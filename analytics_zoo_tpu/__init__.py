@@ -1,0 +1,32 @@
+"""analytics_zoo_tpu — a TPU-native Big Data AI framework.
+
+A ground-up rebuild of the capability surface of Analytics Zoo
+(reference: songhappy/analytics-zoo) on JAX/XLA: where the reference layered
+Spark + BigDL + Ray + JNI (reference zoo/pom.xml, pyzoo/zoo/__init__.py), this
+framework lowers everything to XLA on a `jax.sharding.Mesh` — data / tensor /
+sequence parallelism via sharding specs and pallas kernels, with host-parallel
+sharded data loading.
+
+Top-level subpackages (mirroring the reference layer map, SURVEY.md §1):
+
+- ``common``   — context bootstrap + config singleton (ref: pyzoo/zoo/orca/common.py)
+- ``data``     — XShards sharded data layer (ref: pyzoo/zoo/orca/data/shard.py)
+- ``parallel`` — mesh / sharding strategies / collectives (new capability; ref had
+  data-parallel only, see reference Topology.scala:1145-1550)
+- ``ops``      — pallas TPU kernels (flash attention, ring attention)
+- ``learn``    — Orca-style Estimators: fit/predict/evaluate (ref:
+  pyzoo/zoo/orca/learn/)
+- ``keras``    — Keras-style layer/model API (ref: pyzoo/zoo/pipeline/api/keras/)
+- ``models``   — model zoo (ref: pyzoo/zoo/models/, zoo/.../models/)
+- ``automl``   — hyperparameter search (ref: pyzoo/zoo/automl/)
+- ``zouwu``    — time series: forecasters, AutoTS, anomaly (ref: pyzoo/zoo/zouwu/)
+- ``friesian`` — recsys tabular feature engineering (ref: pyzoo/zoo/friesian/)
+- ``serving``  — streaming + batch inference serving (ref: zoo serving/)
+"""
+
+from analytics_zoo_tpu.version import __version__  # noqa: F401
+from analytics_zoo_tpu.common.context import (  # noqa: F401
+    init_orca_context,
+    stop_orca_context,
+    OrcaContext,
+)

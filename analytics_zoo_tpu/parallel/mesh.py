@@ -1,0 +1,122 @@
+"""Device mesh management.
+
+This is the spine that replaces all four of the reference's communication
+fabrics (SURVEY.md §2.6): BigDL AllReduceParameter-over-BlockManager
+(ref zoo/.../keras/models/Topology.scala:1204), TF MultiWorkerMirrored gRPC
+rings (ref pyzoo/zoo/orca/learn/tf2/tf_runner.py:281-318), gloo/Horovod
+(ref torch_runner.py:136-152), and MXNet kvstore. On TPU, a single
+``jax.sharding.Mesh`` + sharding specs makes XLA emit the collectives
+(all-reduce / reduce-scatter / all-gather / all-to-all) over ICI/DCN directly;
+there is no hand-written comm layer to maintain.
+
+Canonical axis names (used by strategies, kernels and the model zoo):
+
+- ``data``   — data parallel (batch dim)
+- ``fsdp``   — parameter sharding over the data axis (ZeRO-3 analog)
+- ``model``  — tensor parallel
+- ``seq``    — sequence/context parallel (ring attention rides this axis)
+- ``expert`` — MoE expert parallel
+- ``pipe``   — pipeline stages
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
+PIPE_AXIS = "pipe"
+
+_default_mesh = None
+
+
+def build_mesh(axes: Optional[Sequence[str]] = None,
+               shape: Optional[Sequence[int]] = None,
+               devices=None,
+               set_default: bool = True):
+    """Create a ``jax.sharding.Mesh``.
+
+    Defaults to a 1-D data-parallel mesh over all devices — the TPU analog of
+    the reference's one-replica-per-core data parallelism
+    (ref Topology.scala:1237 initThreadModels caches per-core replicas).
+
+    ``shape`` may contain one ``-1`` which absorbs the remaining devices.
+    """
+    global _default_mesh
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+
+    if axes is None:
+        axes = (DATA_AXIS,)
+    axes = tuple(axes)
+    if shape is None:
+        if len(axes) == 1:
+            shape = (n,)
+        else:
+            raise ValueError("mesh_shape required when len(mesh_axes) > 1")
+    shape = list(shape)
+    if -1 in shape:
+        i = shape.index(-1)
+        rest = math.prod(s for s in shape if s != -1)
+        if n % rest:
+            raise ValueError(f"cannot infer -1 in mesh shape {shape} over {n} devices")
+        shape[i] = n // rest
+    if math.prod(shape) != n:
+        raise ValueError(f"mesh shape {shape} does not cover {n} devices")
+
+    dev_array = np.asarray(devices).reshape(shape)
+    mesh = Mesh(dev_array, axes)
+    if set_default:
+        _default_mesh = mesh
+    return mesh
+
+
+def get_default_mesh():
+    """Return the process-wide default mesh, creating a 1-D data mesh lazily."""
+    global _default_mesh
+    if _default_mesh is None:
+        build_mesh()
+    return _default_mesh
+
+
+def set_default_mesh(mesh):
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def mesh_axis_size(mesh, axis: str) -> int:
+    return mesh.shape.get(axis, 1) if hasattr(mesh.shape, "get") else dict(
+        zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
+
+
+def local_batch_to_global(batch, mesh, axis_name: str = DATA_AXIS):
+    """Assemble per-process host arrays into a global jax.Array sharded over
+    ``axis_name`` on the leading dimension.
+
+    Replaces the reference's FeatureSet→DistributedDataSet minibatch handoff
+    (ref zoo/.../feature/FeatureSet.scala:109) and the Spark→Ray shard
+    transfer (ref pyzoo/zoo/orca/data/ray_xshards.py:67-94): data stays on the
+    host that read it; ``make_array_from_process_local_data`` forms the global
+    view without a central shuffle.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def _one(x):
+        spec = P(axis_name, *([None] * (np.ndim(x) - 1)))
+        sharding = NamedSharding(mesh, spec)
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+
+    return jax.tree_util.tree_map(_one, batch)

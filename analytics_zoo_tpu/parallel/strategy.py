@@ -1,0 +1,134 @@
+"""Sharding strategies — parallelism as a first-class Estimator option.
+
+The reference supports *only* synchronous data parallelism (SURVEY.md §2.6:
+"TP / PP / SP / EP / CP — absent in reference"). Here every strategy is a
+declarative sharding layout over the mesh; ``pjit`` lowers it to XLA
+collectives:
+
+- DP    — batch split over ``data``; params replicated; XLA inserts the
+          gradient all-reduce (replaces BigDL AllReduceParameter,
+          ref Topology.scala:1204).
+- FSDP  — params/opt-state sharded over ``fsdp`` (reduce-scatter + all-gather).
+- TP    — tensor parallel over ``model`` via per-parameter rules.
+- SP/CP — sequence dim over ``seq`` (ring attention, ops/ring_attention.py).
+- EP    — experts over ``expert``.
+
+Spell: ``"dp"``, ``"fsdp"``, ``"dp2,tp4"``, ``"dp2,sp2,tp2"`` — sizes omitted
+or ``-1`` absorb the remaining devices.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+from analytics_zoo_tpu.parallel import mesh as mesh_lib
+
+_TOKEN_RE = re.compile(r"^(dp|fsdp|tp|sp|ep|pp)(-?\d*)$")
+
+_AXIS_OF = {
+    "dp": mesh_lib.DATA_AXIS,
+    "fsdp": mesh_lib.FSDP_AXIS,
+    "tp": mesh_lib.MODEL_AXIS,
+    "sp": mesh_lib.SEQ_AXIS,
+    "ep": mesh_lib.EXPERT_AXIS,
+    "pp": mesh_lib.PIPE_AXIS,
+}
+
+
+@dataclass
+class ShardingStrategy:
+    """A mesh layout + parameter partition rules.
+
+    ``param_rules``: list of ``(path_regex, PartitionSpec-as-tuple)`` tried in
+    order against the '/'-joined parameter path; first match wins. Unmatched
+    params are replicated (or fsdp-sharded if fsdp is active).
+    """
+
+    sizes: List[Tuple[str, int]] = field(default_factory=lambda: [("dp", -1)])
+    param_rules: List[Tuple[str, Tuple]] = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, spec: "str | ShardingStrategy | None",
+              param_rules=None) -> "ShardingStrategy":
+        if spec is None:
+            return cls(param_rules=list(param_rules or []))
+        if isinstance(spec, ShardingStrategy):
+            return spec
+        sizes = []
+        for tok in str(spec).replace(" ", "").split(","):
+            if not tok:
+                continue
+            m = _TOKEN_RE.match(tok)
+            if not m:
+                raise ValueError(f"bad strategy token {tok!r}; expected e.g. dp, tp2, fsdp-1")
+            kind, num = m.group(1), m.group(2)
+            sizes.append((kind, int(num) if num not in ("", "-") else -1))
+        if not any(k == "dp" for k, _ in sizes) and not any(n == -1 for _, n in sizes):
+            sizes.insert(0, ("dp", -1))
+        return cls(sizes=sizes, param_rules=list(param_rules or []))
+
+    # ---- mesh ----
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(_AXIS_OF[k] for k, _ in self.sizes)
+
+    def build_mesh(self, devices=None, set_default: bool = True):
+        shape = [n for _, n in self.sizes]
+        if sum(1 for n in shape if n == -1) > 1:
+            raise ValueError("at most one -1 axis size")
+        return mesh_lib.build_mesh(axes=self.axis_names(), shape=shape,
+                                   devices=devices, set_default=set_default)
+
+    @property
+    def uses(self):
+        return {k for k, _ in self.sizes}
+
+    # ---- shardings ----
+    def batch_axes(self) -> Tuple[str, ...]:
+        axes = []
+        if "dp" in self.uses:
+            axes.append(mesh_lib.DATA_AXIS)
+        if "fsdp" in self.uses:
+            axes.append(mesh_lib.FSDP_AXIS)
+        return tuple(axes)
+
+    def batch_spec(self, ndim: int):
+        from jax.sharding import PartitionSpec as P
+        axes = self.batch_axes()
+        lead = axes if len(axes) != 1 else axes[0]
+        return P(lead, *([None] * (ndim - 1))) if axes else P()
+
+    def param_spec(self, path: str, shape: Sequence[int], mesh):
+        """PartitionSpec for one parameter."""
+        from jax.sharding import PartitionSpec as P
+        for pattern, spec in self.param_rules:
+            if re.search(pattern, path):
+                return P(*spec)
+        if "fsdp" in self.uses:
+            size = mesh_lib.mesh_axis_size(mesh, mesh_lib.FSDP_AXIS)
+            # shard the largest divisible dim, prefer the leading one
+            order = sorted(range(len(shape)), key=lambda i: -shape[i])
+            for i in sorted(order):
+                if shape[i] % size == 0 and shape[i] >= size:
+                    spec = [None] * len(shape)
+                    spec[i] = mesh_lib.FSDP_AXIS
+                    return P(*spec)
+        return P()
+
+    def param_shardings(self, params, mesh):
+        """NamedSharding pytree matching ``params``."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        out = []
+        for path, leaf in flat:
+            path_str = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                                for p in path)
+            spec = self.param_spec(path_str, getattr(leaf, "shape", ()), mesh)
+            out.append(NamedSharding(mesh, spec))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def __str__(self):
+        return ",".join(f"{k}{'' if n == -1 else n}" for k, n in self.sizes)

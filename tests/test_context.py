@@ -1,0 +1,71 @@
+import warnings
+
+import numpy as np
+import pytest
+
+
+def test_init_orca_context_local():
+    from analytics_zoo_tpu import init_orca_context, OrcaContext
+    ctx = init_orca_context(cluster_mode="local")
+    assert ctx.num_devices == 8
+    assert OrcaContext.get_context() is ctx
+    assert ctx.mesh.axis_names == ("data",)
+
+
+def test_legacy_spark_kwargs_warn():
+    from analytics_zoo_tpu import init_orca_context
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        init_orca_context(cluster_mode="local", cores=4, memory="2g")
+    assert any("ignored" in str(x.message) for x in w)
+
+
+def test_orca_context_knobs():
+    from analytics_zoo_tpu import OrcaContext
+    OrcaContext.shard_size = 100
+    assert OrcaContext.shard_size == 100
+    OrcaContext.train_data_store = "disk_4"
+    assert OrcaContext.train_data_store == "DISK_4"
+    with pytest.raises(AssertionError):
+        OrcaContext.pandas_read_backend = "spark"
+    OrcaContext.shard_size = None
+    OrcaContext.train_data_store = "DRAM"
+
+
+def test_mesh_build_and_global_batch():
+    from analytics_zoo_tpu import init_orca_context
+    from analytics_zoo_tpu.parallel.mesh import build_mesh, local_batch_to_global
+    init_orca_context(cluster_mode="local")
+    mesh = build_mesh(axes=("data", "model"), shape=(4, -1))
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {"data": 4, "model": 2}
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    gx = local_batch_to_global({"x": x}, mesh)["x"]
+    assert gx.shape == (8, 4)
+    np.testing.assert_allclose(np.asarray(gx), x)
+
+
+def test_strategy_parse_and_specs():
+    from jax.sharding import PartitionSpec as P
+    from analytics_zoo_tpu import init_orca_context
+    from analytics_zoo_tpu.parallel.strategy import ShardingStrategy
+
+    init_orca_context(cluster_mode="local")
+    s = ShardingStrategy.parse("dp2,tp4")
+    assert s.axis_names() == ("data", "model")
+    mesh = s.build_mesh()
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {"data": 2, "model": 4}
+    assert s.batch_spec(2) == P("data", None)
+
+    s2 = ShardingStrategy.parse("dp")
+    m2 = s2.build_mesh()
+    assert dict(zip(m2.axis_names, m2.devices.shape)) == {"data": 8}
+
+    s3 = ShardingStrategy.parse("dp2,fsdp4")
+    m3 = s3.build_mesh()
+    assert s3.batch_spec(2) == P(("data", "fsdp"), None)
+    assert s3.param_spec("dense/kernel", (16, 8), m3) == P("fsdp", None)
+
+    tp = ShardingStrategy.parse("tp8", param_rules=[(r"kernel$", (None, "model"))])
+    mtp = tp.build_mesh()
+    assert tp.param_spec("layers_0/dense/kernel", (4, 16), mtp) == P(None, "model")
+    assert tp.param_spec("layers_0/dense/bias", (16,), mtp) == P()
