@@ -207,12 +207,17 @@ def init_orca_context(cluster_mode: str = "local",
     _sanitize_host_env()
     import jax
 
-    if cluster_mode in ("multihost", "tpu_pod") and coordinator_address:
+    if cluster_mode in ("multihost", "tpu_pod"):
+        if not coordinator_address:
+            raise ValueError(
+                f"cluster_mode={cluster_mode!r} requires coordinator_address "
+                "(host:port of process 0) — otherwise each host would train "
+                "an independent model")
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id)
-    elif cluster_mode not in ("local", "multihost", "tpu_pod"):
+    elif cluster_mode != "local":
         # Accept the reference's mode names so ported scripts still run
         # single-process (ref nncontext.py dispatches yarn/k8s/standalone).
         warnings.warn(f"cluster_mode={cluster_mode!r} has no TPU analog; "

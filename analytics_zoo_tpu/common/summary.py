@@ -1,0 +1,240 @@
+"""TensorBoard event writer with no TF dependency.
+
+Ref: the reference implements its own TF-events writer on the JVM
+(``zoo/src/main/scala/com/intel/analytics/zoo/tensorboard/FileWriter.scala``,
+``EventWriter``, ``RecordWriter``, ``Summary`` — 553 LoC) so training
+summaries ("Loss", "Throughput", "LearningRate", validation metrics;
+Topology.scala:208-240) are viewable in TensorBoard. Same here: scalar
+events are hand-encoded protobuf wrapped in TFRecord framing (masked CRC32C),
+written to ``events.out.tfevents.<ts>.<host>`` files.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Tuple
+
+# ---------------- CRC32C (Castagnoli) ----------------
+
+_CRC_TABLE = []
+
+
+def _make_table():
+    poly = 0x82F63B78
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ poly if c & 1 else c >> 1
+        _CRC_TABLE.append(c)
+
+
+_make_table()
+
+
+def crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF)
+
+
+# ---------------- minimal protobuf encoding ----------------
+
+def _varint(n: int) -> bytes:
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b | 0x80])
+        else:
+            out += bytes([b])
+            return out
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _pb_string(field: int, s: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(s)) + s
+
+
+def _pb_float(field: int, v: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", v)
+
+
+def _pb_double(field: int, v: float) -> bytes:
+    return _tag(field, 1) + struct.pack("<d", v)
+
+
+def _pb_int64(field: int, v: int) -> bytes:
+    return _tag(field, 0) + _varint(v & 0xFFFFFFFFFFFFFFFF)
+
+
+def _summary_value(tag: str, value: float) -> bytes:
+    # Summary.Value: tag = field 1 (string), simple_value = field 2 (float)
+    body = _pb_string(1, tag.encode()) + _pb_float(2, value)
+    return body
+
+
+def _event(step: int, tag: str = None, value: float = None,
+           file_version: str = None) -> bytes:
+    # Event: wall_time f1 double, step f2 int64, file_version f3 string,
+    # summary f5 message; Summary.value = repeated field 1
+    out = _pb_double(1, time.time())
+    out += _pb_int64(2, step)
+    if file_version is not None:
+        out += _pb_string(3, file_version.encode())
+    if tag is not None:
+        summary = _pb_string(1, _summary_value(tag, value))
+        out += _pb_string(5, summary)
+    return out
+
+
+def _record(data: bytes) -> bytes:
+    header = struct.pack("<Q", len(data))
+    return (header + struct.pack("<I", _masked_crc(header))
+            + data + struct.pack("<I", _masked_crc(data)))
+
+
+class SummaryWriter:
+    """Append-only scalar event writer (ref FileWriter.scala / EventWriter)."""
+
+    def __init__(self, log_dir: str):
+        os.makedirs(log_dir, exist_ok=True)
+        self.log_dir = log_dir
+        fname = f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}"
+        self._path = os.path.join(log_dir, fname)
+        self._lock = threading.Lock()
+        self._fh = open(self._path, "ab")
+        self._fh.write(_record(_event(0, file_version="brain.Event:2")))
+        self._fh.flush()
+        # in-memory mirror for get_scalar (ref Topology.scala:208-240
+        # get_train_summary reads back from disk; we keep both)
+        self._scalars: Dict[str, List[Tuple[int, float]]] = {}
+
+    def add_scalar(self, tag: str, value: float, step: int):
+        with self._lock:
+            self._fh.write(_record(_event(step, tag, float(value))))
+            self._scalars.setdefault(tag, []).append((step, float(value)))
+
+    def flush(self):
+        with self._lock:
+            self._fh.flush()
+
+    def close(self):
+        with self._lock:
+            if not self._fh.closed:
+                self._fh.flush()
+                self._fh.close()
+
+    def get_scalar(self, tag: str) -> List[Tuple[int, float]]:
+        return list(self._scalars.get(tag, []))
+
+
+def read_scalars(path: str) -> Dict[str, List[Tuple[int, float]]]:
+    """Parse an events file back into {tag: [(step, value)]} — used by tests
+    and by ``get_train_summary`` on reload."""
+    out: Dict[str, List[Tuple[int, float]]] = {}
+    with open(path, "rb") as fh:
+        data = fh.read()
+    pos = 0
+    while pos + 12 <= len(data):
+        (length,) = struct.unpack_from("<Q", data, pos)
+        pos += 12  # len + len-crc
+        payload = data[pos:pos + length]
+        pos += length + 4  # payload + payload-crc
+        step, tag, value = _parse_event(payload)
+        if tag is not None:
+            out.setdefault(tag, []).append((step, value))
+    return out
+
+
+def _parse_event(buf: bytes):
+    pos, step, tag, value = 0, 0, None, None
+
+    def read_varint(p):
+        shift = v = 0
+        while True:
+            b = buf[p]
+            v |= (b & 0x7F) << shift
+            p += 1
+            if not b & 0x80:
+                return v, p
+            shift += 7
+
+    while pos < len(buf):
+        key, pos = read_varint(pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, pos = read_varint(pos)
+            if field == 2:
+                step = v
+        elif wire == 1:
+            pos += 8
+        elif wire == 5:
+            pos += 4
+        elif wire == 2:
+            ln, pos = read_varint(pos)
+            sub = buf[pos:pos + ln]
+            pos += ln
+            if field == 5:  # summary
+                spos = 0
+                while spos < len(sub):
+                    skey, spos = read_varint_b(sub, spos)
+                    sfield, swire = skey >> 3, skey & 7
+                    if swire == 2:
+                        sln, spos = read_varint_b(sub, spos)
+                        val_msg = sub[spos:spos + sln]
+                        spos += sln
+                        if sfield == 1:
+                            tag, value = _parse_value(val_msg)
+                    elif swire == 5:
+                        spos += 4
+                    elif swire == 1:
+                        spos += 8
+                    else:
+                        _, spos = read_varint_b(sub, spos)
+    return step, tag, value
+
+
+def read_varint_b(buf: bytes, p: int):
+    shift = v = 0
+    while True:
+        b = buf[p]
+        v |= (b & 0x7F) << shift
+        p += 1
+        if not b & 0x80:
+            return v, p
+        shift += 7
+
+
+def _parse_value(buf: bytes):
+    pos, tag, value = 0, None, None
+    while pos < len(buf):
+        key, pos = read_varint_b(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 2:
+            ln, pos = read_varint_b(buf, pos)
+            if field == 1:
+                tag = buf[pos:pos + ln].decode("utf-8", "replace")
+            pos += ln
+        elif wire == 5:
+            if field == 2:
+                (value,) = struct.unpack_from("<f", buf, pos)
+            pos += 4
+        elif wire == 1:
+            pos += 8
+        else:
+            _, pos = read_varint_b(buf, pos)
+    return tag, value

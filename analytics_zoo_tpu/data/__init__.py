@@ -1,0 +1,2 @@
+from analytics_zoo_tpu.data.shard import XShards, HostXShards, SharedValue  # noqa: F401
+from analytics_zoo_tpu.data.dataset import ShardedDataset  # noqa: F401

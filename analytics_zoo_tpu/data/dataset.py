@@ -1,0 +1,230 @@
+"""ShardedDataset — fixed-shape minibatch feeding for the mesh.
+
+Replaces the reference's entire TFDataset/FeatureSet feeding stack
+(ref pyzoo/zoo/tfpark/tf_dataset.py:117-1356 and
+zoo/.../feature/FeatureSet.scala:109-705): instead of slicing a per-core
+batch inside Spark executors and pushing JVM tensors through JNI, we gather
+each host's shards into contiguous numpy arrays once, then cut
+shuffled fixed-shape global batches and place them on the mesh as sharded
+``jax.Array``s (XLA requires static shapes — the batch dim never varies; the
+final partial batch is dropped for training or zero-padded + masked for
+eval/predict, matching the reference's drop/pad split at
+tf_dataset.py:117 batch_per_thread semantics).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.data.shard import HostXShards, XShards
+
+
+def _tree_concat(shards):
+    import jax
+    leaves_list = [jax.tree_util.tree_flatten(s)[0] for s in shards]
+    treedef = jax.tree_util.tree_flatten(shards[0])[1]
+    out = [np.concatenate([ls[i] for ls in leaves_list]) for i in range(len(leaves_list[0]))]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _tree_take(data, idx):
+    import jax
+    return jax.tree_util.tree_map(lambda a: a[idx], data)
+
+
+def _tree_len(data):
+    import jax
+    return len(jax.tree_util.tree_leaves(data)[0])
+
+
+class ShardedDataset:
+    """Host-resident columnar dataset with deterministic sharded batching.
+
+    ``x``/``y`` are pytrees of numpy arrays (dict, tuple or single array),
+    equal length on axis 0. ``y`` may be None (predict).
+    """
+
+    def __init__(self, x, y=None, sample_weight=None):
+        self.x = x
+        self.y = y
+        self.sample_weight = sample_weight
+        self.n = _tree_len(x)
+        if y is not None:
+            assert _tree_len(y) == self.n, "x/y length mismatch"
+
+    # ---- constructors ----
+    @classmethod
+    def from_ndarrays(cls, x, y=None, sample_weight=None) -> "ShardedDataset":
+        return cls(x, y, sample_weight)
+
+    @classmethod
+    def from_xshards(cls, shards: XShards,
+                     feature_cols=None, label_cols=None) -> "ShardedDataset":
+        """From XShards of ``{"x":..., "y":...}`` numpy dicts (the Orca
+        convention, ref pyzoo/zoo/orca/learn/utils.py) or of pandas
+        DataFrames + feature/label column names (ref
+        orca/learn/tf/estimator.py:373-426 to_dataset)."""
+        data = shards.collect()
+        assert data, "empty XShards"
+        first = data[0]
+        if isinstance(first, dict) and "x" in first:
+            x = _tree_concat([d["x"] for d in data])
+            y = _tree_concat([d["y"] for d in data]) if "y" in first and first["y"] is not None else None
+            return cls(x, y)
+        # pandas path
+        import pandas as pd
+        assert isinstance(first, pd.DataFrame), f"unsupported shard type {type(first)}"
+        assert feature_cols, "feature_cols required for DataFrame shards"
+        big = pd.concat(data, ignore_index=True)
+
+        def cols_to_tree(cols):
+            if isinstance(cols, str):
+                cols = [cols]
+            arrs = [np.asarray(np.stack(big[c].to_numpy())
+                               if big[c].dtype == object else big[c].to_numpy())
+                    for c in cols]
+            return arrs[0] if len(arrs) == 1 else tuple(arrs)
+
+        x = cols_to_tree(feature_cols)
+        y = cols_to_tree(label_cols) if label_cols else None
+        return cls(x, y)
+
+    # ---- transforms ----
+    def map(self, fn: Callable) -> "ShardedDataset":
+        x, y = fn(self.x, self.y)
+        return ShardedDataset(x, y, self.sample_weight)
+
+    def take(self, n: int) -> "ShardedDataset":
+        idx = np.arange(min(n, self.n))
+        return ShardedDataset(_tree_take(self.x, idx),
+                              _tree_take(self.y, idx) if self.y is not None else None)
+
+    def split(self, fraction: float, seed: int = 0):
+        """Random train/val split."""
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(self.n)
+        k = int(self.n * fraction)
+        a, b = perm[:k], perm[k:]
+        mk = lambda idx: ShardedDataset(
+            _tree_take(self.x, idx),
+            _tree_take(self.y, idx) if self.y is not None else None)
+        return mk(a), mk(b)
+
+    # ---- batching ----
+    def steps_per_epoch(self, batch_size: int, drop_remainder: bool = True) -> int:
+        per_host = batch_size  # single-process: global == local
+        import jax
+        if jax.process_count() > 1:
+            assert batch_size % jax.process_count() == 0
+            per_host = batch_size // jax.process_count()
+        if drop_remainder:
+            return self.n // per_host
+        return math.ceil(self.n / per_host)
+
+    def iter_batches(self, batch_size: int, shuffle: bool = False,
+                     seed: int = 0, epoch: int = 0,
+                     drop_remainder: bool = True
+                     ) -> Iterator[Tuple[Any, Any, Optional[np.ndarray]]]:
+        """Yield (x, y, mask) host-local numpy batches of fixed shape.
+
+        mask is None for full batches; for a padded final batch it is a
+        float32 {0,1} vector of valid rows.
+        """
+        import jax
+        per_host = batch_size
+        if jax.process_count() > 1:
+            assert batch_size % jax.process_count() == 0, \
+                "global batch must divide over processes"
+            per_host = batch_size // jax.process_count()
+        if per_host > self.n and drop_remainder:
+            raise ValueError(f"batch_size {per_host} > dataset size {self.n} "
+                             "(with drop_remainder=True no batch can be formed)")
+
+        order = np.arange(self.n)
+        if shuffle:
+            rng = np.random.default_rng((seed * 100003 + epoch) & 0x7FFFFFFF)
+            rng.shuffle(order)
+
+        full = self.n // per_host
+        for b in range(full):
+            idx = order[b * per_host:(b + 1) * per_host]
+            yield (_tree_take(self.x, idx),
+                   _tree_take(self.y, idx) if self.y is not None else None,
+                   None)
+        rem = self.n - full * per_host
+        if rem and not drop_remainder:
+            idx = order[full * per_host:]
+            pad = np.concatenate([idx, np.zeros(per_host - rem, dtype=idx.dtype)])
+            mask = np.zeros(per_host, np.float32)
+            mask[:rem] = 1.0
+            yield (_tree_take(self.x, pad),
+                   _tree_take(self.y, pad) if self.y is not None else None,
+                   mask)
+
+    def device_iterator(self, mesh, strategy, batch_size: int,
+                        shuffle: bool = False, seed: int = 0, epoch: int = 0,
+                        drop_remainder: bool = True):
+        """iter_batches + placement on the mesh as global sharded jax.Arrays,
+        with one batch of host→device prefetch overlap."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        # Fixed-shape constraint (ref tf_dataset.py:117: batch_size must be
+        # divisible by the total core count): the per-host batch must divide
+        # over the mesh's batch axes.
+        divisor = 1
+        for ax in strategy.batch_axes():
+            divisor *= dict(zip(mesh.axis_names, mesh.devices.shape)).get(ax, 1)
+        per_host = batch_size // max(1, jax.process_count())
+        if divisor and per_host % divisor:
+            raise ValueError(
+                f"batch_size {batch_size} (per-host {per_host}) must be "
+                f"divisible by the mesh batch-axis size {divisor} "
+                f"(axes {strategy.batch_axes()})")
+
+        from analytics_zoo_tpu.parallel.mesh import place_on_mesh
+
+        def place(batch):
+            x, y, mask = batch
+            def put(tree):
+                if tree is None:
+                    return None
+                return place_on_mesh(
+                    tree, mesh, lambda a: strategy.batch_spec(np.ndim(a)))
+            return put(x), put(y), put(mask)
+
+        it = self.iter_batches(batch_size, shuffle, seed, epoch, drop_remainder)
+        prev = None
+        for b in it:
+            cur = place(b)  # async transfer starts immediately
+            if prev is not None:
+                yield prev
+            prev = cur
+        if prev is not None:
+            yield prev
+
+
+def to_sharded_dataset(data, feature_cols=None, label_cols=None,
+                       validation=None) -> ShardedDataset:
+    """Coerce the Orca Estimator's accepted inputs — XShards, (x, y) ndarray
+    tuples, dict pytrees, pandas DataFrame — into a ShardedDataset
+    (ref orca/learn/tf/estimator.py:373-426 to_dataset dispatch)."""
+    if isinstance(data, ShardedDataset):
+        return data
+    if isinstance(data, XShards):
+        return ShardedDataset.from_xshards(data, feature_cols, label_cols)
+    try:
+        import pandas as pd
+        if isinstance(data, pd.DataFrame):
+            return ShardedDataset.from_xshards(
+                HostXShards([data]), feature_cols, label_cols)
+    except ImportError:  # pragma: no cover
+        pass
+    if isinstance(data, tuple) and len(data) == 2:
+        return ShardedDataset.from_ndarrays(data[0], data[1])
+    if isinstance(data, dict) and "x" in data:
+        return ShardedDataset.from_ndarrays(data["x"], data.get("y"))
+    return ShardedDataset.from_ndarrays(data)

@@ -1,0 +1,76 @@
+"""Distributed file readers → XShards of pandas DataFrames.
+
+Ref: ``pyzoo/zoo/orca/data/pandas/preprocessing.py:24-308`` (read_csv /
+read_json / read_parquet over Spark or pandas backends). Here each host
+process reads its slice of the file list (multi-host: files are striped over
+``jax.process_index()``), one shard per file, re-sharded to honour
+``OrcaContext.shard_size``.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import List, Optional
+
+from analytics_zoo_tpu.common.context import OrcaContext
+from analytics_zoo_tpu.data.shard import HostXShards
+
+
+def _expand(file_path: str) -> List[str]:
+    paths = []
+    for p in file_path.split(","):
+        p = p.strip()
+        if not p:
+            continue
+        if os.path.isdir(p):
+            paths.extend(sorted(
+                f for f in glob.glob(os.path.join(p, "*"))
+                if os.path.isfile(f) and not os.path.basename(f).startswith(("_", "."))))
+        else:
+            hits = sorted(glob.glob(p))
+            if not hits:
+                raise FileNotFoundError(p)
+            paths.extend(hits)
+    if not paths:
+        raise FileNotFoundError(f"no files matched {file_path!r}")
+    return paths
+
+
+def _my_slice(paths: List[str]) -> List[str]:
+    import jax
+    n, i = jax.process_count(), jax.process_index()
+    return paths[i::n] if n > 1 else paths
+
+
+def _post(shards, num_shards: Optional[int]):
+    out = HostXShards(shards)
+    if num_shards is not None:
+        out = out.repartition(num_shards)
+    elif OrcaContext.shard_size is not None:
+        total = len(out)
+        import math
+        out = out.repartition(max(1, math.ceil(total / OrcaContext.shard_size)))
+    return out
+
+
+def read_csv(file_path: str, num_shards: Optional[int] = None, **kwargs) -> HostXShards:
+    """(ref preprocessing.py:24-35)"""
+    import pandas as pd
+    return _post([pd.read_csv(p, **kwargs) for p in _my_slice(_expand(file_path))],
+                 num_shards)
+
+
+def read_json(file_path: str, num_shards: Optional[int] = None, **kwargs) -> HostXShards:
+    """(ref preprocessing.py:37-48)"""
+    import pandas as pd
+    return _post([pd.read_json(p, **kwargs) for p in _my_slice(_expand(file_path))],
+                 num_shards)
+
+
+def read_parquet(file_path: str, columns: Optional[List[str]] = None,
+                 num_shards: Optional[int] = None, **options) -> HostXShards:
+    """(ref preprocessing.py:271-306)"""
+    import pandas as pd
+    return _post([pd.read_parquet(p, columns=columns, **options)
+                  for p in _my_slice(_expand(file_path))], num_shards)

@@ -1,0 +1,293 @@
+"""XShards — the sharded data layer.
+
+TPU-native analog of the reference's ``XShards``/``SparkXShards``
+(ref pyzoo/zoo/orca/data/shard.py:25-470): a partitioned collection of Python
+objects (numpy-dict shards, pandas DataFrames, arbitrary objects). Where the
+reference keeps shards in Spark RDD partitions on executors, here each *host
+process* owns a list of shards (multi-host: each process holds its slice of
+the global dataset and batches assemble into global ``jax.Array``s via
+``make_array_from_process_local_data`` — see parallel/mesh.py).
+
+Memory tiers (ref FeatureSet DRAM/PMEM/DISK_n, zoo/.../feature/FeatureSet.scala:556,635):
+``"DRAM"`` keeps shards as live objects; ``"DISK_n"`` spills shards to disk
+pickles and keeps only 1/n resident, streaming the rest on demand — set via
+``OrcaContext.train_data_store``.
+
+API parity (same method names as the reference): ``partition``,
+``transform_shard``, ``collect``, ``num_partitions``, ``repartition``,
+``partition_by``, ``unique``, ``split``, ``zip``, ``__len__``,
+``save_pickle``/``load_pickle``, ``__getitem__``, ``cache``/``uncache``.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import pickle
+import tempfile
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+
+def _is_dataframe(x):
+    try:
+        import pandas as pd
+        return isinstance(x, pd.DataFrame)
+    except ImportError:  # pragma: no cover
+        return False
+
+
+class XShards:
+    """Abstract base (ref shard.py:25-70)."""
+
+    def transform_shard(self, func: Callable, *args) -> "XShards":
+        raise NotImplementedError
+
+    def collect(self) -> List[Any]:
+        raise NotImplementedError
+
+    def num_partitions(self) -> int:
+        raise NotImplementedError
+
+    @classmethod
+    def load_pickle(cls, path: str, minPartitions: Optional[int] = None) -> "HostXShards":
+        """Load shards saved by ``save_pickle`` (ref shard.py:60-71)."""
+        files = sorted(glob.glob(os.path.join(path, "part-*.pkl")))
+        if not files:
+            raise FileNotFoundError(f"no shard pickles under {path}")
+        shards = []
+        for f in files:
+            with open(f, "rb") as fh:
+                shards.extend(pickle.load(fh))
+        out = HostXShards(shards)
+        if minPartitions and out.num_partitions() < minPartitions:
+            out = out.repartition(minPartitions)
+        return out
+
+    @staticmethod
+    def partition(data, num_shards: Optional[int] = None) -> "HostXShards":
+        """Partition an in-memory ndarray / dict / (nested) list-of-ndarrays
+        into shards (ref shard.py:73-127 splits along axis 0)."""
+        import jax
+
+        n = num_shards
+        if n is None:
+            from analytics_zoo_tpu.common.context import OrcaContext
+            try:
+                n = OrcaContext.get_context().num_devices
+            except RuntimeError:
+                n = 1
+
+        leaves, treedef = jax.tree_util.tree_flatten(data)
+        if not leaves:
+            raise ValueError("empty data")
+        lengths = {len(a) for a in leaves}
+        if len(lengths) != 1:
+            raise ValueError(f"all arrays must share axis-0 length, got {lengths}")
+        total = lengths.pop()
+        if total < n:
+            raise ValueError(f"cannot split {total} rows into {n} shards")
+        splits = np.array_split(np.arange(total), n)
+        shards = []
+        for idx in splits:
+            shards.append(jax.tree_util.tree_unflatten(
+                treedef, [np.asarray(a)[idx] for a in leaves]))
+        return HostXShards(shards)
+
+
+class _ShardStore:
+    """Shard storage backend: DRAM list, or disk spill keeping 1/n resident."""
+
+    def __init__(self, shards: List[Any], tier: str = "DRAM"):
+        self.tier = tier
+        if tier == "DRAM":
+            self._mem = list(shards)
+            self._paths = None
+        else:
+            keep = max(1, int(tier.split("_", 1)[1]))
+            self._dir = tempfile.mkdtemp(prefix="zoo_tpu_shards_")
+            self._paths = []
+            self._mem = [None] * len(shards)
+            for i, s in enumerate(shards):
+                p = os.path.join(self._dir, f"shard-{i:05d}.pkl")
+                with open(p, "wb") as fh:
+                    pickle.dump(s, fh, protocol=pickle.HIGHEST_PROTOCOL)
+                self._paths.append(p)
+                if i % keep == 0:  # keep 1/keep resident
+                    self._mem[i] = s
+
+    def __len__(self):
+        return len(self._mem)
+
+    def get(self, i: int):
+        s = self._mem[i]
+        if s is None:
+            with open(self._paths[i], "rb") as fh:
+                s = pickle.load(fh)
+        return s
+
+    def all(self):
+        return [self.get(i) for i in range(len(self))]
+
+
+class HostXShards(XShards):
+    """Shards resident in this host process (ref SparkXShards, shard.py:129)."""
+
+    def __init__(self, shards: List[Any], transient: bool = False,
+                 tier: Optional[str] = None):
+        if tier is None:
+            from analytics_zoo_tpu.common.context import OrcaContext
+            tier = OrcaContext.train_data_store
+        self._store = _ShardStore(list(shards), tier if not transient else "DRAM")
+        self.tier = self._store.tier
+
+    # -- core --
+    def transform_shard(self, func: Callable, *args) -> "HostXShards":
+        return HostXShards([func(s, *args) for s in self._iter_shards()])
+
+    def _iter_shards(self):
+        for i in range(len(self._store)):
+            yield self._store.get(i)
+
+    def collect(self) -> List[Any]:
+        return self._store.all()
+
+    def num_partitions(self) -> int:
+        return len(self._store)
+
+    def cache(self):
+        return self
+
+    def uncache(self):
+        return self
+
+    # -- restructuring --
+    def repartition(self, num_partitions: int) -> "HostXShards":
+        """Type-aware merge/split (ref shard.py:219-293: np-dict rows merged
+        elementwise, DataFrames concatenated)."""
+        import pandas as pd
+        shards = self.collect()
+        if not shards:
+            return self
+        flat_rows: List[Any]
+        first = shards[0]
+        if _is_dataframe(first):
+            big = pd.concat(shards, ignore_index=False)
+            idx = np.array_split(np.arange(len(big)), num_partitions)
+            return HostXShards([big.iloc[i] for i in idx])
+        if isinstance(first, dict) and all(
+                isinstance(v, np.ndarray) for v in first.values()):
+            keys = list(first.keys())
+            merged = {k: np.concatenate([s[k] for s in shards]) for k in keys}
+            total = len(merged[keys[0]])
+            idx = np.array_split(np.arange(total), num_partitions)
+            return HostXShards([{k: merged[k][i] for k in keys} for i in idx])
+        if isinstance(first, np.ndarray):
+            merged = np.concatenate(shards)
+            return HostXShards(np.array_split(merged, num_partitions))
+        # generic: treat each shard as a list of records
+        records = []
+        for s in shards:
+            records.extend(s if isinstance(s, (list, tuple)) else [s])
+        idx = np.array_split(np.arange(len(records)), num_partitions)
+        return HostXShards([[records[j] for j in i] for i in idx])
+
+    def partition_by(self, cols, num_partitions: Optional[int] = None) -> "HostXShards":
+        """Hash-partition DataFrame shards by column(s) (ref shard.py:295-339)."""
+        import pandas as pd
+        shards = self.collect()
+        assert shards and _is_dataframe(shards[0]), \
+            "partition_by requires pandas DataFrame shards"
+        if isinstance(cols, str):
+            cols = [cols]
+        n = num_partitions or self.num_partitions()
+        big = pd.concat(shards, ignore_index=False)
+        codes = pd.util.hash_pandas_object(big[cols], index=False).to_numpy() % n
+        return HostXShards([big[codes == i] for i in range(n)])
+
+    def unique(self) -> np.ndarray:
+        """Distinct elements over series/array shards (ref shard.py:341-358)."""
+        vals = []
+        for s in self._iter_shards():
+            vals.append(np.unique(np.asarray(s)))
+        return np.unique(np.concatenate(vals)) if vals else np.array([])
+
+    def split(self) -> List["HostXShards"]:
+        """If each shard is a tuple/list of k elements, return k XShards
+        (ref shard.py:360-387)."""
+        shards = self.collect()
+        ks = {len(s) for s in shards if isinstance(s, (list, tuple))}
+        if len(ks) != 1:
+            return [self]
+        k = ks.pop()
+        return [HostXShards([s[i] for s in shards]) for i in range(k)]
+
+    def zip(self, other: "HostXShards") -> "HostXShards":
+        """Pairwise zip; requires equal partition counts and lengths
+        (ref shard.py:389-411)."""
+        assert isinstance(other, HostXShards)
+        assert self.num_partitions() == other.num_partitions(), \
+            "XShards.zip: partition counts differ"
+        a, b = self.collect(), other.collect()
+        for x, y in zip(a, b):
+            if hasattr(x, "__len__") and hasattr(y, "__len__"):
+                assert len(x) == len(y), "XShards.zip: shard lengths differ"
+        return HostXShards(list(zip(a, b)))
+
+    # -- misc --
+    def __len__(self):
+        total = 0
+        for s in self._iter_shards():
+            if isinstance(s, dict):
+                # numpy-dict shard: rows, not keys (ref shard.py:413-415
+                # counts elements via get_size on each partition)
+                vals = list(s.values())
+                total += len(vals[0]) if vals else 0
+            elif hasattr(s, "__len__"):
+                total += len(s)
+            else:
+                total += 1
+        return total
+
+    def __getitem__(self, key):
+        """Column selection on dict/DataFrame shards (ref shard.py:432-441)."""
+        def get_data(data):
+            if isinstance(data, dict) or _is_dataframe(data):
+                return data[key]
+            raise KeyError(f"cannot index shard of type {type(data)}")
+        return HostXShards([get_data(s) for s in self._iter_shards()],
+                           transient=True)
+
+    def save_pickle(self, path: str, batchSize: int = 10) -> "HostXShards":
+        """(ref shard.py:417-427)"""
+        os.makedirs(path, exist_ok=True)
+        shards = self.collect()
+        for i in range(0, len(shards), batchSize):
+            with open(os.path.join(path, f"part-{i // batchSize:05d}.pkl"), "wb") as fh:
+                pickle.dump(shards[i:i + batchSize], fh,
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        return self
+
+    def to_pandas(self):
+        import pandas as pd
+        return pd.concat(self.collect(), ignore_index=False)
+
+
+# backwards-compatible alias: reference user code says SparkXShards
+SparkXShards = HostXShards
+
+
+class SharedValue:
+    """Broadcast-value analog (ref shard.py:472-485). On a single host this is
+    just a holder; the .value property keeps API parity."""
+
+    def __init__(self, data):
+        self._data = data
+
+    @property
+    def value(self):
+        return self._data
+
+    def unpersist(self):
+        self._data = None

@@ -1,0 +1,1 @@
+from analytics_zoo_tpu.learn.estimator import Estimator  # noqa: F401

@@ -1,0 +1,82 @@
+"""Versioned checkpoint/resume.
+
+Ref: BigDL-style snapshots ``model.<iter>`` + ``optimMethod-<name>.<iter>``
+under a timestamped dir (zoo/.../keras/models/Topology.scala:1245-1252) and
+Orca ``find_latest_checkpoint`` / ``load_orca_checkpoint``
+(pyzoo/zoo/orca/learn/utils.py:24, orca/learn/tf/estimator.py:270-289).
+
+Format: ``<dir>/ckpt-<iteration>/`` containing ``state.msgpack`` (params +
+opt_state + rng, via flax msgpack serialization of host-gathered arrays) and
+``meta.json`` (iteration, epoch, wall time). Retention respects
+``OrcaContext.checkpoint_max_to_keep``.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import shutil
+import time
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def _to_host(tree):
+    return jax.tree_util.tree_map(lambda a: np.asarray(a), tree)
+
+
+def save_checkpoint(ckpt_dir: str, state: Any, iteration: int, epoch: int,
+                    max_to_keep: Optional[int] = None) -> str:
+    from flax import serialization
+    if max_to_keep is None:
+        from analytics_zoo_tpu.common.context import OrcaContext
+        max_to_keep = OrcaContext.checkpoint_max_to_keep
+
+    path = os.path.join(ckpt_dir, f"ckpt-{iteration}")
+    tmp = path + ".tmp"
+    os.makedirs(tmp, exist_ok=True)
+    with open(os.path.join(tmp, "state.msgpack"), "wb") as fh:
+        fh.write(serialization.to_bytes(_to_host(state)))
+    with open(os.path.join(tmp, "meta.json"), "w") as fh:
+        json.dump({"iteration": iteration, "epoch": epoch, "time": time.time()}, fh)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    os.replace(tmp, path)
+
+    # retention
+    versions = sorted(_list_versions(ckpt_dir))
+    for v in versions[:-max_to_keep]:
+        shutil.rmtree(os.path.join(ckpt_dir, f"ckpt-{v}"), ignore_errors=True)
+    return path
+
+
+def _list_versions(ckpt_dir: str):
+    out = []
+    for p in glob.glob(os.path.join(ckpt_dir, "ckpt-*")):
+        m = re.match(r".*ckpt-(\d+)$", p)
+        if m and os.path.isdir(p):
+            out.append(int(m.group(1)))
+    return out
+
+
+def find_latest_checkpoint(ckpt_dir: str) -> Optional[Tuple[str, int]]:
+    """(ref orca/learn/utils.py find_latest_checkpoint)"""
+    versions = _list_versions(ckpt_dir)
+    if not versions:
+        return None
+    v = max(versions)
+    return os.path.join(ckpt_dir, f"ckpt-{v}"), v
+
+
+def load_checkpoint(path: str, target: Any) -> Tuple[Any, dict]:
+    """Restore into the structure of ``target`` (a template state pytree)."""
+    from flax import serialization
+    with open(os.path.join(path, "state.msgpack"), "rb") as fh:
+        state = serialization.from_bytes(_to_host(target), fh.read())
+    with open(os.path.join(path, "meta.json")) as fh:
+        meta = json.load(fh)
+    return state, meta

@@ -1,0 +1,254 @@
+"""Framework-neutral metrics (ref ``pyzoo/zoo/orca/learn/metrics.py:19-340``).
+
+The reference lowers metric names to BigDL ``ValidationMethod`` objects
+executed on the JVM; here each metric is a pure-functional accumulator —
+``init_state() → state``, ``update(state, y_true, y_pred, mask) → state``
+(jit-safe, runs on device inside the eval step, so metric math is fused into
+the forward pass and only O(1) state returns to host), ``result(state)``.
+
+Surface parity: Accuracy, SparseCategoricalAccuracy, CategoricalAccuracy,
+BinaryAccuracy, Top5Accuracy, AUC, MAE, MSE, RMSE, BinaryCrossentropy,
+CategoricalCrossentropy, SparseCategoricalCrossentropy, KLDivergence,
+Poisson.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _align(y_true, y_pred):
+    """Flatten both to [batch, features] so (n,) labels vs (n,1) predictions
+    don't broadcast into an (n,n) matrix."""
+    y_true = jnp.asarray(y_true)
+    y_pred = jnp.asarray(y_pred)
+    b = y_pred.shape[0]
+    return y_true.reshape(b, -1), y_pred.reshape(b, -1)
+
+
+def _masked(values, mask):
+    """Reduce per-sample values with an optional {0,1} validity mask."""
+    values = values.astype(jnp.float32)
+    if values.ndim > 1:
+        values = values.reshape(values.shape[0], -1).mean(axis=-1)
+    if mask is None:
+        return values.sum(), jnp.asarray(values.shape[0], jnp.float32)
+    return (values * mask).sum(), mask.sum()
+
+
+class Metric:
+    name = "metric"
+
+    def init_state(self):
+        return {"total": jnp.zeros((), jnp.float32),
+                "count": jnp.zeros((), jnp.float32)}
+
+    def update(self, state, y_true, y_pred, mask=None):
+        total, count = _masked(self._per_sample(y_true, y_pred), mask)
+        return {"total": state["total"] + total, "count": state["count"] + count}
+
+    def _per_sample(self, y_true, y_pred):
+        raise NotImplementedError
+
+    def result(self, state) -> float:
+        return float(state["total"] / jnp.maximum(state["count"], 1.0))
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+class Accuracy(Metric):
+    """Auto-dispatching accuracy (ref metrics.py Accuracy: zero-based labels).
+
+    binary if y_pred has 1 output, sparse-categorical if labels are integer
+    class ids, categorical if labels are one-hot.
+    """
+    name = "accuracy"
+
+    def _per_sample(self, y_true, y_pred):
+        y_pred = jnp.asarray(y_pred)
+        y_true = jnp.asarray(y_true)
+        if y_pred.ndim <= 1 or y_pred.shape[-1] == 1:
+            p = y_pred.reshape(y_pred.shape[0], -1)[:, 0]
+            t = y_true.reshape(y_true.shape[0], -1)[:, 0]
+            return ((p > 0.5) == (t > 0.5)).astype(jnp.float32)
+        pred_cls = jnp.argmax(y_pred, axis=-1)
+        if y_true.ndim == y_pred.ndim:
+            true_cls = jnp.argmax(y_true, axis=-1)
+        else:
+            true_cls = y_true.astype(jnp.int32)
+        return (pred_cls == true_cls).astype(jnp.float32)
+
+
+class SparseCategoricalAccuracy(Accuracy):
+    name = "sparse_categorical_accuracy"
+
+    def _per_sample(self, y_true, y_pred):
+        return (jnp.argmax(y_pred, -1) == jnp.asarray(y_true).astype(jnp.int32)
+                ).astype(jnp.float32)
+
+
+class CategoricalAccuracy(Metric):
+    name = "categorical_accuracy"
+
+    def _per_sample(self, y_true, y_pred):
+        return (jnp.argmax(y_pred, -1) == jnp.argmax(y_true, -1)).astype(jnp.float32)
+
+
+class BinaryAccuracy(Metric):
+    name = "binary_accuracy"
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+
+    def _per_sample(self, y_true, y_pred):
+        t, p = _align(y_true, y_pred)
+        return ((p > self.threshold) == (t > 0.5)).astype(jnp.float32)
+
+
+class Top5Accuracy(Metric):
+    """(ref metrics.py Top5Accuracy)"""
+    name = "top5_accuracy"
+
+    def _per_sample(self, y_true, y_pred):
+        y_true = jnp.asarray(y_true)
+        if y_true.ndim == jnp.asarray(y_pred).ndim:
+            y_true = jnp.argmax(y_true, -1)
+        top5 = jnp.argsort(y_pred, axis=-1)[..., -5:]
+        return jnp.any(top5 == y_true[..., None], axis=-1).astype(jnp.float32)
+
+
+class MAE(Metric):
+    name = "mae"
+
+    def _per_sample(self, y_true, y_pred):
+        t, p = _align(y_true, y_pred)
+        return jnp.abs(p - t)
+
+
+class MSE(Metric):
+    name = "mse"
+
+    def _per_sample(self, y_true, y_pred):
+        t, p = _align(y_true, y_pred)
+        return jnp.square(p - t)
+
+
+class RMSE(MSE):
+    name = "rmse"
+
+    def result(self, state):
+        return float(jnp.sqrt(state["total"] / jnp.maximum(state["count"], 1.0)))
+
+
+class BinaryCrossentropy(Metric):
+    name = "binary_crossentropy"
+
+    def _per_sample(self, y_true, y_pred):
+        eps = 1e-7
+        t, p = _align(y_true, y_pred)
+        p = jnp.clip(p, eps, 1 - eps)
+        return -(t * jnp.log(p) + (1 - t) * jnp.log1p(-p))
+
+
+class CategoricalCrossentropy(Metric):
+    name = "categorical_crossentropy"
+
+    def _per_sample(self, y_true, y_pred):
+        eps = 1e-7
+        p = jnp.clip(y_pred, eps, 1.0)
+        return -(y_true * jnp.log(p)).sum(-1)
+
+
+class SparseCategoricalCrossentropy(Metric):
+    name = "sparse_categorical_crossentropy"
+
+    def _per_sample(self, y_true, y_pred):
+        eps = 1e-7
+        p = jnp.clip(y_pred, eps, 1.0)
+        idx = jnp.asarray(y_true).astype(jnp.int32)
+        return -jnp.log(jnp.take_along_axis(p, idx[..., None], axis=-1))[..., 0]
+
+
+class KLDivergence(Metric):
+    name = "kld"
+
+    def _per_sample(self, y_true, y_pred):
+        eps = 1e-7
+        t = jnp.clip(y_true, eps, 1.0)
+        p = jnp.clip(y_pred, eps, 1.0)
+        return (t * jnp.log(t / p)).sum(-1)
+
+
+class Poisson(Metric):
+    name = "poisson"
+
+    def _per_sample(self, y_true, y_pred):
+        t, p = _align(y_true, y_pred)
+        return p - t * jnp.log(p + 1e-7)
+
+
+class AUC(Metric):
+    """Streaming ROC-AUC over ``num_thresholds`` buckets
+    (ref metrics.py AUC → BigDL AUC(20 thresholds); default raised to 200)."""
+    name = "auc"
+
+    def __init__(self, num_thresholds: int = 200):
+        self.k = num_thresholds
+
+    def init_state(self):
+        z = jnp.zeros((self.k,), jnp.float32)
+        return {"tp": z, "fp": z, "pos": jnp.zeros((), jnp.float32),
+                "neg": jnp.zeros((), jnp.float32)}
+
+    def update(self, state, y_true, y_pred, mask=None):
+        y_pred = jnp.asarray(y_pred).reshape(-1)
+        y_true = (jnp.asarray(y_true).reshape(-1) > 0.5).astype(jnp.float32)
+        m = jnp.ones_like(y_true) if mask is None else jnp.asarray(mask).reshape(-1)
+        thresholds = jnp.linspace(0.0, 1.0, self.k)
+        pred_ge = (y_pred[None, :] >= thresholds[:, None]).astype(jnp.float32)
+        tp = (pred_ge * (y_true * m)[None, :]).sum(-1)
+        fp = (pred_ge * ((1 - y_true) * m)[None, :]).sum(-1)
+        return {"tp": state["tp"] + tp, "fp": state["fp"] + fp,
+                "pos": state["pos"] + (y_true * m).sum(),
+                "neg": state["neg"] + ((1 - y_true) * m).sum()}
+
+    def result(self, state):
+        tpr = np.asarray(state["tp"]) / max(float(state["pos"]), 1.0)
+        fpr = np.asarray(state["fp"]) / max(float(state["neg"]), 1.0)
+        # thresholds ascending → fpr descending; integrate |dx| * mean(y)
+        trapezoid = getattr(np, "trapezoid", None) or np.trapz  # numpy<2 compat
+        return float(np.abs(trapezoid(tpr, fpr)))
+
+
+_REGISTRY: Dict[str, type] = {
+    "accuracy": Accuracy, "acc": Accuracy,
+    "sparse_categorical_accuracy": SparseCategoricalAccuracy,
+    "categorical_accuracy": CategoricalAccuracy,
+    "binary_accuracy": BinaryAccuracy,
+    "top5": Top5Accuracy, "top5_accuracy": Top5Accuracy,
+    "mae": MAE, "mean_absolute_error": MAE,
+    "mse": MSE, "mean_squared_error": MSE,
+    "rmse": RMSE,
+    "auc": AUC,
+    "binary_crossentropy": BinaryCrossentropy,
+    "categorical_crossentropy": CategoricalCrossentropy,
+    "sparse_categorical_crossentropy": SparseCategoricalCrossentropy,
+    "kld": KLDivergence, "kullback_leibler_divergence": KLDivergence,
+    "poisson": Poisson,
+}
+
+
+def get(metric) -> Metric:
+    """Resolve a metric name or instance (ref metrics.py Metric.get)."""
+    if isinstance(metric, Metric):
+        return metric
+    if isinstance(metric, str):
+        key = metric.lower()
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown metric {metric!r}; known: {sorted(_REGISTRY)}")
+        return _REGISTRY[key]()
+    raise TypeError(f"metric must be str or Metric, got {type(metric)}")

@@ -1,0 +1,82 @@
+"""Triggers (ref ``pyzoo/zoo/orca/learn/trigger.py:19-76`` → BigDL Trigger).
+
+A trigger decides when checkpoint/validation fire, evaluated on
+``(epoch, iteration, loss)`` driver-side state.
+"""
+
+from __future__ import annotations
+
+
+class Trigger:
+    def __call__(self, epoch: int, iteration: int, loss: float) -> bool:
+        raise NotImplementedError
+
+    @staticmethod
+    def get(t):
+        if t is None or isinstance(t, Trigger):
+            return t
+        raise TypeError(f"expected Trigger, got {type(t)}")
+
+
+class EveryEpoch(Trigger):
+    """Fires at each epoch boundary (ref trigger.py:19-31): the first observed
+    epoch value arms the trigger; every subsequent epoch *change* fires."""
+
+    def __init__(self):
+        self._last_epoch = None
+
+    def __call__(self, epoch, iteration, loss):
+        fired = self._last_epoch is not None and epoch != self._last_epoch
+        self._last_epoch = epoch
+        return fired
+
+
+class SeveralIteration(Trigger):
+    """Fires every n iterations (ref trigger.py:34-49)."""
+
+    def __init__(self, interval: int):
+        assert interval > 0
+        self.interval = interval
+
+    def __call__(self, epoch, iteration, loss):
+        return iteration > 0 and iteration % self.interval == 0
+
+
+class MaxEpoch(Trigger):
+    def __init__(self, max_epoch: int):
+        self.max_epoch = max_epoch
+
+    def __call__(self, epoch, iteration, loss):
+        return epoch >= self.max_epoch
+
+
+class MaxIteration(Trigger):
+    def __init__(self, max_iteration: int):
+        self.max_iteration = max_iteration
+
+    def __call__(self, epoch, iteration, loss):
+        return iteration >= self.max_iteration
+
+
+class MinLoss(Trigger):
+    def __init__(self, min_loss: float):
+        self.min_loss = min_loss
+
+    def __call__(self, epoch, iteration, loss):
+        return loss is not None and loss < self.min_loss
+
+
+class TriggerAnd(Trigger):
+    def __init__(self, *triggers):
+        self.triggers = triggers
+
+    def __call__(self, epoch, iteration, loss):
+        return all(t(epoch, iteration, loss) for t in self.triggers)
+
+
+class TriggerOr(Trigger):
+    def __init__(self, *triggers):
+        self.triggers = triggers
+
+    def __call__(self, epoch, iteration, loss):
+        return any(t(epoch, iteration, loss) for t in self.triggers)

@@ -99,9 +99,11 @@ def mesh_axis_size(mesh, axis: str) -> int:
         zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
 
 
-def local_batch_to_global(batch, mesh, axis_name: str = DATA_AXIS):
-    """Assemble per-process host arrays into a global jax.Array sharded over
-    ``axis_name`` on the leading dimension.
+def place_on_mesh(tree, mesh, spec_fn):
+    """Place a pytree of host arrays on the mesh as global jax.Arrays.
+
+    ``spec_fn(ndarray) -> PartitionSpec`` chooses each leaf's layout. dtypes
+    are canonicalised for device (f64→f32, i64→i32; x64 stays host-side).
 
     Replaces the reference's FeatureSet→DistributedDataSet minibatch handoff
     (ref zoo/.../feature/FeatureSet.scala:109) and the Spark→Ray shard
@@ -110,13 +112,25 @@ def local_batch_to_global(batch, mesh, axis_name: str = DATA_AXIS):
     view without a central shuffle.
     """
     import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import NamedSharding
 
     def _one(x):
-        spec = P(axis_name, *([None] * (np.ndim(x) - 1)))
-        sharding = NamedSharding(mesh, spec)
+        a = np.asarray(x)
+        if a.dtype == np.float64:
+            a = a.astype(np.float32)
+        elif a.dtype == np.int64:
+            a = a.astype(np.int32)
+        sharding = NamedSharding(mesh, spec_fn(a))
         if jax.process_count() == 1:
-            return jax.device_put(x, sharding)
-        return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+            return jax.device_put(a, sharding)
+        return jax.make_array_from_process_local_data(sharding, a)
 
-    return jax.tree_util.tree_map(_one, batch)
+    return jax.tree_util.tree_map(_one, tree)
+
+
+def local_batch_to_global(batch, mesh, axis_name: str = DATA_AXIS):
+    """place_on_mesh with the default batch layout: leading dim sharded over
+    ``axis_name``, everything else replicated."""
+    from jax.sharding import PartitionSpec as P
+    return place_on_mesh(
+        batch, mesh, lambda a: P(axis_name, *([None] * (np.ndim(a) - 1))))

@@ -107,9 +107,9 @@ class ShardingStrategy:
                 return P(*spec)
         if "fsdp" in self.uses:
             size = mesh_lib.mesh_axis_size(mesh, mesh_lib.FSDP_AXIS)
-            # shard the largest divisible dim, prefer the leading one
+            # shard the largest divisible dim
             order = sorted(range(len(shape)), key=lambda i: -shape[i])
-            for i in sorted(order):
+            for i in order:
                 if shape[i] % size == 0 and shape[i] >= size:
                     spec = [None] * len(shape)
                     spec[i] = mesh_lib.FSDP_AXIS

@@ -1,0 +1,123 @@
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu import init_orca_context, OrcaContext
+from analytics_zoo_tpu.data import XShards, HostXShards
+from analytics_zoo_tpu.data.dataset import ShardedDataset, to_sharded_dataset
+import analytics_zoo_tpu.data.pandas as zoo_pandas
+
+
+@pytest.fixture
+def csv_dir(tmp_path):
+    for i in range(3):
+        df = pd.DataFrame({"a": np.arange(10) + i * 10, "b": np.arange(10) * 2.0,
+                           "label": (np.arange(10) % 2)})
+        df.to_csv(tmp_path / f"f{i}.csv", index=False)
+    return str(tmp_path)
+
+
+def test_partition_and_transform(orca_ctx):
+    x = {"x": np.arange(40).reshape(40, 1).astype(np.float32),
+         "y": np.arange(40).astype(np.int32)}
+    shards = XShards.partition(x, num_shards=4)
+    assert shards.num_partitions() == 4
+    assert len(shards) == 40
+    doubled = shards.transform_shard(lambda d: {"x": d["x"] * 2, "y": d["y"]})
+    got = np.concatenate([s["x"] for s in doubled.collect()])
+    np.testing.assert_allclose(got[:, 0], np.arange(40) * 2)
+
+
+def test_read_csv_repartition_partition_by(orca_ctx, csv_dir):
+    shards = zoo_pandas.read_csv(csv_dir)
+    assert shards.num_partitions() == 3
+    assert len(shards) == 30
+    rep = shards.repartition(5)
+    assert rep.num_partitions() == 5
+    assert len(rep) == 30
+    byp = shards.partition_by("label", num_partitions=2)
+    for df in byp.collect():
+        assert df["label"].nunique() <= 1 or set(df["label"].unique()) <= {0, 1}
+    assert sum(len(d) for d in byp.collect()) == 30
+    uniq = shards["label"].unique()
+    assert set(uniq.tolist()) == {0, 1}
+
+
+def test_shard_size_knob(orca_ctx, csv_dir):
+    OrcaContext.shard_size = 7
+    try:
+        shards = zoo_pandas.read_csv(csv_dir)
+        assert shards.num_partitions() == 5  # ceil(30/7)
+    finally:
+        OrcaContext.shard_size = None
+
+
+def test_save_load_pickle(orca_ctx, tmp_path, csv_dir):
+    shards = zoo_pandas.read_csv(csv_dir)
+    shards.save_pickle(str(tmp_path / "saved"), batchSize=2)
+    loaded = XShards.load_pickle(str(tmp_path / "saved"))
+    assert len(loaded) == 30
+
+
+def test_disk_tier(orca_ctx):
+    OrcaContext.train_data_store = "DISK_2"
+    try:
+        x = {"x": np.ones((16, 2), np.float32), "y": np.zeros(16, np.int32)}
+        shards = XShards.partition(x, num_shards=4)
+        assert shards.tier == "DISK_2"
+        assert len(shards) == 16
+        total = sum(len(s["y"]) for s in shards.collect())
+        assert total == 16
+    finally:
+        OrcaContext.train_data_store = "DRAM"
+
+
+def test_zip_split(orca_ctx):
+    a = HostXShards([np.arange(4), np.arange(4, 8)])
+    b = HostXShards([np.arange(4) * 10, np.arange(4, 8) * 10])
+    z = a.zip(b)
+    parts = z.split()
+    assert len(parts) == 2
+    np.testing.assert_array_equal(parts[1].collect()[0], np.arange(4) * 10)
+
+
+def test_sharded_dataset_batching(orca_ctx):
+    n = 35
+    ds = ShardedDataset.from_ndarrays(
+        {"u": np.arange(n, dtype=np.float32)}, np.arange(n, dtype=np.int32))
+    batches = list(ds.iter_batches(8, shuffle=True, seed=1, drop_remainder=True))
+    assert len(batches) == 4
+    assert all(b[0]["u"].shape == (8,) for b in batches)
+    # padded eval path
+    batches = list(ds.iter_batches(8, drop_remainder=False))
+    assert len(batches) == 5
+    x, y, mask = batches[-1]
+    assert x["u"].shape == (8,) and mask.sum() == 3
+    # epochs shuffle differently but cover all
+    e0 = np.concatenate([b[1] for b in ds.iter_batches(5, shuffle=True, epoch=0)])
+    e1 = np.concatenate([b[1] for b in ds.iter_batches(5, shuffle=True, epoch=1)])
+    assert not np.array_equal(e0, e1)
+    assert set(e0.tolist()) == set(range(35))
+
+
+def test_device_iterator_sharding(orca_ctx):
+    from analytics_zoo_tpu.parallel.strategy import ShardingStrategy
+    s = ShardingStrategy.parse("dp")
+    mesh = s.build_mesh()
+    ds = ShardedDataset.from_ndarrays(np.ones((64, 3), np.float32),
+                                      np.zeros(64, np.int32))
+    out = list(ds.device_iterator(mesh, s, batch_size=16))
+    assert len(out) == 4
+    x, y, mask = out[0]
+    assert x.shape == (16, 3)
+    assert "data" in str(x.sharding.spec)
+
+
+def test_from_dataframe_cols(orca_ctx):
+    df = pd.DataFrame({"f1": np.arange(10.0), "f2": np.arange(10.0) * 2,
+                       "y": np.arange(10)})
+    ds = to_sharded_dataset(df, feature_cols=["f1", "f2"], label_cols="y")
+    assert isinstance(ds.x, tuple) and len(ds.x) == 2
+    assert ds.n == 10

@@ -1,0 +1,62 @@
+"""Regression tests for review findings: clipping-after-fit, iteration
+checkpoint triggers, small-dataset padding."""
+
+import numpy as np
+
+import flax.linen as nn
+
+
+class Lin(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(1)(x)
+
+
+def _data(n=64):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    return x, (x.sum(1, keepdims=True)).astype(np.float32)
+
+
+def test_clipping_change_after_fit(orca_ctx):
+    from analytics_zoo_tpu.learn.estimator import Estimator
+    x, y = _data()
+    est = Estimator.from_flax(model=Lin(), loss="mse", sample_input=x[:2])
+    est.fit((x, y), epochs=1, batch_size=32)
+    est.set_l2_norm_gradient_clipping(1.0)  # opt_state must be rebuilt
+    est.fit((x, y), epochs=1, batch_size=32)
+    est.clear_gradient_clipping()
+    hist = est.fit((x, y), epochs=1, batch_size=32)
+    assert np.isfinite(hist["loss"][0])
+
+
+def test_several_iteration_checkpoint(orca_ctx, tmp_path):
+    from analytics_zoo_tpu.learn.estimator import Estimator
+    from analytics_zoo_tpu.learn.trigger import SeveralIteration
+    from analytics_zoo_tpu.learn import checkpoint as ckpt
+    x, y = _data(64)  # 8 steps/epoch at batch 8
+    mdir = str(tmp_path / "it")
+    est = Estimator.from_flax(model=Lin(), loss="mse", sample_input=x[:2],
+                              model_dir=mdir)
+    est.fit((x, y), epochs=1, batch_size=8,
+            checkpoint_trigger=SeveralIteration(3))
+    versions = sorted(v for _, v in [ckpt.find_latest_checkpoint(mdir)])
+    assert ckpt.find_latest_checkpoint(mdir)[1] >= 6  # fired at 3 and 6
+
+
+def test_evaluate_smaller_than_batch(orca_ctx):
+    from analytics_zoo_tpu.learn.estimator import Estimator
+    x, y = _data(20)
+    est = Estimator.from_flax(model=Lin(), loss="mse", sample_input=x[:2],
+                              metrics=["mae"])
+    res = est.evaluate((x, y), batch_size=32)  # 20 rows < batch 32
+    assert np.isfinite(res["loss"]) and np.isfinite(res["mae"])
+    preds = est.predict(x[:10], batch_size=32)
+    assert preds.shape == (10, 1)
+
+
+def test_multihost_requires_coordinator():
+    import pytest
+    from analytics_zoo_tpu import init_orca_context
+    with pytest.raises(ValueError, match="coordinator_address"):
+        init_orca_context(cluster_mode="multihost")
