@@ -47,18 +47,17 @@ class ShardedDataset:
     equal length on axis 0. ``y`` may be None (predict).
     """
 
-    def __init__(self, x, y=None, sample_weight=None):
+    def __init__(self, x, y=None):
         self.x = x
         self.y = y
-        self.sample_weight = sample_weight
         self.n = _tree_len(x)
         if y is not None:
             assert _tree_len(y) == self.n, "x/y length mismatch"
 
     # ---- constructors ----
     @classmethod
-    def from_ndarrays(cls, x, y=None, sample_weight=None) -> "ShardedDataset":
-        return cls(x, y, sample_weight)
+    def from_ndarrays(cls, x, y=None) -> "ShardedDataset":
+        return cls(x, y)
 
     @classmethod
     def from_xshards(cls, shards: XShards,
@@ -95,7 +94,7 @@ class ShardedDataset:
     # ---- transforms ----
     def map(self, fn: Callable) -> "ShardedDataset":
         x, y = fn(self.x, self.y)
-        return ShardedDataset(x, y, self.sample_weight)
+        return ShardedDataset(x, y)
 
     def take(self, n: int) -> "ShardedDataset":
         idx = np.arange(min(n, self.n))

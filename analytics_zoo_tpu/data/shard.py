@@ -166,13 +166,12 @@ class HostXShards(XShards):
     def repartition(self, num_partitions: int) -> "HostXShards":
         """Type-aware merge/split (ref shard.py:219-293: np-dict rows merged
         elementwise, DataFrames concatenated)."""
-        import pandas as pd
         shards = self.collect()
         if not shards:
             return self
-        flat_rows: List[Any]
         first = shards[0]
         if _is_dataframe(first):
+            import pandas as pd
             big = pd.concat(shards, ignore_index=False)
             idx = np.array_split(np.arange(len(big)), num_partitions)
             return HostXShards([big.iloc[i] for i in idx])

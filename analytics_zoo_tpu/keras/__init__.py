@@ -1,0 +1,3 @@
+from analytics_zoo_tpu.keras import layers  # noqa: F401
+from analytics_zoo_tpu.keras.engine import Input  # noqa: F401
+from analytics_zoo_tpu.keras.models import Sequential, Model  # noqa: F401

@@ -1,0 +1,148 @@
+"""Keras-style graph engine on flax.
+
+TPU-native rebuild of the zoo Keras API core (ref
+``zoo/src/main/scala/com/intel/analytics/zoo/pipeline/api/keras/models/Topology.scala:67-609``
+``KerasNet``/``Model``/``Sequential`` and the Python mirror
+``pyzoo/zoo/pipeline/api/keras/engine/topology.py``): users compose layer
+objects — ``Sequential().add(...)`` or the functional ``Input``/``Model``
+graph — and the engine lowers the whole graph to ONE flax module, so the
+entire model jits into a single XLA computation (no per-layer dispatch).
+
+Weight sharing follows linen semantics: calling the same layer object on two
+nodes reuses one flax submodule (ref KerasLayer sharing via node graphs).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+
+_id_counter = itertools.count()
+_name_counters: Dict[str, itertools.count] = {}
+
+
+def fresh_name(prefix: str) -> str:
+    c = _name_counters.setdefault(prefix, itertools.count(1))
+    return f"{prefix}_{next(c)}"
+
+
+class Node:
+    """One tensor in the symbolic graph."""
+
+    __slots__ = ("id", "layer", "inputs", "shape", "name")
+
+    def __init__(self, layer: Optional["KerasLayer"], inputs: List["Node"],
+                 shape: Optional[Tuple], name: str = ""):
+        self.id = next(_id_counter)
+        self.layer = layer
+        self.inputs = inputs
+        self.shape = shape  # without batch dim, may be None
+        self.name = name
+
+    # ---- autograd-style operator sugar (ref pyzoo/zoo/pipeline/api/autograd.py
+    # Variable operators: +,-,*,/ on symbolic tensors) ----
+    def __add__(self, other):
+        from analytics_zoo_tpu.keras.layers import merge_op
+        return merge_op("add")([self, _const(other, self)])
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        from analytics_zoo_tpu.keras.layers import merge_op
+        return merge_op("sub")([self, _const(other, self)])
+
+    def __mul__(self, other):
+        from analytics_zoo_tpu.keras.layers import merge_op
+        return merge_op("mul")([self, _const(other, self)])
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        from analytics_zoo_tpu.keras.layers import merge_op
+        return merge_op("div")([self, _const(other, self)])
+
+
+def _const(v, like: Node) -> Node:
+    if isinstance(v, Node):
+        return v
+    from analytics_zoo_tpu.keras.layers import Constant
+    return Constant(v)([])
+
+
+def Input(shape: Sequence[int], name: str = "") -> Node:
+    """Symbolic input (ref pyzoo keras topology Input; shape excludes batch)."""
+    return Node(None, [], tuple(shape), name or fresh_name("input"))
+
+
+class KerasLayer:
+    """Base layer: a config object that (a) can be called on Node(s) to build
+    the graph, (b) knows how to run via flax inside the graph module."""
+
+    def __init__(self, name: Optional[str] = None):
+        self._auto_named = name is None
+        self.name = name or fresh_name(type(self).__name__.lower())
+
+    # -- graph building --
+    def __call__(self, x: Union[Node, List[Node]]) -> Node:
+        inputs = x if isinstance(x, list) else [x]
+        for i in inputs:
+            assert isinstance(i, Node), f"{self.name} called on non-Node {type(i)}"
+        shape = self._infer_shape([i.shape for i in inputs])
+        return Node(self, inputs, shape)
+
+    def _infer_shape(self, in_shapes):
+        return None
+
+    # -- execution: override one of these --
+    def make_module(self) -> Optional[nn.Module]:
+        """Return a flax module if the layer has params/state, else None."""
+        return None
+
+    def apply(self, module: Optional[nn.Module], args: List[Any],
+              train: bool):
+        """Run the layer. ``module`` is the memoized flax submodule."""
+        raise NotImplementedError
+
+
+def topo_sort(outputs: List[Node]) -> List[Node]:
+    seen: Dict[int, Node] = {}
+    order: List[Node] = []
+
+    def visit(node: Node):
+        if node.id in seen:
+            return
+        seen[node.id] = node
+        for i in node.inputs:
+            visit(i)
+        order.append(node)
+
+    for o in outputs:
+        visit(o)
+    return order
+
+
+class GraphModule(nn.Module):
+    """The ONE flax module executing the whole Keras graph."""
+
+    graph_inputs: Tuple[int, ...]      # node ids
+    graph_outputs: Tuple[int, ...]
+    order: Tuple[Node, ...]            # topo order (static pytree-aux data)
+
+    @nn.compact
+    def __call__(self, *xs, train: bool = False):
+        assert len(xs) == len(self.graph_inputs), \
+            f"model takes {len(self.graph_inputs)} inputs, got {len(xs)}"
+        env: Dict[int, Any] = dict(zip(self.graph_inputs, xs))
+        modules: Dict[str, Optional[nn.Module]] = {}
+        for node in self.order:
+            if node.id in env:
+                continue
+            layer = node.layer
+            if layer.name not in modules:
+                modules[layer.name] = layer.make_module()
+            args = [env[i.id] for i in node.inputs]
+            env[node.id] = layer.apply(modules[layer.name], args, train)
+        outs = [env[i] for i in self.graph_outputs]
+        return outs[0] if len(outs) == 1 else tuple(outs)

@@ -1,0 +1,676 @@
+"""zoo-Keras layer library on flax/XLA.
+
+Rebuild of the reference's Keras-1-style layer surface
+(ref ``zoo/src/main/scala/com/intel/analytics/zoo/pipeline/api/keras/layers/``
+~120 layer files and the Python mirror
+``pyzoo/zoo/pipeline/api/keras/layers/``). Layers are config objects
+(``KerasLayer``); execution happens inside one fused ``GraphModule``
+(engine.py). Channels-last layout throughout (the TPU-friendly layout — the
+reference's "th"/"tf" dim_ordering split collapses to "tf").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.keras.engine import KerasLayer as _KerasLayerBase
+from analytics_zoo_tpu.keras.engine import Node, fresh_name
+
+
+class KerasLayer(_KerasLayerBase):
+    """Layer base that records ``input_shape`` (used when a layer opens a
+    Sequential, ref pyzoo keras layers' input_shape kwarg)."""
+
+    def __init__(self, name=None, input_shape=None):
+        super().__init__(name)
+        self.input_shape = tuple(input_shape) if input_shape is not None else None
+
+# ---------------- activations ----------------
+
+_ACTIVATIONS = {
+    "relu": nn.relu, "sigmoid": nn.sigmoid, "tanh": jnp.tanh,
+    "softmax": nn.softmax, "log_softmax": nn.log_softmax,
+    "softplus": nn.softplus, "softsign": nn.soft_sign, "gelu": nn.gelu,
+    "elu": nn.elu, "selu": nn.selu, "swish": nn.swish, "silu": nn.silu,
+    "leaky_relu": nn.leaky_relu, "relu6": lambda x: jnp.clip(x, 0, 6),
+    "hard_sigmoid": lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
+    "linear": lambda x: x, "identity": lambda x: x, None: lambda x: x,
+}
+
+
+def get_activation(act):
+    if callable(act):
+        return act
+    if act in _ACTIVATIONS:
+        return _ACTIVATIONS[act]
+    raise ValueError(f"unknown activation {act!r}")
+
+
+# ---------------- init helpers (ref keras init strings) ----------------
+
+def get_init(init: str):
+    table = {
+        "glorot_uniform": nn.initializers.glorot_uniform(),
+        "glorot_normal": nn.initializers.glorot_normal(),
+        "he_normal": nn.initializers.he_normal(),
+        "he_uniform": nn.initializers.he_uniform(),
+        "lecun_normal": nn.initializers.lecun_normal(),
+        "normal": nn.initializers.normal(0.05),
+        "uniform": nn.initializers.uniform(0.05),
+        "zero": nn.initializers.zeros, "zeros": nn.initializers.zeros,
+        "one": nn.initializers.ones, "ones": nn.initializers.ones,
+    }
+    if callable(init):
+        return init
+    if init in table:
+        return table[init]
+    raise ValueError(f"unknown init {init!r}")
+
+
+# ---------------- core layers ----------------
+
+class Dense(KerasLayer):
+    """(ref keras/layers/core.py Dense / Scala Dense.scala)"""
+
+    def __init__(self, output_dim: int, activation=None, init="glorot_uniform",
+                 bias: bool = True, W_regularizer=None, b_regularizer=None,
+                 input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.output_dim = output_dim
+        self.activation = get_activation(activation)
+        self.init = get_init(init)
+        self.bias = bias
+        self.input_shape = input_shape
+
+    def make_module(self):
+        return nn.Dense(self.output_dim, use_bias=self.bias,
+                        kernel_init=self.init, name=self.name)
+
+    def apply(self, module, args, train):
+        return self.activation(module(args[0]))
+
+    def _infer_shape(self, in_shapes):
+        s = in_shapes[0]
+        return (s[:-1] + (self.output_dim,)) if s else None
+
+
+class Activation(KerasLayer):
+    def __init__(self, activation, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.fn = get_activation(activation)
+
+    def apply(self, module, args, train):
+        return self.fn(args[0])
+
+    def _infer_shape(self, in_shapes):
+        return in_shapes[0]
+
+
+class Dropout(KerasLayer):
+    def __init__(self, p: float, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.p = p
+
+    def make_module(self):
+        return nn.Dropout(rate=self.p, name=self.name)
+
+    def apply(self, module, args, train):
+        return module(args[0], deterministic=not train)
+
+    def _infer_shape(self, in_shapes):
+        return in_shapes[0]
+
+
+class Flatten(KerasLayer):
+    def apply(self, module, args, train):
+        x = args[0]
+        return x.reshape(x.shape[0], -1)
+
+    def _infer_shape(self, in_shapes):
+        s = in_shapes[0]
+        return (int(np.prod(s)),) if s else None
+
+
+class Reshape(KerasLayer):
+    def __init__(self, target_shape, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.target_shape = tuple(target_shape)
+
+    def apply(self, module, args, train):
+        x = args[0]
+        return x.reshape((x.shape[0],) + self.target_shape)
+
+    def _infer_shape(self, in_shapes):
+        return self.target_shape
+
+
+class Permute(KerasLayer):
+    def __init__(self, dims, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.dims = tuple(dims)  # 1-based over non-batch dims (keras conv.)
+
+    def apply(self, module, args, train):
+        return jnp.transpose(args[0], (0,) + self.dims)
+
+
+class RepeatVector(KerasLayer):
+    def __init__(self, n: int, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.n = n
+
+    def apply(self, module, args, train):
+        return jnp.repeat(args[0][:, None, :], self.n, axis=1)
+
+    def _infer_shape(self, in_shapes):
+        s = in_shapes[0]
+        return (self.n,) + tuple(s) if s else None
+
+
+class Squeeze(KerasLayer):
+    def __init__(self, dim: int, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.dim = dim
+
+    def apply(self, module, args, train):
+        return jnp.squeeze(args[0], axis=self.dim)
+
+
+class ExpandDim(KerasLayer):
+    def __init__(self, dim: int, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.dim = dim
+
+    def apply(self, module, args, train):
+        return jnp.expand_dims(args[0], axis=self.dim)
+
+
+class Select(KerasLayer):
+    """Select one index along a dim (ref Scala Select.scala)."""
+
+    def __init__(self, dim: int, index: int, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.dim, self.index = dim, index
+
+    def apply(self, module, args, train):
+        return jnp.take(args[0], self.index, axis=self.dim)
+
+
+class Narrow(KerasLayer):
+    """Slice length elements from offset along dim (ref Narrow.scala)."""
+
+    def __init__(self, dim: int, offset: int, length: int = 1,
+                 input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.dim, self.offset, self.length = dim, offset, length
+
+    def apply(self, module, args, train):
+        return jax.lax.slice_in_dim(args[0], self.offset,
+                                    self.offset + self.length, axis=self.dim)
+
+
+class Lambda(KerasLayer):
+    """Wrap an arbitrary jax function (ref autograd.py Lambda:393)."""
+
+    def __init__(self, function: Callable, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.function = function
+
+    def apply(self, module, args, train):
+        return self.function(*args)
+
+
+class Constant(KerasLayer):
+    def __init__(self, value, name=None):
+        super().__init__(name)
+        self.value = value
+
+    def apply(self, module, args, train):
+        return jnp.asarray(self.value)
+
+
+class Masking(KerasLayer):
+    def __init__(self, mask_value: float = 0.0, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.mask_value = mask_value
+
+    def apply(self, module, args, train):
+        x = args[0]
+        keep = jnp.any(x != self.mask_value, axis=-1, keepdims=True)
+        return x * keep
+
+
+# ---------------- embeddings ----------------
+
+class Embedding(KerasLayer):
+    """(ref keras/layers/embeddings.py; Scala Embedding.scala). On TPU the
+    lookup lowers to a one-hot matmul/gather on the MXU; the table can be
+    model-parallel via param_rules matching 'embedding'."""
+
+    def __init__(self, input_dim: int, output_dim: int, init="uniform",
+                 input_length=None, input_shape=None, name=None,
+                 zero_based_id: bool = True):
+        super().__init__(name, input_shape)
+        self.input_dim, self.output_dim = input_dim, output_dim
+        self.init = get_init(init)
+        self.zero_based_id = zero_based_id
+
+    def make_module(self):
+        return nn.Embed(self.input_dim, self.output_dim,
+                        embedding_init=self.init, name=self.name)
+
+    def apply(self, module, args, train):
+        ids = args[0].astype(jnp.int32)
+        if not self.zero_based_id:
+            ids = ids - 1  # ref WordEmbedding 1-based vocab ids
+        return module(ids)
+
+    def _infer_shape(self, in_shapes):
+        s = in_shapes[0]
+        return tuple(s) + (self.output_dim,) if s is not None else None
+
+
+# ---------------- normalization ----------------
+
+class BatchNormalization(KerasLayer):
+    def __init__(self, epsilon: float = 1e-3, momentum: float = 0.99,
+                 input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.epsilon, self.momentum = epsilon, momentum
+
+    def make_module(self):
+        return nn.BatchNorm(use_running_average=None, momentum=self.momentum,
+                            epsilon=self.epsilon, name=self.name,
+                            axis_name=None)
+
+    def apply(self, module, args, train):
+        return module(args[0], use_running_average=not train)
+
+
+class LayerNormalization(KerasLayer):
+    def __init__(self, epsilon: float = 1e-6, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.epsilon = epsilon
+
+    def make_module(self):
+        return nn.LayerNorm(epsilon=self.epsilon, name=self.name)
+
+    def apply(self, module, args, train):
+        return module(args[0])
+
+
+# ---------------- convolutions / pooling ----------------
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+class Conv1D(KerasLayer):
+    """(ref Convolution1D) input [batch, steps, channels]."""
+
+    def __init__(self, nb_filter: int, filter_length: int, activation=None,
+                 border_mode: str = "valid", subsample_length: int = 1,
+                 init="glorot_uniform", bias: bool = True, dilation_rate: int = 1,
+                 input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.nb_filter, self.filter_length = nb_filter, filter_length
+        self.activation = get_activation(activation)
+        self.padding = border_mode.upper()
+        self.stride = subsample_length
+        self.init = get_init(init)
+        self.bias = bias
+        self.dilation = dilation_rate
+
+    def make_module(self):
+        return nn.Conv(self.nb_filter, (self.filter_length,),
+                       strides=(self.stride,), padding=self.padding,
+                       kernel_dilation=(self.dilation,), use_bias=self.bias,
+                       kernel_init=self.init, name=self.name)
+
+    def apply(self, module, args, train):
+        return self.activation(module(args[0]))
+
+
+Convolution1D = Conv1D
+
+
+class Conv2D(KerasLayer):
+    """(ref Convolution2D) input [batch, h, w, channels] (channels-last)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, border_mode: str = "valid",
+                 subsample=(1, 1), init="glorot_uniform", bias: bool = True,
+                 input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.nb_filter = nb_filter
+        self.kernel = (nb_row, nb_col)
+        self.activation = get_activation(activation)
+        self.padding = border_mode.upper()
+        self.strides = _pair(subsample)
+        self.init = get_init(init)
+        self.bias = bias
+
+    def make_module(self):
+        return nn.Conv(self.nb_filter, self.kernel, strides=self.strides,
+                       padding=self.padding, use_bias=self.bias,
+                       kernel_init=self.init, name=self.name)
+
+    def apply(self, module, args, train):
+        return self.activation(module(args[0]))
+
+
+Convolution2D = Conv2D
+
+
+class SeparableConv2D(KerasLayer):
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, border_mode="valid", subsample=(1, 1),
+                 input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.nb_filter, self.kernel = nb_filter, (nb_row, nb_col)
+        self.activation = get_activation(activation)
+        self.padding = border_mode.upper()
+        self.strides = _pair(subsample)
+
+    def make_module(self):
+        # depthwise (feature_group_count) + pointwise
+        class _Sep(nn.Module):
+            nb_filter: int
+            kernel: tuple
+            strides: tuple
+            padding: str
+
+            @nn.compact
+            def __call__(self, x):
+                c = x.shape[-1]
+                x = nn.Conv(c, self.kernel, strides=self.strides,
+                            padding=self.padding, feature_group_count=c,
+                            name="depthwise")(x)
+                return nn.Conv(self.nb_filter, (1, 1), name="pointwise")(x)
+
+        return _Sep(self.nb_filter, self.kernel, self.strides, self.padding,
+                    name=self.name)
+
+    def apply(self, module, args, train):
+        return self.activation(module(args[0]))
+
+
+class _Pool(KerasLayer):
+    reducer = None
+    init_val = None
+
+    def __init__(self, pool_size, strides=None, border_mode="valid",
+                 input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.pool_size = pool_size
+        self.strides = strides or pool_size
+        self.padding = border_mode.upper()
+
+
+class MaxPooling1D(_Pool):
+    def __init__(self, pool_length: int = 2, stride=None, border_mode="valid",
+                 input_shape=None, name=None):
+        super().__init__((pool_length,), (stride or pool_length,),
+                         border_mode, input_shape=input_shape, name=name)
+
+    def apply(self, module, args, train):
+        return nn.max_pool(args[0], self.pool_size, self.strides, self.padding)
+
+
+class AveragePooling1D(MaxPooling1D):
+    def apply(self, module, args, train):
+        return nn.avg_pool(args[0], self.pool_size, self.strides, self.padding)
+
+
+class MaxPooling2D(_Pool):
+    def __init__(self, pool_size=(2, 2), strides=None, border_mode="valid",
+                 input_shape=None, name=None):
+        super().__init__(_pair(pool_size), _pair(strides or pool_size),
+                         border_mode, input_shape=input_shape, name=name)
+
+    def apply(self, module, args, train):
+        return nn.max_pool(args[0], self.pool_size, self.strides, self.padding)
+
+
+class AveragePooling2D(MaxPooling2D):
+    def apply(self, module, args, train):
+        return nn.avg_pool(args[0], self.pool_size, self.strides, self.padding)
+
+
+class GlobalMaxPooling1D(KerasLayer):
+    def apply(self, module, args, train):
+        return jnp.max(args[0], axis=1)
+
+
+class GlobalAveragePooling1D(KerasLayer):
+    def apply(self, module, args, train):
+        return jnp.mean(args[0], axis=1)
+
+
+class GlobalMaxPooling2D(KerasLayer):
+    def apply(self, module, args, train):
+        return jnp.max(args[0], axis=(1, 2))
+
+
+class GlobalAveragePooling2D(KerasLayer):
+    def apply(self, module, args, train):
+        return jnp.mean(args[0], axis=(1, 2))
+
+
+class ZeroPadding1D(KerasLayer):
+    def __init__(self, padding: int = 1, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.padding = _pair(padding) if not isinstance(padding, int) else (padding, padding)
+
+    def apply(self, module, args, train):
+        return jnp.pad(args[0], ((0, 0), self.padding, (0, 0)))
+
+
+class ZeroPadding2D(KerasLayer):
+    def __init__(self, padding=(1, 1), input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.padding = _pair(padding)
+
+    def apply(self, module, args, train):
+        p = self.padding
+        return jnp.pad(args[0], ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0)))
+
+
+class UpSampling2D(KerasLayer):
+    def __init__(self, size=(2, 2), input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.size = _pair(size)
+
+    def apply(self, module, args, train):
+        x = args[0]
+        x = jnp.repeat(x, self.size[0], axis=1)
+        return jnp.repeat(x, self.size[1], axis=2)
+
+
+# ---------------- recurrent ----------------
+
+class _RNNBase(KerasLayer):
+    cell_cls = None
+
+    def __init__(self, output_dim: int, activation="tanh",
+                 return_sequences: bool = False, go_backwards: bool = False,
+                 input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.output_dim = output_dim
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+
+    def make_module(self):
+        return nn.RNN(self.cell_cls(features=self.output_dim),
+                      reverse=self.go_backwards, name=self.name)
+
+    def apply(self, module, args, train):
+        out = module(args[0])
+        return out if self.return_sequences else out[:, -1, :]
+
+    def _infer_shape(self, in_shapes):
+        s = in_shapes[0]
+        if s is None:
+            return None
+        return (s[0], self.output_dim) if self.return_sequences else (self.output_dim,)
+
+
+class LSTM(_RNNBase):
+    """(ref keras/layers/recurrent LSTM; lowers to lax.scan over an
+    OptimizedLSTMCell — XLA fuses the gates into MXU matmuls)."""
+    cell_cls = nn.OptimizedLSTMCell
+
+
+class GRU(_RNNBase):
+    cell_cls = nn.GRUCell
+
+
+class SimpleRNN(_RNNBase):
+    cell_cls = nn.SimpleCell
+
+
+class Bidirectional(KerasLayer):
+    """(ref keras Bidirectional wrapper)"""
+
+    def __init__(self, layer: _RNNBase, merge_mode: str = "concat", name=None):
+        super().__init__(name)
+        self.layer = layer
+        self.merge_mode = merge_mode
+
+    def make_module(self):
+        class _BiDi(nn.Module):
+            cell_cls: Any
+            features: int
+            ret_seq: bool
+
+            @nn.compact
+            def __call__(self, x):
+                fwd = nn.RNN(self.cell_cls(features=self.features),
+                             name="forward")(x)
+                bwd = nn.RNN(self.cell_cls(features=self.features),
+                             reverse=True, keep_order=True, name="backward")(x)
+                return fwd, bwd
+
+        return _BiDi(self.layer.cell_cls, self.layer.output_dim,
+                     self.layer.return_sequences, name=self.name)
+
+    def apply(self, module, args, train):
+        fwd, bwd = module(args[0])
+        if not self.layer.return_sequences:
+            fwd, bwd = fwd[:, -1, :], bwd[:, 0, :]
+        if self.merge_mode == "concat":
+            return jnp.concatenate([fwd, bwd], axis=-1)
+        if self.merge_mode == "sum":
+            return fwd + bwd
+        if self.merge_mode == "mul":
+            return fwd * bwd
+        if self.merge_mode == "ave":
+            return (fwd + bwd) / 2
+        raise ValueError(f"bad merge_mode {self.merge_mode}")
+
+
+# ---------------- attention / transformer ----------------
+
+class MultiHeadAttention(KerasLayer):
+    """Dot-product multi-head attention (ref pyzoo self_attention.py /
+    Scala TransformerLayer.scala:56). Uses the fused attention op from
+    ops/attention.py (pallas flash attention on TPU)."""
+
+    def __init__(self, num_heads: int, head_dim: int, dropout: float = 0.0,
+                 causal: bool = False, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.num_heads, self.head_dim = num_heads, head_dim
+        self.dropout, self.causal = dropout, causal
+
+    def make_module(self):
+        from analytics_zoo_tpu.ops.attention import AttentionModule
+        return AttentionModule(num_heads=self.num_heads,
+                               head_dim=self.head_dim,
+                               dropout=self.dropout, causal=self.causal,
+                               name=self.name)
+
+    def apply(self, module, args, train):
+        q = args[0]
+        kv = args[1] if len(args) > 1 else q
+        mask = args[2] if len(args) > 2 else None
+        return module(q, kv, mask=mask, train=train)
+
+
+# ---------------- merge ----------------
+
+class Merge(KerasLayer):
+    """(ref keras/layers Merge mode=sum/mul/concat/ave/dot/max...)"""
+
+    def __init__(self, layers=None, mode: str = "sum", concat_axis: int = -1,
+                 input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.mode = mode
+        self.concat_axis = concat_axis
+
+    def apply(self, module, args, train):
+        m = self.mode
+        if m in ("sum", "add"):
+            out = args[0]
+            for a in args[1:]:
+                out = out + a
+            return out
+        if m == "sub":
+            return args[0] - args[1]
+        if m == "mul":
+            out = args[0]
+            for a in args[1:]:
+                out = out * a
+            return out
+        if m == "div":
+            return args[0] / args[1]
+        if m in ("ave", "avg"):
+            return sum(args) / len(args)
+        if m == "max":
+            return jnp.stack(args).max(0)
+        if m == "min":
+            return jnp.stack(args).min(0)
+        if m == "concat":
+            return jnp.concatenate(args, axis=self.concat_axis)
+        if m == "dot":
+            return jnp.sum(args[0] * args[1], axis=-1, keepdims=True)
+        if m == "cos":
+            a = args[0] / jnp.linalg.norm(args[0], axis=-1, keepdims=True)
+            b = args[1] / jnp.linalg.norm(args[1], axis=-1, keepdims=True)
+            return jnp.sum(a * b, axis=-1, keepdims=True)
+        raise ValueError(f"unknown merge mode {m!r}")
+
+
+def merge_op(mode: str, concat_axis: int = -1) -> Merge:
+    return Merge(mode=mode, concat_axis=concat_axis)
+
+
+def merge(inputs: List[Node], mode: str = "sum", concat_axis: int = -1) -> Node:
+    """Functional merge (ref pyzoo keras merge())."""
+    return Merge(mode=mode, concat_axis=concat_axis)(inputs)
+
+
+class TimeDistributed(KerasLayer):
+    """Apply a layer to every time step (ref keras TimeDistributed)."""
+
+    def __init__(self, layer: KerasLayer, name=None):
+        super().__init__(name)
+        self.layer = layer
+
+    def make_module(self):
+        return self.layer.make_module()
+
+    def apply(self, module, args, train):
+        x = args[0]
+        b, t = x.shape[0], x.shape[1]
+        flat = x.reshape((b * t,) + x.shape[2:])
+        out = self.layer.apply(module, [flat], train)
+        return out.reshape((b, t) + out.shape[1:])
+
+
+class GetShape(KerasLayer):
+    def apply(self, module, args, train):
+        return jnp.asarray(args[0].shape)

@@ -1,0 +1,252 @@
+"""Sequential / Model topologies with compile/fit/evaluate/predict.
+
+Ref: ``zoo/.../pipeline/api/keras/models/Topology.scala:67-609`` (KerasNet:
+``compile:139``, ``fit:347``, ``evaluate``, ``predict``, ``Model:631``,
+``Sequential:854``) and the Python mirror
+``pyzoo/zoo/pipeline/api/keras/models.py``. Training delegates to the
+JaxEstimator engine — one jitted sharded train step instead of the
+reference's InternalDistriOptimizer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from analytics_zoo_tpu.keras.engine import (GraphModule, Input, KerasLayer,
+                                            Node, topo_sort)
+
+
+class KerasNet:
+    """Shared compile/fit surface (ref Topology.scala KerasNet)."""
+
+    def __init__(self):
+        self._estimator = None
+        self._compile_args = None
+        self._strategy = "dp"
+        self._param_rules = None
+        self.model_dir = None
+
+    # -- to be provided by subclass --
+    def _graph(self) -> Tuple[List[Node], List[Node]]:
+        raise NotImplementedError
+
+    def input_shapes(self) -> List[Tuple]:
+        inputs, _ = self._graph()
+        shapes = [n.shape for n in inputs]
+        assert all(s is not None for s in shapes), \
+            "input shapes unknown; give input_shape to the first layer or use Input()"
+        return shapes
+
+    def to_flax(self) -> GraphModule:
+        inputs, outputs = self._graph()
+        order = tuple(topo_sort(outputs))
+        self._canonicalize_names(order)
+        return GraphModule(graph_inputs=tuple(n.id for n in inputs),
+                           graph_outputs=tuple(n.id for n in outputs),
+                           order=order)
+
+    @staticmethod
+    def _canonicalize_names(order):
+        """Auto-generated layer names are rewritten to a deterministic
+        per-model scheme (type_index in topo order) so two builds of the same
+        architecture produce identical parameter trees — required for
+        checkpoint/save_model round-trips across processes."""
+        counters: dict = {}
+        seen: set = set()
+        for node in order:
+            layer = node.layer
+            if layer is None or id(layer) in seen:
+                continue
+            seen.add(id(layer))
+            if getattr(layer, "_auto_named", False):
+                prefix = type(layer).__name__.lower()
+                counters[prefix] = counters.get(prefix, 0) + 1
+                layer.name = f"{prefix}_{counters[prefix]}"
+
+    def sample_input(self, batch: int = 2):
+        shapes = self.input_shapes()
+        arrs = tuple(np.zeros((batch,) + tuple(s), np.float32) for s in shapes)
+        return arrs[0] if len(arrs) == 1 else arrs
+
+    # -- reference API --
+    def set_strategy(self, strategy: str, param_rules=None):
+        """TPU extension: parallelism for this model ("dp", "dp2,tp4"...)."""
+        self._strategy = strategy
+        self._param_rules = param_rules
+        self._estimator = None
+        return self
+
+    def compile(self, optimizer, loss, metrics: Optional[List] = None):
+        """(ref Topology.scala compile:139). Compiling after weights were
+        loaded (or after a placeholder inference estimator was built) keeps
+        the existing parameters."""
+        self._compile_args = dict(optimizer=optimizer, loss=loss,
+                                  metrics=metrics)
+        if self._estimator is not None:
+            self._reuse_adapter = self._estimator.adapter
+        self._estimator = None
+        return self
+
+    def set_tensorboard(self, log_dir: str, app_name: str):
+        self._ensure_estimator().set_tensorboard(log_dir, app_name)
+
+    def set_checkpoint(self, path: str):
+        self._ensure_estimator().model_dir = path
+
+    def set_constant_gradient_clipping(self, min_value, max_value):
+        self._ensure_estimator().set_constant_gradient_clipping(min_value, max_value)
+
+    def set_gradient_clipping_by_l2_norm(self, clip_norm):
+        self._ensure_estimator().set_l2_norm_gradient_clipping(clip_norm)
+
+    def _ensure_estimator(self, for_training: bool = False):
+        if self._estimator is None:
+            args = self._compile_args
+            if args is None:
+                # inference/weights-only use (predict, load_weights) is legal
+                # before compile (ref KerasNet.predict works uncompiled)
+                assert not for_training, \
+                    "call compile(optimizer, loss) before fit/evaluate"
+                args = dict(optimizer="adam", loss="mse", metrics=None)
+            from analytics_zoo_tpu.learn.estimator import Estimator
+            self._estimator = Estimator.from_flax(
+                model=self.to_flax(),
+                loss=args["loss"],
+                optimizer=args["optimizer"],
+                metrics=args["metrics"],
+                sample_input=self.sample_input(),
+                model_dir=self.model_dir,
+                strategy=self._strategy,
+                param_rules=self._param_rules)
+            reuse = getattr(self, "_reuse_adapter", None)
+            if reuse is not None:
+                self._estimator.adapter.params = reuse.params
+                self._estimator.adapter.model_state = reuse.model_state
+                self._reuse_adapter = None
+        return self._estimator
+
+    @property
+    def estimator(self):
+        return self._ensure_estimator()
+
+    @staticmethod
+    def _as_x(x):
+        return tuple(x) if isinstance(x, (list, tuple)) else x
+
+    def fit(self, x, y=None, batch_size: int = 32, nb_epoch: int = 1,
+            validation_data=None, distributed: bool = True, shuffle=True,
+            feature_cols=None, label_cols=None, **kwargs):
+        """(ref Topology.scala fit:347; py keras fit(x, y, batch_size,
+        nb_epoch, validation_data))"""
+        est = self._ensure_estimator(for_training=True)
+        data = self._as_x(x) if y is None else (self._as_x(x), y)
+        if validation_data is not None and isinstance(validation_data, tuple) \
+                and len(validation_data) == 2:
+            validation_data = (self._as_x(validation_data[0]), validation_data[1])
+        return est.fit(data, epochs=nb_epoch, batch_size=batch_size,
+                       validation_data=validation_data, shuffle=shuffle,
+                       feature_cols=feature_cols, label_cols=label_cols,
+                       **kwargs)
+
+    def evaluate(self, x, y=None, batch_size: int = 32, **kwargs):
+        est = self._ensure_estimator(for_training=True)
+        data = self._as_x(x) if y is None else (self._as_x(x), y)
+        return est.evaluate(data, batch_size=batch_size, **kwargs)
+
+    def predict(self, x, batch_size: int = 256, distributed: bool = True):
+        return self._ensure_estimator().predict(self._as_x(x),
+                                                batch_size=batch_size)
+
+    def predict_classes(self, x, batch_size: int = 256,
+                        zero_based_label: bool = True):
+        """(ref pyzoo keras predict_classes)"""
+        probs = self.predict(x, batch_size=batch_size)
+        classes = np.argmax(np.asarray(probs), axis=-1)
+        return classes if zero_based_label else classes + 1
+
+    # -- persistence --
+    def save_weights(self, path: str):
+        self._ensure_estimator().save(path)
+
+    def load_weights(self, path: str):
+        self._ensure_estimator().load(path)
+
+    def get_weights(self):
+        return self._ensure_estimator().get_model()
+
+    # -- introspection --
+    def summary(self):
+        """(ref Topology.scala summary / KerasNet.summary)"""
+        import jax
+        module = self.to_flax()
+        sample = self.sample_input()
+        args = sample if isinstance(sample, tuple) else (sample,)
+        shapes = jax.eval_shape(
+            lambda *a: module.init(jax.random.PRNGKey(0), *a), *args)
+        total = 0
+        lines = ["_" * 64]
+        lines.append(f"{'Layer (type)':<34}{'Param #':>12}")
+        lines.append("=" * 64)
+        params = shapes.get("params", {}) if isinstance(shapes, dict) else {}
+        for name, tree in params.items():
+            n = sum(int(np.prod(l.shape))
+                    for l in jax.tree_util.tree_leaves(tree))
+            total += n
+            lines.append(f"{name:<34}{n:>12,}")
+        lines.append("=" * 64)
+        lines.append(f"Total params: {total:,}")
+        text = "\n".join(lines)
+        print(text)
+        return text
+
+
+class Sequential(KerasNet):
+    """(ref Topology.scala Sequential:854; py Sequential().add(...))"""
+
+    def __init__(self):
+        super().__init__()
+        self.layers: List[KerasLayer] = []
+        self._built: Optional[Tuple[List[Node], List[Node]]] = None
+
+    def add(self, layer: KerasLayer) -> "Sequential":
+        assert isinstance(layer, (KerasLayer, KerasNet)), \
+            f"cannot add {type(layer)}"
+        self.layers.append(layer)
+        self._built = None
+        self._estimator = None
+        return self
+
+    def _graph(self):
+        if self._built is None:
+            assert self.layers, "empty Sequential"
+            first = self.layers[0]
+            in_shape = getattr(first, "input_shape", None)
+            assert in_shape is not None, \
+                "first layer of a Sequential needs input_shape=..."
+            node = Input(shape=in_shape)
+            inputs = [node]
+            for layer in self.layers:
+                if isinstance(layer, KerasNet):  # nested model
+                    sub_in, sub_out = layer._graph()
+                    raise NotImplementedError(
+                        "nesting models inside Sequential is not supported yet")
+                node = layer(node)
+            self._built = (inputs, [node])
+        return self._built
+
+
+class Model(KerasNet):
+    """Functional graph model (ref Topology.scala Model:631;
+    py Model(input=..., output=...))."""
+
+    def __init__(self, input, output, **kwargs):
+        super().__init__()
+        self._inputs = input if isinstance(input, (list, tuple)) else [input]
+        self._outputs = output if isinstance(output, (list, tuple)) else [output]
+        for n in list(self._inputs) + list(self._outputs):
+            assert isinstance(n, Node), "Model(input=, output=) takes Input()/layer nodes"
+
+    def _graph(self):
+        return list(self._inputs), list(self._outputs)

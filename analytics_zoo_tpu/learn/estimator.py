@@ -195,6 +195,10 @@ class JaxEstimator:
     def set_tensorboard(self, log_dir: str, app_name: str):
         self._tb_dirs = (os.path.join(log_dir, app_name, "train"),
                          os.path.join(log_dir, app_name, "validation"))
+        if self._train_writer is not None:  # redirect future events
+            self._train_writer.close()
+            self._val_writer.close()
+            self._train_writer = self._val_writer = None
 
     def _writers(self):
         from analytics_zoo_tpu.common.summary import SummaryWriter
@@ -273,7 +277,10 @@ class JaxEstimator:
 
         def spec_for(path_str, leaf):
             for p, spec in param_specs.items():
-                if path_str.endswith(p) and np.shape(leaf) and \
+                # '/'-boundary suffix match so 'q_proj/kernel' never matches
+                # a rule for 'proj/kernel'
+                if (path_str == p or path_str.endswith("/" + p)) \
+                        and np.shape(leaf) and \
                         tuple(np.shape(leaf)) == tuple(np.shape(_get_by_path(
                             state["params"], p))):
                     return spec
@@ -425,7 +432,7 @@ class JaxEstimator:
         if (self.adapter.n_inputs == 1 and isinstance(ds.x, tuple)
                 and all(np.ndim(a) == 1 for a in ds.x)):
             x = np.column_stack([np.asarray(a) for a in ds.x])
-            return ShardedDataset(x, ds.y, ds.sample_weight)
+            return ShardedDataset(x, ds.y)
         return ds
 
     def _iteration(self) -> int:
@@ -509,7 +516,13 @@ class JaxEstimator:
         XShards, ndarray otherwise)"""
         import jax
         was_shards = isinstance(data, XShards)
+        if isinstance(data, tuple):
+            # predict takes features only — a tuple is a multi-input x, not
+            # an (x, y) pair
+            data = {"x": data}
         ds = self._coerce(to_sharded_dataset(data, feature_cols, None))
+        if ds.n == 0:
+            raise ValueError("predict called on an empty dataset")
         mesh = self._ensure_mesh()
         self._init_state()
         self._build_predict()

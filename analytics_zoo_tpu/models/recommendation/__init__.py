@@ -1,0 +1,13 @@
+from analytics_zoo_tpu.models.recommendation.recommender import (  # noqa: F401
+    Recommender,
+    UserItemFeature,
+    UserItemPrediction,
+)
+from analytics_zoo_tpu.models.recommendation.neuralcf import NeuralCF  # noqa: F401
+from analytics_zoo_tpu.models.recommendation.wide_and_deep import (  # noqa: F401
+    ColumnFeatureInfo,
+    WideAndDeep,
+)
+from analytics_zoo_tpu.models.recommendation.session_recommender import (  # noqa: F401
+    SessionRecommender,
+)

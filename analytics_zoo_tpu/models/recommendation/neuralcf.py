@@ -1,0 +1,76 @@
+"""NeuralCF — neural collaborative filtering.
+
+Ref: ``pyzoo/zoo/models/recommendation/neuralcf.py:30-117`` and Scala
+``zoo/.../models/recommendation/NeuralCF.scala``. Same architecture (MLP tower
+over user/item embeddings, optional GMF branch, softmax head), same input
+convention (one [batch, 2] tensor of [user_id, item_id], 1-based ids), rebuilt
+on the TPU keras engine: embedding lookups + the MLP fuse into a single XLA
+computation, and the embedding tables can be model-parallel via
+``tp_param_rules()``.
+"""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.keras import Input, Model
+from analytics_zoo_tpu.keras import layers as zl
+from analytics_zoo_tpu.models.common import ZooModel, registry
+from analytics_zoo_tpu.models.recommendation.recommender import Recommender
+
+
+@registry.register
+class NeuralCF(Recommender):
+    """(ref neuralcf.py:45: user_count, item_count, class_num, user_embed,
+    item_embed, hidden_layers, include_mf, mf_embed)"""
+
+    def __init__(self, user_count, item_count, class_num, user_embed=20,
+                 item_embed=20, hidden_layers=(40, 20, 10), include_mf=True,
+                 mf_embed=20):
+        super().__init__()
+        self.user_count = int(user_count)
+        self.item_count = int(item_count)
+        self.class_num = int(class_num)
+        self.user_embed = int(user_embed)
+        self.item_embed = int(item_embed)
+        self.hidden_layers = [int(u) for u in hidden_layers]
+        self.include_mf = include_mf
+        self.mf_embed = int(mf_embed)
+        self.model = self.build_model()
+
+    def build_model(self):
+        # (ref neuralcf.py:70-96 build_model, layer-for-layer)
+        inp = Input(shape=(2,))
+        user = zl.Select(1, 0)(inp)   # [batch] user ids
+        item = zl.Select(1, 1)(inp)
+        mlp_user = zl.Embedding(self.user_count + 1, self.user_embed,
+                                init="uniform", name="mlp_user_embed")(user)
+        mlp_item = zl.Embedding(self.item_count + 1, self.item_embed,
+                                init="uniform", name="mlp_item_embed")(item)
+        latent = zl.merge([mlp_user, mlp_item], mode="concat")
+        linear = zl.Dense(self.hidden_layers[0], activation="relu")(latent)
+        for units in self.hidden_layers[1:]:
+            linear = zl.Dense(units, activation="relu")(linear)
+        if self.include_mf:
+            assert self.mf_embed > 0
+            mf_user = zl.Embedding(self.user_count + 1, self.mf_embed,
+                                   init="uniform", name="mf_user_embed")(user)
+            mf_item = zl.Embedding(self.item_count + 1, self.mf_embed,
+                                   init="uniform", name="mf_item_embed")(item)
+            mf_latent = zl.merge([mf_user, mf_item], mode="mul")
+            concated = zl.merge([linear, mf_latent], mode="concat")
+            out = zl.Dense(self.class_num, activation="softmax")(concated)
+        else:
+            out = zl.Dense(self.class_num, activation="softmax")(linear)
+        return Model(input=inp, output=out)
+
+    @staticmethod
+    def tp_param_rules():
+        """Tensor-parallel layout: shard embedding tables + first dense over
+        the model axis (new capability vs reference)."""
+        return [(r"embed.*/embedding$", (None, "model")),
+                (r"dense_\d+/kernel$", (None, "model"))]
+
+    def _config(self):
+        return dict(user_count=self.user_count, item_count=self.item_count,
+                    class_num=self.class_num, user_embed=self.user_embed,
+                    item_embed=self.item_embed, hidden_layers=self.hidden_layers,
+                    include_mf=self.include_mf, mf_embed=self.mf_embed)

@@ -1,0 +1,81 @@
+"""Recommender base + user/item feature types.
+
+Ref: ``pyzoo/zoo/models/recommendation/__init__.py`` (UserItemFeature,
+UserItemPrediction, Recommender with ``predict_user_item_pair``,
+``recommend_for_user``, ``recommend_for_item``) and Scala
+``zoo/.../models/recommendation/Recommender.scala``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Union
+
+import numpy as np
+
+from analytics_zoo_tpu.data.shard import HostXShards, XShards
+from analytics_zoo_tpu.models.common import ZooModel
+
+
+@dataclass
+class UserItemFeature:
+    user_id: int
+    item_id: int
+    sample: np.ndarray  # model input row, e.g. [user_id, item_id]
+
+
+@dataclass
+class UserItemPrediction:
+    user_id: int
+    item_id: int
+    prediction: int
+    probability: float
+
+
+class Recommender(ZooModel):
+    """Shared ranking utilities over XShards of UserItemFeature."""
+
+    def _pairs_to_batch(self, features: List[UserItemFeature]):
+        return np.stack([np.asarray(f.sample, np.float32) for f in features])
+
+    def predict_user_item_pair(
+            self, feature_shards: Union[XShards, List[UserItemFeature]],
+            batch_size: int = 1024) -> HostXShards:
+        """(ref Recommender.predictUserItemPair)"""
+        shards = (feature_shards.collect()
+                  if isinstance(feature_shards, XShards) else [feature_shards])
+        out = []
+        for shard in shards:
+            x = self._pairs_to_batch(shard)
+            probs = np.asarray(self.predict(x, batch_size=batch_size))
+            cls = probs.argmax(-1)
+            out.append([UserItemPrediction(f.user_id, f.item_id,
+                                           int(c) + 1, float(p[c]))
+                        for f, c, p in zip(shard, cls, probs)])
+        return HostXShards(out)
+
+    def recommend_for_user(self, feature_shards, max_items: int) -> HostXShards:
+        """Top-N items per user by predicted class then probability
+        (ref Recommender.recommendForUser)."""
+        preds = self.predict_user_item_pair(feature_shards).collect()
+        flat = [p for shard in preds for p in shard]
+        by_user = {}
+        for p in flat:
+            by_user.setdefault(p.user_id, []).append(p)
+        out = []
+        for uid, plist in by_user.items():
+            plist.sort(key=lambda p: (-p.prediction, -p.probability))
+            out.append(plist[:max_items])
+        return HostXShards(out)
+
+    def recommend_for_item(self, feature_shards, max_users: int) -> HostXShards:
+        preds = self.predict_user_item_pair(feature_shards).collect()
+        flat = [p for shard in preds for p in shard]
+        by_item = {}
+        for p in flat:
+            by_item.setdefault(p.item_id, []).append(p)
+        out = []
+        for iid, plist in by_item.items():
+            plist.sort(key=lambda p: (-p.prediction, -p.probability))
+            out.append(plist[:max_users])
+        return HostXShards(out)

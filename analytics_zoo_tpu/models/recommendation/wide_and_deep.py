@@ -1,0 +1,135 @@
+"""Wide & Deep recommender.
+
+Ref: ``pyzoo/zoo/models/recommendation/wide_and_deep.py:60-200`` and Scala
+``zoo/.../models/recommendation/WideAndDeep.scala:101``. Same three variants
+("wide", "deep", "wide_n_deep") and the same four-part input convention
+(wide one-hot block / indicator block / embedding ids / continuous). The
+reference's SparseDense over the wide block becomes a dense matmul — on TPU
+the one-hot × kernel product is exactly what the MXU is for.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from analytics_zoo_tpu.keras import Input, Model
+from analytics_zoo_tpu.keras import layers as zl
+from analytics_zoo_tpu.models.common import ZooModel, registry
+from analytics_zoo_tpu.models.recommendation.recommender import Recommender
+
+
+class ColumnFeatureInfo:
+    """(ref wide_and_deep.py:60-93: the feature-column schema object)"""
+
+    def __init__(self, wide_base_cols=None, wide_base_dims=None,
+                 wide_cross_cols=None, wide_cross_dims=None,
+                 indicator_cols=None, indicator_dims=None,
+                 embed_cols=None, embed_in_dims=None, embed_out_dims=None,
+                 continuous_cols=None, label="label"):
+        self.wide_base_cols = wide_base_cols or []
+        self.wide_base_dims = wide_base_dims or []
+        self.wide_cross_cols = wide_cross_cols or []
+        self.wide_cross_dims = wide_cross_dims or []
+        self.indicator_cols = indicator_cols or []
+        self.indicator_dims = indicator_dims or []
+        self.embed_cols = embed_cols or []
+        self.embed_in_dims = embed_in_dims or []
+        self.embed_out_dims = embed_out_dims or []
+        self.continuous_cols = continuous_cols or []
+        self.label = label
+
+
+@registry.register
+class WideAndDeep(Recommender):
+    """(ref wide_and_deep.py:94-200)"""
+
+    def __init__(self, class_num, column_info=None, model_type="wide_n_deep",
+                 hidden_layers=(40, 20, 10), **cfg_kwargs):
+        super().__init__()
+        if column_info is None:  # reload path: config given flat
+            column_info = ColumnFeatureInfo(**cfg_kwargs)
+        assert len(column_info.wide_base_cols) == len(column_info.wide_base_dims)
+        assert len(column_info.wide_cross_cols) == len(column_info.wide_cross_dims)
+        assert len(column_info.indicator_cols) == len(column_info.indicator_dims)
+        assert len(column_info.embed_cols) == len(column_info.embed_in_dims) \
+            == len(column_info.embed_out_dims)
+        self.class_num = int(class_num)
+        self.column_info = column_info
+        self.model_type = model_type
+        self.hidden_layers = [int(u) for u in hidden_layers]
+        self.model = self.build_model()
+
+    # ---- graph (ref wide_and_deep.py:141-200, layer-for-layer) ----
+    def build_model(self):
+        info = self.column_info
+        wide_dims = sum(info.wide_base_dims) + sum(info.wide_cross_dims)
+        input_wide = Input(shape=(wide_dims,), name="wide")
+        input_ind = Input(shape=(sum(info.indicator_dims),), name="indicator")
+        input_emb = Input(shape=(len(info.embed_cols),), name="embed")
+        input_con = Input(shape=(len(info.continuous_cols),), name="continuous")
+
+        wide_linear = zl.Dense(self.class_num, name="wide_linear")(input_wide)
+
+        if self.model_type == "wide":
+            out = zl.Activation("softmax")(wide_linear)
+            return Model(input=input_wide, output=out)
+        if self.model_type == "deep":
+            deep_inputs, merge_list = self._deep_merge(input_ind, input_emb,
+                                                       input_con)
+            out = zl.Activation("softmax")(self._deep_hidden(merge_list))
+            return Model(input=deep_inputs, output=out)
+        if self.model_type == "wide_n_deep":
+            deep_inputs, merge_list = self._deep_merge(input_ind, input_emb,
+                                                       input_con)
+            deep_linear = self._deep_hidden(merge_list)
+            merged = zl.merge([wide_linear, deep_linear], mode="sum")
+            out = zl.Activation("softmax")(merged)
+            return Model(input=[input_wide] + deep_inputs, output=out)
+        raise TypeError(f"Unsupported model_type: {self.model_type}")
+
+    def _deep_hidden(self, merge_list):
+        merged = merge_list[0] if len(merge_list) == 1 else \
+            zl.merge(merge_list, mode="concat")
+        linear = zl.Dense(self.hidden_layers[0], activation="relu")(merged)
+        for units in self.hidden_layers[1:]:
+            linear = zl.Dense(units, activation="relu")(linear)
+        return zl.Dense(self.class_num, activation="relu")(linear)
+
+    def _deep_merge(self, input_ind, input_emb, input_con):
+        info = self.column_info
+        embeds = []
+        for i, (in_dim, out_dim) in enumerate(zip(info.embed_in_dims,
+                                                  info.embed_out_dims)):
+            ids = zl.Select(1, i)(input_emb)
+            embeds.append(zl.Embedding(in_dim + 1, out_dim, init="normal",
+                                       name=f"embed_{i}")(ids))
+        has_ind = len(info.indicator_dims) > 0
+        has_emb = len(info.embed_cols) > 0
+        has_con = len(info.continuous_cols) > 0
+        inputs, merged = [], []
+        if has_ind:
+            inputs.append(input_ind)
+            merged.append(input_ind)
+        if has_emb:
+            inputs.append(input_emb)
+            merged.extend(embeds)
+        if has_con:
+            inputs.append(input_con)
+            merged.append(input_con)
+        assert merged, "deep model needs indicator/embed/continuous columns"
+        return inputs, merged
+
+    def _config(self):
+        info = self.column_info
+        return dict(class_num=self.class_num, model_type=self.model_type,
+                    hidden_layers=self.hidden_layers,
+                    wide_base_cols=info.wide_base_cols,
+                    wide_base_dims=info.wide_base_dims,
+                    wide_cross_cols=info.wide_cross_cols,
+                    wide_cross_dims=info.wide_cross_dims,
+                    indicator_cols=info.indicator_cols,
+                    indicator_dims=info.indicator_dims,
+                    embed_cols=info.embed_cols,
+                    embed_in_dims=info.embed_in_dims,
+                    embed_out_dims=info.embed_out_dims,
+                    continuous_cols=info.continuous_cols)

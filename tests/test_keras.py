@@ -1,0 +1,148 @@
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.keras import Input, Model, Sequential
+from analytics_zoo_tpu.keras import layers as zl
+
+
+def test_sequential_mlp_fit(orca_ctx):
+    x = np.random.default_rng(0).normal(size=(256, 8)).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.float32)[:, None]
+    m = Sequential()
+    m.add(zl.Dense(16, activation="relu", input_shape=(8,)))
+    m.add(zl.Dropout(0.1))
+    m.add(zl.Dense(1, activation="sigmoid"))
+    from analytics_zoo_tpu.learn.optimizers import Adam
+    m.compile(optimizer=Adam(1e-2), loss="binary_crossentropy",
+              metrics=["accuracy"])
+    m.fit(x, y, batch_size=32, nb_epoch=15)
+    res = m.evaluate(x, y, batch_size=32)
+    assert res["accuracy"] > 0.8
+    preds = m.predict(x[:10])
+    assert preds.shape == (10, 1)
+
+
+def test_functional_two_tower(orca_ctx):
+    a = Input(shape=(4,))
+    b = Input(shape=(4,))
+    ha = zl.Dense(8, activation="relu")(a)
+    hb = zl.Dense(8, activation="relu")(b)
+    merged = zl.merge([ha, hb], mode="concat")
+    out = zl.Dense(1)(merged)
+    m = Model(input=[a, b], output=out)
+    m.compile(optimizer="adam", loss="mse")
+    xa = np.random.default_rng(1).normal(size=(64, 4)).astype(np.float32)
+    xb = np.random.default_rng(2).normal(size=(64, 4)).astype(np.float32)
+    y = (xa - xb).sum(1, keepdims=True).astype(np.float32)
+    hist = m.fit([xa, xb], y, batch_size=16, nb_epoch=5)
+    assert hist["loss"][-1] < hist["loss"][0]
+    assert m.predict([xa, xb]).shape == (64, 1)
+
+
+def test_weight_sharing(orca_ctx):
+    import jax
+    inp1 = Input(shape=(4,))
+    inp2 = Input(shape=(4,))
+    shared = zl.Dense(3, name="shared_dense")
+    o = zl.merge([shared(inp1), shared(inp2)], mode="sum")
+    m = Model(input=[inp1, inp2], output=o)
+    module = m.to_flax()
+    variables = module.init(jax.random.PRNGKey(0),
+                            np.zeros((2, 4), np.float32),
+                            np.zeros((2, 4), np.float32))
+    # one copy of shared params
+    assert list(variables["params"].keys()) == ["shared_dense"]
+
+
+def test_cnn_layers(orca_ctx):
+    m = Sequential()
+    m.add(zl.Conv2D(4, 3, 3, activation="relu", input_shape=(8, 8, 1)))
+    m.add(zl.MaxPooling2D())
+    m.add(zl.Flatten())
+    m.add(zl.Dense(10, activation="softmax"))
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    x = np.random.default_rng(0).normal(size=(64, 8, 8, 1)).astype(np.float32)
+    y = np.random.default_rng(0).integers(0, 10, size=64)
+    m.fit(x, y, batch_size=16, nb_epoch=1)
+    assert m.predict(x[:4]).shape == (4, 10)
+    cls = m.predict_classes(x[:4])
+    assert cls.shape == (4,) and cls.dtype.kind == "i"
+
+
+def test_lstm_gru(orca_ctx):
+    for Layer in (zl.LSTM, zl.GRU, zl.SimpleRNN):
+        m = Sequential()
+        m.add(Layer(6, input_shape=(5, 3)))
+        m.add(zl.Dense(1))
+        m.compile(optimizer="adam", loss="mse")
+        x = np.random.default_rng(0).normal(size=(32, 5, 3)).astype(np.float32)
+        y = x.mean((1, 2), keepdims=False)[:, None]
+        m.fit(x, y, batch_size=16, nb_epoch=1)
+        assert m.predict(x[:3]).shape == (3, 1)
+
+
+def test_lstm_return_sequences_and_bidirectional(orca_ctx):
+    import jax
+    m = Sequential()
+    m.add(zl.Bidirectional(zl.LSTM(4, return_sequences=True),
+                           merge_mode="concat"))
+    m.layers[0].layer.input_shape = None
+    # Bidirectional needs explicit input_shape on the wrapper path
+    seq = Sequential()
+    bi = zl.Bidirectional(zl.LSTM(4, return_sequences=True))
+    bi.input_shape = (6, 3)
+    seq.add(bi)
+    seq.add(zl.TimeDistributed(zl.Dense(2)))
+    module = seq.to_flax()
+    x = np.zeros((2, 6, 3), np.float32)
+    variables = module.init(jax.random.PRNGKey(0), x)
+    out = module.apply(variables, x)
+    assert out.shape == (2, 6, 2)
+
+
+def test_embedding_and_batchnorm(orca_ctx):
+    m = Sequential()
+    m.add(zl.Embedding(100, 8, input_shape=(4,)))
+    m.add(zl.Flatten())
+    m.add(zl.BatchNormalization())
+    m.add(zl.Dense(1))
+    m.compile(optimizer="adam", loss="mse")
+    x = np.random.default_rng(0).integers(0, 100, size=(64, 4)).astype(np.float32)
+    y = np.zeros((64, 1), np.float32)
+    m.fit(x, y, batch_size=16, nb_epoch=1)
+    # batch_stats updated during training
+    est = m.estimator
+    assert "batch_stats" in est._state["model_state"]
+
+
+def test_attention_layer(orca_ctx):
+    seq = Sequential()
+    att = zl.MultiHeadAttention(num_heads=2, head_dim=4)
+    att.input_shape = (6, 8)
+    seq.add(att)
+    seq.add(zl.GlobalAveragePooling1D())
+    seq.add(zl.Dense(1))
+    seq.compile(optimizer="adam", loss="mse")
+    x = np.random.default_rng(0).normal(size=(16, 6, 8)).astype(np.float32)
+    y = np.zeros((16, 1), np.float32)
+    seq.fit(x, y, batch_size=8, nb_epoch=1)
+
+
+def test_summary(orca_ctx, capsys):
+    m = Sequential()
+    m.add(zl.Dense(4, input_shape=(3,), name="d1"))
+    m.add(zl.Dense(2, name="d2"))
+    text = m.summary()
+    assert "d1" in text and "Total params: 26" in text  # 3*4+4 + 4*2+2
+
+
+def test_node_arith_ops(orca_ctx):
+    import jax
+    a = Input(shape=(3,))
+    out = (a * 2.0) + 1.0
+    m = Model(input=a, output=out)
+    module = m.to_flax()
+    x = np.ones((2, 3), np.float32)
+    variables = module.init(jax.random.PRNGKey(0), x)
+    np.testing.assert_allclose(module.apply(variables, x), 3.0 * np.ones((2, 3)))

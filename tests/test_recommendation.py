@@ -1,0 +1,122 @@
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.models.recommendation import (ColumnFeatureInfo,
+                                                     NeuralCF,
+                                                     SessionRecommender,
+                                                     UserItemFeature,
+                                                     WideAndDeep)
+
+
+def _ml_like(n=400, users=50, items=30, classes=5, seed=0):
+    rng = np.random.default_rng(seed)
+    u = rng.integers(1, users + 1, n)
+    i = rng.integers(1, items + 1, n)
+    # rating structured on user/item parity so the model can learn
+    y = ((u + i) % classes).astype(np.int32)
+    x = np.stack([u, i], 1).astype(np.float32)
+    return x, y
+
+
+def test_ncf_fit_predict(orca_ctx):
+    from analytics_zoo_tpu.learn.optimizers import Adam
+    x, y = _ml_like()
+    ncf = NeuralCF(user_count=50, item_count=30, class_num=5)
+    ncf.compile(optimizer=Adam(5e-3), loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"])
+    hist = ncf.fit(x, y, batch_size=80, nb_epoch=20)
+    assert hist["loss"][-1] < hist["loss"][0]
+    res = ncf.evaluate(x, y, batch_size=80)
+    assert res["accuracy"] > 0.5  # structured signal is learnable
+    probs = ncf.predict(x[:8])
+    assert probs.shape == (8, 5)
+    np.testing.assert_allclose(np.asarray(probs).sum(-1), 1.0, rtol=1e-4)
+
+
+def test_ncf_no_mf_and_save_load(orca_ctx, tmp_path):
+    x, y = _ml_like(n=160)
+    ncf = NeuralCF(50, 30, 5, include_mf=False)
+    ncf.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    ncf.fit(x, y, batch_size=32, nb_epoch=1)
+    path = str(tmp_path / "ncf")
+    ncf.save_model(path)
+    from analytics_zoo_tpu.models.common import ZooModel
+    loaded = ZooModel.load_model(path)
+    assert isinstance(loaded, NeuralCF)
+    np.testing.assert_allclose(np.asarray(loaded.predict(x[:4])),
+                               np.asarray(ncf.predict(x[:4])), rtol=1e-5)
+
+
+def test_recommender_utilities(orca_ctx):
+    x, y = _ml_like(n=80)
+    ncf = NeuralCF(50, 30, 5)
+    ncf.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    ncf.fit(x, y, batch_size=16, nb_epoch=1)
+    feats = [UserItemFeature(int(r[0]), int(r[1]), r) for r in x[:40]]
+    preds = ncf.predict_user_item_pair(feats).collect()[0]
+    assert len(preds) == 40
+    assert all(1 <= p.prediction <= 5 for p in preds)
+    recs = ncf.recommend_for_user(feats, max_items=3).collect()
+    assert all(len(r) <= 3 for r in recs)
+    ritems = ncf.recommend_for_item(feats, max_users=2).collect()
+    assert all(len(r) <= 2 for r in ritems)
+
+
+def test_wide_and_deep_variants(orca_ctx):
+    info = ColumnFeatureInfo(
+        wide_base_cols=["a", "b"], wide_base_dims=[10, 10],
+        wide_cross_cols=["ab"], wide_cross_dims=[20],
+        indicator_cols=["c"], indicator_dims=[4],
+        embed_cols=["u", "i"], embed_in_dims=[30, 40], embed_out_dims=[8, 8],
+        continuous_cols=["age"])
+    n = 96
+    rng = np.random.default_rng(0)
+    wide = np.zeros((n, 40), np.float32)
+    wide[np.arange(n), rng.integers(0, 40, n)] = 1.0
+    ind = np.zeros((n, 4), np.float32)
+    ind[np.arange(n), rng.integers(0, 4, n)] = 1.0
+    emb = np.stack([rng.integers(1, 31, n), rng.integers(1, 41, n)], 1).astype(np.float32)
+    con = rng.normal(size=(n, 1)).astype(np.float32)
+    y = rng.integers(0, 2, n)
+
+    wnd = WideAndDeep(2, info, model_type="wide_n_deep")
+    wnd.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"])
+    wnd.fit([wide, ind, emb, con], y, batch_size=32, nb_epoch=2)
+    assert wnd.predict([wide, ind, emb, con]).shape == (n, 2)
+
+    wide_only = WideAndDeep(2, info, model_type="wide")
+    wide_only.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    wide_only.fit(wide, y, batch_size=32, nb_epoch=1)
+
+    deep = WideAndDeep(2, info, model_type="deep")
+    deep.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    deep.fit([ind, emb, con], y, batch_size=32, nb_epoch=1)
+
+
+def test_session_recommender(orca_ctx):
+    rng = np.random.default_rng(0)
+    n, sess_len, items = 64, 5, 20
+    x = rng.integers(1, items + 1, (n, sess_len)).astype(np.float32)
+    y = rng.integers(0, items, n)
+    sr = SessionRecommender(item_count=items, item_embed=8,
+                            rnn_hidden_layers=[12, 8], session_length=sess_len)
+    sr.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    sr.fit(x, y, batch_size=16, nb_epoch=1)
+    recs = sr.recommend_for_session(x[:4], max_items=3)
+    assert len(recs) == 4 and len(recs[0]) == 3
+    with pytest.raises(Exception):
+        sr.recommend_for_user(None, 3)
+
+
+def test_session_recommender_with_history(orca_ctx):
+    rng = np.random.default_rng(0)
+    n, sess_len, his_len, items = 32, 4, 6, 15
+    xs = rng.integers(1, items + 1, (n, sess_len)).astype(np.float32)
+    xh = rng.integers(1, items + 1, (n, his_len)).astype(np.float32)
+    y = rng.integers(0, items, n)
+    sr = SessionRecommender(items, 8, [10], sess_len, include_history=True,
+                            mlp_hidden_layers=[10], history_length=his_len)
+    sr.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    sr.fit([xs, xh], y, batch_size=16, nb_epoch=1)
+    assert sr.predict([xs, xh]).shape == (n, items)
