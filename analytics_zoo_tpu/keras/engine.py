@@ -53,6 +53,10 @@ class Node:
         from analytics_zoo_tpu.keras.layers import merge_op
         return merge_op("sub")([self, _const(other, self)])
 
+    def __rsub__(self, other):
+        from analytics_zoo_tpu.keras.layers import merge_op
+        return merge_op("sub")([_const(other, self), self])
+
     def __mul__(self, other):
         from analytics_zoo_tpu.keras.layers import merge_op
         return merge_op("mul")([self, _const(other, self)])
@@ -62,6 +66,13 @@ class Node:
     def __truediv__(self, other):
         from analytics_zoo_tpu.keras.layers import merge_op
         return merge_op("div")([self, _const(other, self)])
+
+    def __rtruediv__(self, other):
+        from analytics_zoo_tpu.keras.layers import merge_op
+        return merge_op("div")([_const(other, self), self])
+
+    def __neg__(self):
+        return self * -1.0
 
 
 def _const(v, like: Node) -> Node:

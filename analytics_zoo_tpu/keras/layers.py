@@ -501,12 +501,19 @@ class _RNNBase(KerasLayer):
                  input_shape=None, name=None):
         super().__init__(name, input_shape)
         self.output_dim = output_dim
+        self.activation = activation
         self.return_sequences = return_sequences
         self.go_backwards = go_backwards
 
+    def _make_cell(self):
+        kwargs = {}
+        if self.activation not in ("tanh", None):
+            kwargs["activation_fn"] = get_activation(self.activation)
+        return self.cell_cls(features=self.output_dim, **kwargs)
+
     def make_module(self):
-        return nn.RNN(self.cell_cls(features=self.output_dim),
-                      reverse=self.go_backwards, name=self.name)
+        return nn.RNN(self._make_cell(), reverse=self.go_backwards,
+                      name=self.name)
 
     def apply(self, module, args, train):
         out = module(args[0])
@@ -542,21 +549,17 @@ class Bidirectional(KerasLayer):
         self.merge_mode = merge_mode
 
     def make_module(self):
-        class _BiDi(nn.Module):
-            cell_cls: Any
-            features: int
-            ret_seq: bool
+        inner = self.layer
 
+        class _BiDi(nn.Module):
             @nn.compact
             def __call__(self, x):
-                fwd = nn.RNN(self.cell_cls(features=self.features),
-                             name="forward")(x)
-                bwd = nn.RNN(self.cell_cls(features=self.features),
-                             reverse=True, keep_order=True, name="backward")(x)
+                fwd = nn.RNN(inner._make_cell(), name="forward")(x)
+                bwd = nn.RNN(inner._make_cell(), reverse=True,
+                             keep_order=True, name="backward")(x)
                 return fwd, bwd
 
-        return _BiDi(self.layer.cell_cls, self.layer.output_dim,
-                     self.layer.return_sequences, name=self.name)
+        return _BiDi(name=self.name)
 
     def apply(self, module, args, train):
         fwd, bwd = module(args[0])
@@ -661,6 +664,9 @@ class TimeDistributed(KerasLayer):
         self.layer = layer
 
     def make_module(self):
+        # the inner module inherits this wrapper's (canonicalised) name so
+        # the parameter tree stays deterministic across processes
+        self.layer.name = f"{self.name}_inner"
         return self.layer.make_module()
 
     def apply(self, module, args, train):

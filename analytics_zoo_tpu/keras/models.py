@@ -52,18 +52,34 @@ class KerasNet:
         """Auto-generated layer names are rewritten to a deterministic
         per-model scheme (type_index in topo order) so two builds of the same
         architecture produce identical parameter trees — required for
-        checkpoint/save_model round-trips across processes."""
-        counters: dict = {}
+        checkpoint/save_model round-trips across processes. Canonical names
+        never collide with user-chosen names (the graph executor memoizes
+        flax submodules by name, so a collision would silently run the wrong
+        layer), and duplicate user names are rejected."""
+        layers, user_names = [], set()
         seen: set = set()
         for node in order:
             layer = node.layer
             if layer is None or id(layer) in seen:
                 continue
             seen.add(id(layer))
+            layers.append(layer)
+            if not getattr(layer, "_auto_named", False):
+                if layer.name in user_names:
+                    raise ValueError(
+                        f"duplicate layer name {layer.name!r}; layer names "
+                        "must be unique within a model")
+                user_names.add(layer.name)
+        counters: dict = {}
+        for layer in layers:
             if getattr(layer, "_auto_named", False):
                 prefix = type(layer).__name__.lower()
-                counters[prefix] = counters.get(prefix, 0) + 1
-                layer.name = f"{prefix}_{counters[prefix]}"
+                while True:
+                    counters[prefix] = counters.get(prefix, 0) + 1
+                    cand = f"{prefix}_{counters[prefix]}"
+                    if cand not in user_names:
+                        break
+                layer.name = cand
 
     def sample_input(self, batch: int = 2):
         shapes = self.input_shapes()

