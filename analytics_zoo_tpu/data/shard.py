@@ -66,6 +66,21 @@ class XShards:
         return out
 
     @staticmethod
+    def from_records(records, num_shards: Optional[int] = None) -> "HostXShards":
+        """Partition a flat list of opaque records (feature dicts, rows) into
+        contiguous shards without descending into their structure."""
+        n = num_shards
+        if n is None:
+            from analytics_zoo_tpu.common.context import OrcaContext
+            try:
+                n = OrcaContext.get_context().num_devices
+            except RuntimeError:
+                n = 1
+        n = max(1, min(n, len(records))) if records else 1
+        splits = np.array_split(np.arange(len(records)), n)
+        return HostXShards([[records[i] for i in idx] for idx in splits])
+
+    @staticmethod
     def partition(data, num_shards: Optional[int] = None) -> "HostXShards":
         """Partition an in-memory ndarray / dict / (nested) list-of-ndarrays
         into shards (ref shard.py:73-127 splits along axis 0)."""

@@ -1,0 +1,5 @@
+"""Feature-engineering pipelines (image / text), the TPU-native analog of the
+reference's ``zoo/.../feature/`` (ImageSet/TextSet) packages."""
+
+from analytics_zoo_tpu.feature.image import ImageSet  # noqa: F401
+from analytics_zoo_tpu.feature.text import TextSet, TextFeature  # noqa: F401

@@ -1,0 +1,10 @@
+from analytics_zoo_tpu.feature.image.imageset import ImageSet, ImageFeature  # noqa: F401
+from analytics_zoo_tpu.feature.image.transforms import (  # noqa: F401
+    ImagePreprocessing, ChainedPreprocessing, ImageResize, ImageAspectScale,
+    ImageRandomAspectScale, ImageCenterCrop, ImageRandomCrop, ImageFixedCrop,
+    ImageHFlip, ImageRandomFlip, ImageChannelNormalize, ImagePixelNormalizer,
+    ImageChannelScaledNormalizer, ImageBrightness, ImageContrast,
+    ImageSaturation, ImageHue, ImageColorJitter, ImageExpand, ImageFiller,
+    ImageRandomPreprocessing, ImageBytesToArray, ImageSetToSample,
+    ImageMatToTensor,
+)

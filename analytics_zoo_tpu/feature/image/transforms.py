@@ -1,0 +1,413 @@
+"""Image preprocessing transformers.
+
+TPU-native rebuild of the reference's OpenCV-backed image pipeline
+(ref ``zoo/src/main/scala/com/intel/analytics/zoo/feature/image/`` — ~40
+transformers such as ImageResize, ImageCenterCrop, ImageChannelNormalize,
+ImageBrightness/Contrast/Saturation/Hue, ImageExpand, ImageFiller,
+ImageRandomPreprocessing — and the python mirror
+``pyzoo/zoo/feature/image/imagePreprocessing.py``).
+
+Design differences from the reference, on purpose:
+- images are channels-last float32/uint8 numpy arrays (HWC), the layout XLA
+  prefers on TPU; there is no Mat/OpenCV object. Decoding uses PIL.
+- every transform is a pure callable on an ``ImageFeature`` dict; pipelines
+  compose with ``ChainedPreprocessing`` (ref
+  ``pyzoo/zoo/feature/common.py`` ChainedPreprocessing) and run host-side,
+  per shard, so the device only ever sees fixed-shape batched tensors.
+- geometric resampling uses ``jax.image.resize`` semantics implemented with
+  numpy (host) to avoid device round-trips during ETL.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "ImagePreprocessing", "ChainedPreprocessing", "ImageResize",
+    "ImageAspectScale", "ImageRandomAspectScale", "ImageCenterCrop",
+    "ImageRandomCrop", "ImageFixedCrop", "ImageHFlip", "ImageRandomFlip",
+    "ImageChannelNormalize", "ImagePixelNormalizer",
+    "ImageChannelScaledNormalizer", "ImageBrightness", "ImageContrast",
+    "ImageSaturation", "ImageHue", "ImageColorJitter", "ImageExpand",
+    "ImageFiller", "ImageRandomPreprocessing", "ImageBytesToArray",
+    "ImageSetToSample", "ImageMatToTensor",
+]
+
+
+def _to_float(img: np.ndarray) -> np.ndarray:
+    if img.dtype == np.uint8:
+        return img.astype(np.float32)
+    return np.asarray(img, dtype=np.float32)
+
+
+def _bilinear_resize(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
+    """Pure-numpy bilinear resize (align_corners=False, like jax.image)."""
+    img = _to_float(img)
+    h, w = img.shape[:2]
+    if h == out_h and w == out_w:
+        return img
+    ys = (np.arange(out_h) + 0.5) * (h / out_h) - 0.5
+    xs = (np.arange(out_w) + 0.5) * (w / out_w) - 0.5
+    y0 = np.clip(np.floor(ys).astype(np.int64), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(np.int64), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0)[:, None, None]
+    wx = np.clip(xs - x0, 0.0, 1.0)[None, :, None]
+    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+class ImagePreprocessing:
+    """Base transformer: a pure function ImageFeature -> ImageFeature.
+
+    Ref ``pyzoo/zoo/feature/image/imagePreprocessing.py`` ImagePreprocessing
+    (py4j wrapper there; a real host-side function here)."""
+
+    def transform(self, feature: dict) -> dict:
+        img = feature["image"]
+        feature = dict(feature)
+        feature["image"] = self.apply_image(img)
+        return feature
+
+    def apply_image(self, img: np.ndarray) -> np.ndarray:  # pragma: no cover
+        raise NotImplementedError
+
+    def __call__(self, feature: dict) -> dict:
+        return self.transform(feature)
+
+    # ref feature/common.py Preprocessing `->` chaining
+    def __gt__(self, other: "ImagePreprocessing") -> "ChainedPreprocessing":
+        return ChainedPreprocessing([self, other])
+
+
+class ChainedPreprocessing(ImagePreprocessing):
+    """Compose transformers left-to-right (ref ChainedPreprocessing,
+    ``pyzoo/zoo/feature/common.py``)."""
+
+    def __init__(self, transformers: Sequence[ImagePreprocessing]):
+        self.transformers = list(transformers)
+
+    def transform(self, feature: dict) -> dict:
+        for t in self.transformers:
+            feature = t.transform(feature)
+        return feature
+
+
+class ImageBytesToArray(ImagePreprocessing):
+    """Decode encoded image bytes (``feature['bytes']``) to an HWC uint8
+    array (ref ImageBytesToMat)."""
+
+    def __init__(self, byte_key: str = "bytes"):
+        self.byte_key = byte_key
+
+    def transform(self, feature: dict) -> dict:
+        import io
+        from PIL import Image
+
+        feature = dict(feature)
+        img = Image.open(io.BytesIO(feature[self.byte_key])).convert("RGB")
+        feature["image"] = np.asarray(img, dtype=np.uint8)
+        return feature
+
+
+class ImageResize(ImagePreprocessing):
+    """Resize to (resize_h, resize_w) (ref ImageResize.scala)."""
+
+    def __init__(self, resize_h: int, resize_w: int):
+        self.resize_h, self.resize_w = resize_h, resize_w
+
+    def apply_image(self, img):
+        return _bilinear_resize(img, self.resize_h, self.resize_w)
+
+
+class ImageAspectScale(ImagePreprocessing):
+    """Scale the short edge to ``min_size`` keeping aspect ratio, cap the
+    long edge at ``max_size`` (ref ImageAspectScale.scala)."""
+
+    def __init__(self, min_size: int, max_size: int = 1000,
+                 scale_multiple_of: int = 1):
+        self.min_size, self.max_size = min_size, max_size
+        self.scale_multiple_of = scale_multiple_of
+
+    def apply_image(self, img):
+        h, w = img.shape[:2]
+        short, long = min(h, w), max(h, w)
+        scale = self.min_size / short
+        if long * scale > self.max_size:
+            scale = self.max_size / long
+        out_h, out_w = int(round(h * scale)), int(round(w * scale))
+        m = self.scale_multiple_of
+        if m > 1:
+            out_h, out_w = (out_h + m - 1) // m * m, (out_w + m - 1) // m * m
+        return _bilinear_resize(img, max(out_h, 1), max(out_w, 1))
+
+
+class ImageRandomAspectScale(ImageAspectScale):
+    """Pick the short-edge target randomly from ``scales``
+    (ref ImageRandomAspectScale.scala)."""
+
+    def __init__(self, scales: Sequence[int], max_size: int = 1000):
+        super().__init__(scales[0], max_size)
+        self.scales = list(scales)
+
+    def apply_image(self, img):
+        return ImageAspectScale(
+            random.choice(self.scales), self.max_size,
+            self.scale_multiple_of).apply_image(img)
+
+
+class ImageCenterCrop(ImagePreprocessing):
+    """Center crop to (crop_h, crop_w) (ref ImageCenterCrop.scala)."""
+
+    def __init__(self, crop_h: int, crop_w: int):
+        self.crop_h, self.crop_w = crop_h, crop_w
+
+    def apply_image(self, img):
+        h, w = img.shape[:2]
+        y0 = max((h - self.crop_h) // 2, 0)
+        x0 = max((w - self.crop_w) // 2, 0)
+        return img[y0:y0 + self.crop_h, x0:x0 + self.crop_w]
+
+
+class ImageRandomCrop(ImagePreprocessing):
+    """Uniform random crop (ref ImageRandomCrop.scala)."""
+
+    def __init__(self, crop_h: int, crop_w: int):
+        self.crop_h, self.crop_w = crop_h, crop_w
+
+    def apply_image(self, img):
+        h, w = img.shape[:2]
+        y0 = random.randint(0, max(h - self.crop_h, 0))
+        x0 = random.randint(0, max(w - self.crop_w, 0))
+        return img[y0:y0 + self.crop_h, x0:x0 + self.crop_w]
+
+
+class ImageFixedCrop(ImagePreprocessing):
+    """Crop a fixed box; normalized=True means fractional coords
+    (ref ImageFixedCrop.scala)."""
+
+    def __init__(self, x1, y1, x2, y2, normalized: bool = True):
+        self.box = (x1, y1, x2, y2)
+        self.normalized = normalized
+
+    def apply_image(self, img):
+        h, w = img.shape[:2]
+        x1, y1, x2, y2 = self.box
+        if self.normalized:
+            x1, x2 = int(x1 * w), int(x2 * w)
+            y1, y2 = int(y1 * h), int(y2 * h)
+        return img[int(y1):int(y2), int(x1):int(x2)]
+
+
+class ImageHFlip(ImagePreprocessing):
+    """Horizontal flip (ref ImageHFlip.scala)."""
+
+    def apply_image(self, img):
+        return img[:, ::-1]
+
+
+class ImageRandomFlip(ImagePreprocessing):
+    """Flip with probability p."""
+
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def apply_image(self, img):
+        return img[:, ::-1] if random.random() < self.p else img
+
+
+class ImageChannelNormalize(ImagePreprocessing):
+    """(x - mean) / std per channel (ref ImageChannelNormalize.scala)."""
+
+    def __init__(self, mean_r, mean_g, mean_b, std_r=1.0, std_g=1.0, std_b=1.0):
+        self.mean = np.array([mean_r, mean_g, mean_b], np.float32)
+        self.std = np.array([std_r, std_g, std_b], np.float32)
+
+    def apply_image(self, img):
+        return (_to_float(img) - self.mean) / self.std
+
+
+class ImagePixelNormalizer(ImagePreprocessing):
+    """Subtract a per-pixel mean image (ref ImagePixelNormalizer.scala)."""
+
+    def __init__(self, means: np.ndarray):
+        self.means = np.asarray(means, np.float32)
+
+    def apply_image(self, img):
+        return _to_float(img) - self.means
+
+
+class ImageChannelScaledNormalizer(ImagePreprocessing):
+    """(x - mean) * scale (ref ImageChannelScaledNormalizer.scala)."""
+
+    def __init__(self, mean_r, mean_g, mean_b, scale: float):
+        self.mean = np.array([mean_r, mean_g, mean_b], np.float32)
+        self.scale = scale
+
+    def apply_image(self, img):
+        return (_to_float(img) - self.mean) * self.scale
+
+
+class ImageBrightness(ImagePreprocessing):
+    """Add a uniform delta in [delta_low, delta_high]
+    (ref ImageBrightness.scala)."""
+
+    def __init__(self, delta_low: float = -32.0, delta_high: float = 32.0):
+        self.low, self.high = delta_low, delta_high
+
+    def apply_image(self, img):
+        return _to_float(img) + random.uniform(self.low, self.high)
+
+
+class ImageContrast(ImagePreprocessing):
+    """Scale contrast by a uniform factor (ref ImageContrast.scala)."""
+
+    def __init__(self, delta_low: float = 0.5, delta_high: float = 1.5):
+        self.low, self.high = delta_low, delta_high
+
+    def apply_image(self, img):
+        return _to_float(img) * random.uniform(self.low, self.high)
+
+
+class ImageSaturation(ImagePreprocessing):
+    """Scale saturation: blend with per-pixel luma (ref ImageSaturation.scala,
+    HSV-S channel scaling; implemented as luma blend which is the same to
+    first order and stays vectorized)."""
+
+    def __init__(self, delta_low: float = 0.5, delta_high: float = 1.5):
+        self.low, self.high = delta_low, delta_high
+
+    def apply_image(self, img):
+        img = _to_float(img)
+        f = random.uniform(self.low, self.high)
+        luma = img @ np.array([0.299, 0.587, 0.114], np.float32)
+        return img * f + (1.0 - f) * luma[..., None]
+
+
+class ImageHue(ImagePreprocessing):
+    """Rotate hue by a uniform angle in degrees (ref ImageHue.scala).
+
+    Uses the YIQ rotation matrix trick so it stays a single matmul."""
+
+    def __init__(self, delta_low: float = -18.0, delta_high: float = 18.0):
+        self.low, self.high = delta_low, delta_high
+
+    def apply_image(self, img):
+        img = _to_float(img)
+        theta = np.deg2rad(random.uniform(self.low, self.high))
+        c, s = np.cos(theta), np.sin(theta)
+        # RGB->YIQ, rotate IQ, back. Precomposed constants.
+        t_yiq = np.array([[0.299, 0.587, 0.114],
+                          [0.596, -0.274, -0.322],
+                          [0.211, -0.523, 0.312]], np.float32)
+        rot = np.array([[1, 0, 0], [0, c, -s], [0, s, c]], np.float32)
+        m = (np.linalg.inv(t_yiq) @ rot @ t_yiq).astype(np.float32)
+        return img @ m.T
+
+
+class ImageColorJitter(ImagePreprocessing):
+    """Random brightness/contrast/saturation in random order
+    (ref ImageColorJitter.scala)."""
+
+    def __init__(self, brightness_prob=0.5, brightness_delta=32.0,
+                 contrast_prob=0.5, contrast_lower=0.5, contrast_upper=1.5,
+                 saturation_prob=0.5, saturation_lower=0.5,
+                 saturation_upper=1.5, hue_prob=0.5, hue_delta=18.0):
+        self.ops = [
+            (brightness_prob, ImageBrightness(-brightness_delta, brightness_delta)),
+            (contrast_prob, ImageContrast(contrast_lower, contrast_upper)),
+            (saturation_prob, ImageSaturation(saturation_lower, saturation_upper)),
+            (hue_prob, ImageHue(-hue_delta, hue_delta)),
+        ]
+
+    def apply_image(self, img):
+        ops = list(self.ops)
+        random.shuffle(ops)
+        for p, op in ops:
+            if random.random() < p:
+                img = op.apply_image(img)
+        return img
+
+
+class ImageExpand(ImagePreprocessing):
+    """Place the image on a larger mean-filled canvas with a random expand
+    ratio (ref ImageExpand.scala, used by SSD augmentation)."""
+
+    def __init__(self, means_r=123, means_g=117, means_b=104,
+                 min_expand_ratio=1.0, max_expand_ratio=4.0):
+        self.mean = np.array([means_r, means_g, means_b], np.float32)
+        self.min_ratio, self.max_ratio = min_expand_ratio, max_expand_ratio
+
+    def apply_image(self, img):
+        img = _to_float(img)
+        ratio = random.uniform(self.min_ratio, self.max_ratio)
+        h, w = img.shape[:2]
+        out_h, out_w = int(h * ratio), int(w * ratio)
+        y0 = random.randint(0, out_h - h)
+        x0 = random.randint(0, out_w - w)
+        canvas = np.broadcast_to(self.mean, (out_h, out_w, 3)).copy()
+        canvas[y0:y0 + h, x0:x0 + w] = img
+        return canvas
+
+
+class ImageFiller(ImagePreprocessing):
+    """Fill a (normalized) box with a constant value (ref ImageFiller.scala)."""
+
+    def __init__(self, x1, y1, x2, y2, value: int = 255):
+        self.box, self.value = (x1, y1, x2, y2), value
+
+    def apply_image(self, img):
+        img = np.array(img)
+        h, w = img.shape[:2]
+        x1, y1, x2, y2 = self.box
+        img[int(y1 * h):int(y2 * h), int(x1 * w):int(x2 * w)] = self.value
+        return img
+
+
+class ImageRandomPreprocessing(ImagePreprocessing):
+    """Apply an inner transformer with probability p
+    (ref ImageRandomPreprocessing.scala)."""
+
+    def __init__(self, preprocessing: ImagePreprocessing, prob: float):
+        self.inner, self.prob = preprocessing, prob
+
+    def transform(self, feature):
+        if random.random() < self.prob:
+            return self.inner.transform(feature)
+        return feature
+
+
+class ImageMatToTensor(ImagePreprocessing):
+    """Finalize to float32 HWC (channels-last; the reference's MatToTensor
+    emits CHW for BigDL — TPU wants NHWC, so ``to_chw=False`` is default)."""
+
+    def __init__(self, to_chw: bool = False):
+        self.to_chw = to_chw
+
+    def apply_image(self, img):
+        img = _to_float(img)
+        return np.transpose(img, (2, 0, 1)) if self.to_chw else img
+
+
+class ImageSetToSample(ImagePreprocessing):
+    """Pack image (+ optional label) into a training sample dict
+    (ref ImageSetToSample.scala)."""
+
+    def __init__(self, input_keys=("image",), target_keys: Optional[Tuple] = ("label",)):
+        self.input_keys = tuple(input_keys)
+        self.target_keys = tuple(target_keys) if target_keys else ()
+
+    def transform(self, feature):
+        feature = dict(feature)
+        xs = [np.asarray(feature[k], np.float32) for k in self.input_keys]
+        sample = {"x": xs[0] if len(xs) == 1 else xs}
+        ys = [np.asarray(feature[k]) for k in self.target_keys if k in feature]
+        if ys:
+            sample["y"] = ys[0] if len(ys) == 1 else ys
+        feature["sample"] = sample
+        return feature

@@ -1,0 +1,253 @@
+"""TextSet: sharded text-classification / QA-ranking pipeline.
+
+Rebuild of ref ``zoo/src/main/scala/com/intel/analytics/zoo/feature/text/TextSet.scala``
+(797 LoC: read, tokenize → normalize → word2idx → shape → sample; relation
+pairs for QA ranking) and ``pyzoo/zoo/feature/text/text_set.py``.
+
+TPU-native shape discipline: every stage is host-side over XShards; the
+output of ``to_dataset`` is fixed-length int32 id matrices (pad/truncate in
+``SequenceShaper``) so the jitted step never sees ragged data."""
+
+from __future__ import annotations
+
+import os
+import re
+import string
+from collections import Counter
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.data.shard import HostXShards
+
+
+class TextFeature(dict):
+    """A text record: ``text``, optional ``label``, accumulating ``tokens``
+    then ``indexed_tokens`` then ``sample`` (ref TextFeature.scala keys)."""
+
+    @property
+    def text(self):
+        return self.get("text")
+
+
+class TextTransformer:
+    """Base stage (ref text/TextTransformer.scala)."""
+
+    def transform(self, feature: TextFeature) -> TextFeature:  # pragma: no cover
+        raise NotImplementedError
+
+    def __call__(self, feature):
+        return self.transform(feature)
+
+
+class Tokenizer(TextTransformer):
+    """Whitespace/word tokenizer (ref text/Tokenizer.scala)."""
+
+    _PAT = re.compile(r"[\w']+")
+
+    def transform(self, feature):
+        feature = TextFeature(feature)
+        feature["tokens"] = self._PAT.findall(feature["text"])
+        return feature
+
+
+class Normalizer(TextTransformer):
+    """Lower-case and strip punctuation/digits from tokens
+    (ref text/Normalizer.scala)."""
+
+    _TABLE = str.maketrans("", "", string.punctuation)
+
+    def transform(self, feature):
+        feature = TextFeature(feature)
+        toks = [t.lower().translate(self._TABLE) for t in feature["tokens"]]
+        feature["tokens"] = [t for t in toks if t]
+        return feature
+
+
+class WordIndexer(TextTransformer):
+    """tokens → int ids given a word→index map (1-based; 0 is the pad/OOV id,
+    matching ref TextSet.word2idx semantics where index starts at 1)."""
+
+    def __init__(self, vocab: Dict[str, int]):
+        self.vocab = vocab
+
+    def transform(self, feature):
+        feature = TextFeature(feature)
+        feature["indexed_tokens"] = [
+            self.vocab.get(t, 0) for t in feature["tokens"]]
+        return feature
+
+
+class SequenceShaper(TextTransformer):
+    """Pad/truncate to ``len`` (ref text/SequenceShaper.scala; trunc_mode
+    pre|post)."""
+
+    def __init__(self, len: int, trunc_mode: str = "pre", pad_element: int = 0):
+        self.len, self.trunc_mode, self.pad = len, trunc_mode, pad_element
+
+    def transform(self, feature):
+        feature = TextFeature(feature)
+        ids = feature["indexed_tokens"]
+        if len(ids) > self.len:
+            ids = ids[-self.len:] if self.trunc_mode == "pre" else ids[:self.len]
+        else:
+            ids = ids + [self.pad] * (self.len - len(ids))
+        feature["indexed_tokens"] = ids
+        return feature
+
+
+class TextFeatureToSample(TextTransformer):
+    """Pack ids (+label) into a sample (ref text/TextFeatureToSample.scala)."""
+
+    def transform(self, feature):
+        feature = TextFeature(feature)
+        sample = {"x": np.asarray(feature["indexed_tokens"], np.int32)}
+        if "label" in feature:
+            sample["y"] = np.asarray(feature["label"])
+        feature["sample"] = sample
+        return feature
+
+
+class TextSet:
+    """Sharded collection of TextFeatures with the standard NLP pipeline.
+
+    ``tokenize().normalize().word2idx().shape_sequence(l).generate_sample()``
+    mirrors ref TextSet.scala's stage methods."""
+
+    def __init__(self, shards: HostXShards,
+                 word_index: Optional[Dict[str, int]] = None):
+        self.shards = shards
+        self._word_index = word_index
+
+    # ---------- constructors ----------
+
+    @classmethod
+    def from_texts(cls, texts: Sequence[str], labels: Optional[Sequence] = None,
+                   num_shards: Optional[int] = None) -> "TextSet":
+        feats = []
+        for i, t in enumerate(texts):
+            f = TextFeature(text=t)
+            if labels is not None:
+                f["label"] = labels[i]
+            feats.append(f)
+        return cls(HostXShards.from_records(feats, num_shards))
+
+    @classmethod
+    def read(cls, path: str, num_shards: Optional[int] = None) -> "TextSet":
+        """Read a folder of ``<class>/<file>.txt`` (ref TextSet.read: text
+        classification layout, subfolder name = category)."""
+        texts, labels = [], []
+        classes = sorted(d for d in os.listdir(path)
+                         if os.path.isdir(os.path.join(path, d)))
+        label_map = {c: i for i, c in enumerate(classes)}
+        for c in classes:
+            cdir = os.path.join(path, c)
+            for fn in sorted(os.listdir(cdir)):
+                fp = os.path.join(cdir, fn)
+                if os.path.isfile(fp):
+                    with open(fp, "r", errors="ignore") as fh:
+                        texts.append(fh.read())
+                    labels.append(label_map[c])
+        return cls.from_texts(texts, labels, num_shards)
+
+    @classmethod
+    def read_csv(cls, path: str, num_shards: Optional[int] = None) -> "TextSet":
+        """Read ``id,text,label`` csv (ref TextSet.readCSV used by QA)."""
+        import pandas as pd
+        df = pd.read_csv(path)
+        cols = list(df.columns)
+        labels = df[cols[2]].tolist() if len(cols) > 2 else None
+        return cls.from_texts(df[cols[1]].astype(str).tolist(), labels,
+                              num_shards)
+
+    # ---------- pipeline stages ----------
+
+    def _map(self, fn, word_index=None) -> "TextSet":
+        return TextSet(
+            self.shards.transform_shard(lambda s: [fn(f) for f in s]),
+            word_index if word_index is not None else self._word_index)
+
+    def transform(self, transformer: TextTransformer) -> "TextSet":
+        return self._map(transformer.transform)
+
+    def tokenize(self) -> "TextSet":
+        return self.transform(Tokenizer())
+
+    def normalize(self) -> "TextSet":
+        return self.transform(Normalizer())
+
+    def word2idx(self, remove_topN: int = 0,
+                 max_words_num: int = -1,
+                 min_freq: int = 1,
+                 existing_map: Optional[Dict[str, int]] = None) -> "TextSet":
+        """Build the vocabulary and index tokens (ref TextSet.word2idx:
+        frequency-sorted, optional drop of top-N most frequent, cap, floor)."""
+        if existing_map is not None:
+            vocab = dict(existing_map)
+        else:
+            counter: Counter = Counter()
+            for shard in self.shards.collect():
+                for f in shard:
+                    counter.update(f["tokens"])
+            items = [(w, c) for w, c in counter.items() if c >= min_freq]
+            items.sort(key=lambda wc: (-wc[1], wc[0]))
+            items = items[remove_topN:]
+            if max_words_num > 0:
+                items = items[:max_words_num]
+            vocab = {w: i + 1 for i, (w, _) in enumerate(items)}
+        out = self._map(WordIndexer(vocab).transform, word_index=vocab)
+        return out
+
+    def shape_sequence(self, len: int, trunc_mode: str = "pre") -> "TextSet":
+        return self.transform(SequenceShaper(len, trunc_mode))
+
+    def generate_sample(self) -> "TextSet":
+        return self.transform(TextFeatureToSample())
+
+    # ---------- accessors ----------
+
+    def get_word_index(self) -> Optional[Dict[str, int]]:
+        return self._word_index
+
+    def get_texts(self) -> List[str]:
+        return [f["text"] for f in self._features()]
+
+    def get_labels(self) -> List:
+        return [f.get("label") for f in self._features()]
+
+    def get_samples(self) -> List[dict]:
+        return [f["sample"] for f in self._features()]
+
+    def _features(self) -> List[TextFeature]:
+        out = []
+        for shard in self.shards.collect():
+            out.extend(shard)
+        return out
+
+    def to_dataset(self):
+        """{'x','y'} ndarray shards for Estimator.fit."""
+        def pack(shard):
+            xs = np.stack([f["sample"]["x"] for f in shard])
+            out = {"x": xs}
+            if shard and "y" in shard[0]["sample"]:
+                out["y"] = np.stack([f["sample"]["y"] for f in shard])
+            return out
+        return self.shards.transform_shard(pack)
+
+
+def load_glove(path: str, vocab: Dict[str, int],
+               dim: int) -> np.ndarray:
+    """Load a GloVe-format embedding file into an (V+1, dim) matrix aligned
+    to ``vocab`` ids (ref WordEmbedding.scala:49 glove loading; row 0 = pad)."""
+    emb = np.random.RandomState(0).normal(0, 0.05,
+                                          (len(vocab) + 1, dim)).astype(np.float32)
+    emb[0] = 0.0
+    with open(path, "r", errors="ignore") as fh:
+        for line in fh:
+            parts = line.rstrip().split(" ")
+            if len(parts) != dim + 1:
+                continue
+            idx = vocab.get(parts[0])
+            if idx is not None:
+                emb[idx] = np.asarray(parts[1:], np.float32)
+    return emb

@@ -507,7 +507,8 @@ class _RNNBase(KerasLayer):
 
     def _make_cell(self):
         kwargs = {}
-        if self.activation not in ("tanh", None):
+        # activation=None means linear, like every other layer here
+        if self.activation != "tanh":
             kwargs["activation_fn"] = get_activation(self.activation)
         return self.cell_cls(features=self.output_dim, **kwargs)
 
@@ -664,9 +665,10 @@ class TimeDistributed(KerasLayer):
         self.layer = layer
 
     def make_module(self):
-        # the inner module inherits this wrapper's (canonicalised) name so
-        # the parameter tree stays deterministic across processes
-        self.layer.name = f"{self.name}_inner"
+        # a user-chosen inner name is kept (save/load keys on it); only an
+        # auto-generated one is replaced to keep the tree deterministic
+        if getattr(self.layer, "_auto_named", False):
+            self.layer.name = f"{self.name}_inner"
         return self.layer.make_module()
 
     def apply(self, module, args, train):

@@ -38,6 +38,9 @@ def blockwise_attention(q, k, v, causal: bool = False, block_k: int = 128):
         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
     scale = 1.0 / jnp.sqrt(d).astype(q.dtype)
     q_pos = jnp.arange(sq)
+    # bottom-right-aligned causal mask (query i sees keys <= i + sk - sq),
+    # matching _reference_attention's tril(k=sk-sq) KV-cache-decode semantics
+    causal_off = sk - sq
 
     def body(carry, kb):
         o, m, l = carry
@@ -47,7 +50,7 @@ def blockwise_attention(q, k, v, causal: bool = False, block_k: int = 128):
         k_pos = kb_idx * block_k + jnp.arange(block_k)
         valid = k_pos < sk
         if causal:
-            valid = valid[None, :] & (k_pos[None, :] <= q_pos[:, None])
+            valid = valid[None, :] & (k_pos[None, :] <= q_pos[:, None] + causal_off)
             s = jnp.where(valid[None, None, :, :], s, NEG_INF)
         else:
             s = jnp.where(valid[None, None, None, :], s, NEG_INF)
@@ -73,7 +76,8 @@ def blockwise_attention(q, k, v, causal: bool = False, block_k: int = 128):
 # ---------------------------------------------------------------- pallas fwd
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, o_scr, m_scr, l_scr, *,
-                      block_k: int, causal: bool, block_q: int, nk: int):
+                      block_k: int, causal: bool, block_q: int, nk: int,
+                      causal_off: int):
     import jax.experimental.pallas as pl
 
     qi = pl.program_id(1)
@@ -86,7 +90,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, o_scr, m_scr, l_scr, *,
         l_scr[...] = jnp.zeros_like(l_scr)
 
     # causal: a key block strictly in the future contributes nothing
-    live = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+    live = (ki * block_k <= qi * block_q + block_q - 1 + causal_off) \
+        if causal else True
 
     @pl.when(live)
     def _compute():
@@ -100,7 +105,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, o_scr, m_scr, l_scr, *,
                 jnp.int32, s.shape, 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+            s = jnp.where(k_pos <= q_pos + causal_off, s, NEG_INF)
         m = m_scr[:, 0]
         l = l_scr[:, 0]
         m_new = jnp.maximum(m, s.max(-1))
@@ -138,7 +143,8 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
     grid = (b * h, sq // block_q, nk)
     out = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, block_k=block_k,
-                          causal=causal, block_q=block_q, nk=nk),
+                          causal=causal, block_q=block_q, nk=nk,
+                          causal_off=sk - sq),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
         grid=grid,
         in_specs=[

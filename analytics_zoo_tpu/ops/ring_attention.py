@@ -37,8 +37,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
     qf = q.astype(jnp.float32)
     perm = [(i, (i + 1) % p) for i in range(p)]
 
-    def body(i, carry):
-        o, m, l, k_cur, v_cur = carry
+    def accum(i, o, m, l, k_cur, v_cur):
         # global index of the key block currently resident here
         src = (my - i) % p
         s = jnp.einsum("bqhd,bkhd->bhqk", qf,
@@ -54,15 +53,24 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
         l_new = l * corr + pr.sum(-1)
         o_new = o * corr[..., None] + jnp.einsum(
             "bhqk,bkhd->bhqd", pr, v_cur.astype(jnp.float32))
+        return o_new, m_new, l_new
+
+    def body(i, carry):
+        o, m, l, k_cur, v_cur = carry
+        o, m, l = accum(i, o, m, l, k_cur, v_cur)
         # rotate k/v one step around the ring (lax.ppermute over ICI)
         k_next = jax.lax.ppermute(k_cur, axis_name, perm)
         v_next = jax.lax.ppermute(v_cur, axis_name, perm)
-        return o_new, m_new, l_new, k_next, v_next
+        return o, m, l, k_next, v_next
 
     o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
     m0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, s_loc), jnp.float32)
-    o, m, l, _, _ = jax.lax.fori_loop(0, p, body, (o0, m0, l0, k, v))
+    # p-1 rotations; the block resident after the last rotation is consumed
+    # by a final accum outside the loop so no ppermute result is discarded
+    o, m, l, k_last, v_last = jax.lax.fori_loop(
+        0, p - 1, body, (o0, m0, l0, k, v))
+    o, m, l = accum(p - 1, o, m, l, k_last, v_last)
     out = o / jnp.maximum(l, 1e-37)[..., None]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 

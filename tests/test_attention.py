@@ -132,3 +132,24 @@ def test_flash_kernel_interpret_mode(orca_ctx):
         pl.pallas_call = orig
     ref = _reference(q, k, v, causal=True)
     np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-4)
+
+
+class TestCausalCrossLength:
+    """Regression: causal mask must be bottom-right aligned (KV-cache decode
+    semantics) in every implementation, not just _reference_attention."""
+
+    def test_blockwise_matches_reference_when_sq_ne_sk(self):
+        import numpy as np
+        import jax
+        from analytics_zoo_tpu.ops.attention import _reference_attention
+        from analytics_zoo_tpu.ops.flash_attention import blockwise_attention
+
+        rng = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(rng, 3)
+        q = jax.random.normal(kq, (1, 4, 2, 8))
+        k = jax.random.normal(kk, (1, 8, 2, 8))
+        v = jax.random.normal(kv, (1, 8, 2, 8))
+        ref = _reference_attention(q, k, v, causal=True)
+        blk = blockwise_attention(q, k, v, causal=True, block_k=4)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(blk),
+                                   rtol=2e-5, atol=2e-5)

@@ -1,0 +1,144 @@
+"""Tests for the image/text feature pipelines (mirrors ref test layout
+pyzoo/test/zoo/feature/)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.feature.image import (
+    ImageSet, ImageResize, ImageCenterCrop, ImageRandomCrop, ImageHFlip,
+    ImageChannelNormalize, ImageBrightness, ImageAspectScale,
+    ImageColorJitter, ImageExpand, ImageSetToSample, ChainedPreprocessing,
+    ImageMatToTensor, ImageRandomPreprocessing,
+)
+from analytics_zoo_tpu.feature.text import TextSet
+
+
+def _imgs(n=6, h=24, w=32):
+    rng = np.random.RandomState(0)
+    return [rng.randint(0, 255, (h, w, 3), dtype=np.uint8) for _ in range(n)]
+
+
+class TestImageSet:
+    def test_resize_crop_normalize_chain(self):
+        iset = ImageSet.from_arrays(_imgs(), labels=list(range(6)))
+        pipeline = ChainedPreprocessing([
+            ImageResize(16, 16),
+            ImageCenterCrop(8, 8),
+            ImageChannelNormalize(123, 117, 104, 58, 57, 57),
+            ImageMatToTensor(),
+            ImageSetToSample(),
+        ])
+        out = iset.transform(pipeline)
+        imgs = out.get_image()
+        assert all(im.shape == (8, 8, 3) for im in imgs)
+        assert all(im.dtype == np.float32 for im in imgs)
+        ds = out.to_dataset()
+        batch = ds.collect()[0]
+        assert batch["x"].ndim == 4 and batch["x"].shape[1:] == (8, 8, 3)
+        assert "y" in batch
+
+    def test_hflip_is_involution(self):
+        img = _imgs(1)[0]
+        flipped = ImageHFlip().apply_image(ImageHFlip().apply_image(img))
+        assert np.array_equal(flipped, img)
+
+    def test_aspect_scale_short_edge(self):
+        img = _imgs(1, 40, 80)[0]
+        out = ImageAspectScale(min_size=20, max_size=1000).apply_image(img)
+        assert min(out.shape[:2]) == 20
+        assert out.shape[1] / out.shape[0] == pytest.approx(2.0, abs=0.1)
+
+    def test_random_crop_and_jitter_shapes(self):
+        img = _imgs(1)[0]
+        out = ImageRandomCrop(10, 12).apply_image(img)
+        assert out.shape == (10, 12, 3)
+        out = ImageColorJitter().apply_image(img)
+        assert out.shape == img.shape
+
+    def test_expand_canvas(self):
+        img = _imgs(1, 10, 10)[0]
+        out = ImageExpand(min_expand_ratio=2.0, max_expand_ratio=2.0).apply_image(img)
+        assert out.shape == (20, 20, 3)
+
+    def test_random_preprocessing_prob0(self):
+        img = _imgs(1)[0]
+        f = {"image": img}
+        out = ImageRandomPreprocessing(ImageResize(4, 4), prob=0.0).transform(f)
+        assert out["image"].shape == img.shape
+
+    def test_brightness_delta(self):
+        img = np.zeros((4, 4, 3), np.float32)
+        out = ImageBrightness(10, 10).apply_image(img)
+        assert np.allclose(out, 10.0)
+
+    def test_read_from_disk_with_label(self, tmp_path):
+        from PIL import Image
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(2):
+                Image.fromarray(_imgs(1)[0]).save(d / f"{i}.png")
+        iset = ImageSet.read(str(tmp_path), with_label=True)
+        labels = sorted(iset.get_label())
+        assert labels == [0, 0, 1, 1]
+
+
+class TestTextSet:
+    TEXTS = [
+        "The quick brown fox jumps over the lazy dog",
+        "A quick movie about a lazy dog",
+        "the worst movie ever made, truly awful",
+        "an awful film about an awful dog",
+    ]
+
+    def test_full_pipeline(self):
+        ts = (TextSet.from_texts(self.TEXTS, labels=[0, 0, 1, 1])
+              .tokenize().normalize().word2idx().shape_sequence(6)
+              .generate_sample())
+        vocab = ts.get_word_index()
+        assert vocab and min(vocab.values()) == 1
+        samples = ts.get_samples()
+        assert all(s["x"].shape == (6,) for s in samples)
+        batch = ts.to_dataset().collect()[0]
+        assert batch["x"].dtype == np.int32
+        assert batch["x"].shape[1] == 6
+
+    def test_word2idx_options(self):
+        ts = TextSet.from_texts(self.TEXTS).tokenize().normalize()
+        v_all = ts.word2idx().get_word_index()
+        v_cap = ts.word2idx(max_words_num=3).get_word_index()
+        assert len(v_cap) == 3
+        # remove_topN drops the most frequent words
+        top_word = min(v_all, key=lambda w: v_all[w])
+        v_drop = ts.word2idx(remove_topN=1).get_word_index()
+        assert top_word not in v_drop
+
+    def test_existing_map_and_oov(self):
+        ts = (TextSet.from_texts(["hello unknownword"])
+              .tokenize().normalize()
+              .word2idx(existing_map={"hello": 1}))
+        feats = ts._features()
+        assert feats[0]["indexed_tokens"] == [1, 0]
+
+    def test_shape_trunc_modes(self):
+        ts = TextSet.from_texts(["a b c d e"]).tokenize().word2idx()
+        pre = ts.shape_sequence(3, "pre")._features()[0]["indexed_tokens"]
+        post = ts.shape_sequence(3, "post")._features()[0]["indexed_tokens"]
+        assert len(pre) == 3 and len(post) == 3 and pre != post
+
+    def test_read_folder(self, tmp_path):
+        for cls, txt in (("neg", "bad terrible"), ("pos", "good great")):
+            d = tmp_path / cls
+            d.mkdir()
+            (d / "a.txt").write_text(txt)
+        ts = TextSet.read(str(tmp_path))
+        assert sorted(ts.get_labels()) == [0, 1]
+
+    def test_load_glove(self, tmp_path):
+        from analytics_zoo_tpu.feature.text.textset import load_glove
+        p = tmp_path / "glove.txt"
+        p.write_text("hello 1.0 2.0\nworld 3.0 4.0\n")
+        emb = load_glove(str(p), {"hello": 1, "world": 2}, dim=2)
+        assert emb.shape == (3, 2)
+        assert np.allclose(emb[1], [1.0, 2.0])
+        assert np.allclose(emb[0], 0.0)
