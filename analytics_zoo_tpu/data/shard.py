@@ -66,16 +66,18 @@ class XShards:
         return out
 
     @staticmethod
+    def _default_num_shards() -> int:
+        from analytics_zoo_tpu.common.context import OrcaContext
+        try:
+            return OrcaContext.get_context().num_devices
+        except RuntimeError:
+            return 1
+
+    @staticmethod
     def from_records(records, num_shards: Optional[int] = None) -> "HostXShards":
         """Partition a flat list of opaque records (feature dicts, rows) into
         contiguous shards without descending into their structure."""
-        n = num_shards
-        if n is None:
-            from analytics_zoo_tpu.common.context import OrcaContext
-            try:
-                n = OrcaContext.get_context().num_devices
-            except RuntimeError:
-                n = 1
+        n = num_shards or HostXShards._default_num_shards()
         n = max(1, min(n, len(records))) if records else 1
         splits = np.array_split(np.arange(len(records)), n)
         return HostXShards([[records[i] for i in idx] for idx in splits])
@@ -86,13 +88,7 @@ class XShards:
         into shards (ref shard.py:73-127 splits along axis 0)."""
         import jax
 
-        n = num_shards
-        if n is None:
-            from analytics_zoo_tpu.common.context import OrcaContext
-            try:
-                n = OrcaContext.get_context().num_devices
-            except RuntimeError:
-                n = 1
+        n = num_shards or HostXShards._default_num_shards()
 
         leaves, treedef = jax.tree_util.tree_flatten(data)
         if not leaves:

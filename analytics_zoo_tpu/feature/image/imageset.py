@@ -71,7 +71,8 @@ class ImageSet:
         if os.path.isfile(path):
             paths = [path]
         else:
-            for root, _dirs, files in os.walk(path):
+            for root, dirs, files in os.walk(path):
+                dirs.sort()  # deterministic order across filesystems/hosts
                 for fn in sorted(files):
                     if fn.lower().endswith(_IMG_EXTS):
                         paths.append(os.path.join(root, fn))

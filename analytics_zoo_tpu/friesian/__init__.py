@@ -1,0 +1,3 @@
+"""Friesian: recsys feature engineering on the sharded data layer
+(TPU-native rebuild of ref ``pyzoo/zoo/friesian/`` + Scala
+``zoo/.../friesian/``)."""

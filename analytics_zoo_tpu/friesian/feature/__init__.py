@@ -1,0 +1,3 @@
+from analytics_zoo_tpu.friesian.feature.table import (  # noqa: F401
+    Table, FeatureTable, StringIndex,
+)

@@ -1,0 +1,490 @@
+"""Friesian FeatureTable: recsys tabular feature engineering.
+
+Rebuild of ref ``pyzoo/zoo/friesian/feature/table.py`` (Table/FeatureTable/
+StringIndex, 723 LoC) and the Scala kernels
+``zoo/.../friesian/feature/Utils.scala:27-167``. The reference runs on Spark
+DataFrames; here tables are ``HostXShards`` of pandas DataFrames, so every
+per-row op is an embarrassingly parallel shard transform and only the
+aggregations (string-index fit, median, min/max) do a gather. The output of
+a feature pipeline is fixed-shape int/float ndarrays ready for the jitted
+train step — padding/masking (``pad``/``mask``) is the ragged→static bridge.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu.data.shard import HostXShards
+
+
+def _as_list(x) -> List[str]:
+    return [x] if isinstance(x, str) else list(x)
+
+
+def _shard_seed(d: pd.DataFrame) -> int:
+    """Deterministic, shard-content-dependent RNG seed: equal-length shards
+    with different rows draw different randoms, and reruns reproduce."""
+    hashable = d.select_dtypes(exclude=["object"])
+    if hashable.shape[1] == 0:
+        hashable = d.astype(str)
+    h = pd.util.hash_pandas_object(hashable, index=False).to_numpy()
+    return int(h.sum() % np.uint64(2**31 - 1))
+
+
+class Table:
+    """Base distributed table (ref table.py:35)."""
+
+    def __init__(self, shards: HostXShards):
+        self.shards = shards
+
+    # ---------- constructors ----------
+
+    @classmethod
+    def from_pandas(cls, df: pd.DataFrame, num_shards: Optional[int] = None):
+        n = num_shards or 1
+        idx = np.array_split(np.arange(len(df)), max(1, n))
+        return cls(HostXShards([df.iloc[i].reset_index(drop=True) for i in idx]))
+
+    @classmethod
+    def read_parquet(cls, paths: Union[str, List[str]]):
+        """(ref table.py:285)"""
+        paths = _as_list(paths)
+        files = []
+        for p in paths:
+            if os.path.isdir(p):
+                files += [os.path.join(p, f) for f in sorted(os.listdir(p))
+                          if f.endswith(".parquet")]
+            else:
+                files.append(p)
+        dfs = [pd.read_parquet(f) for f in files]
+        return cls(HostXShards(dfs))
+
+    @classmethod
+    def read_json(cls, paths: Union[str, List[str]], cols=None):
+        """(ref table.py:296)"""
+        dfs = [pd.read_json(p, lines=True) for p in _as_list(paths)]
+        if cols:
+            dfs = [d[_as_list(cols)] for d in dfs]
+        return cls(HostXShards(dfs))
+
+    # ---------- internals ----------
+
+    def _clone(self, shards: HostXShards) -> "Table":
+        return type(self)(shards)
+
+    def _map(self, fn: Callable[[pd.DataFrame], pd.DataFrame]) -> "Table":
+        return self._clone(self.shards.transform_shard(fn))
+
+    def to_pandas(self) -> pd.DataFrame:
+        dfs = self.shards.collect()
+        return pd.concat(dfs, ignore_index=True) if dfs else pd.DataFrame()
+
+    def compute(self) -> "Table":
+        """(ref table.py:64 — materialize; shards are eager here)"""
+        self.shards.cache()
+        return self
+
+    @property
+    def df(self) -> pd.DataFrame:
+        return self.to_pandas()
+
+    @property
+    def schema(self):
+        return self.shards.collect()[0].dtypes
+
+    def size(self) -> int:
+        """(ref table.py:79)"""
+        return sum(len(s) for s in self.shards.collect())
+
+    def __len__(self):
+        return self.size()
+
+    # ---------- row/column ops ----------
+
+    def select(self, *cols) -> "Table":
+        cols = [c for group in cols for c in _as_list(group)]
+        return self._map(lambda d: d[cols])
+
+    def drop(self, *cols) -> "Table":
+        """(ref table.py:94)"""
+        drop = [c for group in cols for c in _as_list(group)]
+        return self._map(lambda d: d.drop(columns=drop))
+
+    def fillna(self, value, columns: Optional[Sequence[str]]) -> "Table":
+        """(ref table.py:106)"""
+        def fill(d):
+            d = d.copy()
+            cols = _as_list(columns) if columns else list(d.columns)
+            d[cols] = d[cols].fillna(value)
+            return d
+        return self._map(fill)
+
+    def dropna(self, columns=None, how="any", thresh=None) -> "Table":
+        """(ref table.py:132)"""
+        kw = {"thresh": thresh} if thresh is not None else {"how": how}
+        return self._map(lambda d: d.dropna(
+            subset=_as_list(columns) if columns else None,
+            **kw).reset_index(drop=True))
+
+    def distinct(self) -> "Table":
+        """(ref table.py:148; global dedup needs the gather)"""
+        full = self.to_pandas().drop_duplicates().reset_index(drop=True)
+        n = max(1, self.shards.num_partitions())
+        idx = np.array_split(np.arange(len(full)), n)
+        return self._clone(HostXShards(
+            [full.iloc[i].reset_index(drop=True) for i in idx]))
+
+    def filter(self, condition: Union[str, Callable]) -> "Table":
+        """(ref table.py:155; condition is a pandas query string or a
+        row-mask callable)"""
+        if callable(condition):
+            return self._map(
+                lambda d: d[condition(d)].reset_index(drop=True))
+        return self._map(lambda d: d.query(condition).reset_index(drop=True))
+
+    def rename(self, columns: Dict[str, str]) -> "Table":
+        """(ref table.py:252)"""
+        return self._map(lambda d: d.rename(columns=columns))
+
+    def clip(self, columns, min=None, max=None) -> "Table":
+        """(ref table.py:166)"""
+        cols = _as_list(columns)
+
+        def f(d):
+            d = d.copy()
+            d[cols] = d[cols].clip(lower=min, upper=max)
+            return d
+        return self._map(f)
+
+    def log(self, columns, clipping: bool = True) -> "Table":
+        """log(x + 1), clipping negatives to 0 first (ref table.py:188)"""
+        cols = _as_list(columns)
+
+        def f(d):
+            d = d.copy()
+            for c in cols:
+                v = d[c].astype(float)
+                if clipping:
+                    v = v.clip(lower=0)
+                d[c] = np.log1p(v)
+            return d
+        return self._map(f)
+
+    def median(self, columns) -> "Table":
+        """table of (column, median) (ref table.py:223)"""
+        cols = _as_list(columns)
+        full = self.to_pandas()
+        med = pd.DataFrame({"column": cols,
+                            "median": [full[c].median() for c in cols]})
+        return Table.from_pandas(med, 1)
+
+    def fill_median(self, columns) -> "Table":
+        """(ref table.py:206)"""
+        cols = _as_list(columns)
+        full = self.to_pandas()
+        meds = {c: full[c].median() for c in cols}
+
+        def f(d):
+            d = d.copy()
+            for c in cols:
+                d[c] = d[c].fillna(meds[c])
+            return d
+        return self._map(f)
+
+    def merge_cols(self, columns, target: str) -> "Table":
+        """merge columns into one array column (ref table.py:240)"""
+        cols = _as_list(columns)
+
+        def f(d):
+            d = d.copy()
+            d[target] = d[cols].values.tolist()
+            return d.drop(columns=cols)
+        return self._map(f)
+
+    def transform_python_udf(self, in_col, out_col, udf_func) -> "Table":
+        """(ref table.py:521)"""
+        def f(d):
+            d = d.copy()
+            d[out_col] = d[in_col].map(udf_func)
+            return d
+        return self._map(f)
+
+    def join(self, table: "Table", on=None, how="inner") -> "Table":
+        """(ref table.py:534; hash-join via the gathered right side —
+        the broadcast-join analog)"""
+        right = table.to_pandas()
+        on = _as_list(on) if on is not None else None
+        return self._map(lambda d: d.merge(right, on=on, how=how))
+
+    def show(self, n: int = 20, truncate: bool = True):
+        """(ref table.py:268)"""
+        print(self.to_pandas().head(n))
+
+    def write_parquet(self, path: str, mode: str = "overwrite"):
+        """(ref table.py:279)"""
+        os.makedirs(path, exist_ok=True)
+        for i, shard in enumerate(self.shards.collect()):
+            shard.to_parquet(os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def col_names(self) -> List[str]:
+        return list(self.shards.collect()[0].columns)
+
+
+class FeatureTable(Table):
+    """(ref table.py:282 FeatureTable)"""
+
+    # ---------- categorical encoding ----------
+
+    def gen_string_idx(self, columns, freq_limit: Optional[int] = None
+                       ) -> List["StringIndex"]:
+        """Build per-column StringIndex: value → 1-based id ordered by
+        frequency desc (ref table.py:326 + Utils.scala; ids of frequent
+        values are small so embedding tables stay cache-friendly).
+        ``freq_limit`` drops values seen fewer times."""
+        cols = _as_list(columns)
+        full = self.to_pandas()
+        out = []
+        for c in cols:
+            vc = full[c].dropna().value_counts()
+            if freq_limit:
+                vc = vc[vc >= int(freq_limit)]
+            idx_df = pd.DataFrame({
+                c: vc.index,
+                "id": np.arange(1, len(vc) + 1, dtype=np.int64)})
+            out.append(StringIndex(HostXShards([idx_df]), c))
+        return out
+
+    def encode_string(self, columns, indices) -> "FeatureTable":
+        """Replace string values by their index id; unseen → 0
+        (ref table.py:299)."""
+        cols = _as_list(columns)
+        if not isinstance(indices, list):
+            indices = [indices]
+        maps = []
+        for ind in indices:
+            if isinstance(ind, StringIndex):
+                maps.append(ind.to_dict())
+            else:
+                maps.append(dict(ind))
+
+        def f(d):
+            d = d.copy()
+            for c, m in zip(cols, maps):
+                d[c] = d[c].map(m).fillna(0).astype(np.int64)
+            return d
+        return self._map(f)
+
+    def gen_ind2ind(self, cols, indices) -> "FeatureTable":
+        """Table of the indexed projection of ``cols`` (ref table.py:356)."""
+        projected = self.encode_string(cols, indices).select(cols)
+        return FeatureTable(projected.shards)
+
+    def cross_columns(self, crossed_columns: List[List[str]],
+                      bucket_sizes: List[int]) -> "FeatureTable":
+        """Hash-cross column groups into buckets; new column is named
+        ``a_b`` (ref table.py:371, the wide-and-deep cross features)."""
+        def f(d):
+            d = d.copy()
+            for group, size in zip(crossed_columns, bucket_sizes):
+                name = "_".join(group)
+                joined = d[list(group)].astype(str).agg("_".join, axis=1)
+                # vectorized, deterministic across runs and hosts
+                d[name] = (pd.util.hash_pandas_object(joined, index=False)
+                           % np.uint64(size)).astype(np.int64)
+            return d
+        return self._map(f)
+
+    def category_encode(self, columns, freq_limit=None):
+        indices = self.gen_string_idx(columns, freq_limit)
+        return self.encode_string(columns, indices), indices
+
+    # ---------- numeric ----------
+
+    def normalize(self, columns) -> "FeatureTable":
+        """Global min-max scale to [0,1] (ref table.py:382 MinMaxScaler)."""
+        cols = _as_list(columns)
+        full = self.to_pandas()
+        lo = {c: float(full[c].min()) for c in cols}
+        hi = {c: float(full[c].max()) for c in cols}
+
+        def f(d):
+            d = d.copy()
+            for c in cols:
+                span = hi[c] - lo[c]
+                d[c] = 0.0 if span == 0 else (d[c] - lo[c]) / span
+            return d
+        return self._map(f)
+
+    # ---------- recsys sequence features ----------
+
+    def add_negative_samples(self, item_size: int, item_col: str = "item",
+                             label_col: str = "label", neg_num: int = 1
+                             ) -> "FeatureTable":
+        """Each row becomes 1 positive (label 1) + ``neg_num`` negatives with
+        a random different item (label 0) (ref table.py:429; item ids are
+        1-based like the string-index output)."""
+        def f(d):
+            rng = np.random.RandomState(_shard_seed(d))
+            rows = [d.assign(**{label_col: np.int64(1)})]
+            for _ in range(neg_num):
+                neg = d.copy()
+                rand = rng.randint(1, item_size, size=len(d))
+                # resample collisions with the positive item
+                pos = d[item_col].to_numpy()
+                coll = rand >= pos  # shift to skip the positive id
+                rand = np.where(coll, rand + 1, rand)
+                neg[item_col] = rand
+                neg[label_col] = np.int64(0)
+                rows.append(neg)
+            return pd.concat(rows, ignore_index=True)
+        return self._map(f)
+
+    def add_hist_seq(self, user_col: str, cols, sort_col: str = "time",
+                     min_len: int = 1, max_len: int = 100) -> "FeatureTable":
+        """Per user (sorted by ``sort_col``) attach the preceding visit
+        history as ``<col>_hist_seq`` lists; rows with history shorter than
+        ``min_len`` are dropped (ref table.py:443)."""
+        cols = _as_list(cols)
+        full = self.to_pandas().sort_values([user_col, sort_col])
+        out_rows = []
+        for _, grp in full.groupby(user_col, sort=False):
+            vals = {c: grp[c].tolist() for c in cols}
+            for i in range(len(grp)):
+                if i < min_len:
+                    continue
+                row = grp.iloc[i].to_dict()
+                for c in cols:
+                    row[f"{c}_hist_seq"] = vals[c][max(0, i - max_len):i]
+                out_rows.append(row)
+        out = pd.DataFrame(out_rows)
+        return FeatureTable.from_pandas(
+            out, self.shards.num_partitions()) if len(out) else \
+            FeatureTable(HostXShards([out]))
+
+    def add_neg_hist_seq(self, item_size: int, item_history_col: str,
+                         neg_num: int) -> "FeatureTable":
+        """For every history list attach ``neg_num`` random negative lists
+        of the same length as ``neg_<col>`` (ref table.py:458)."""
+        def f(d):
+            rng = np.random.RandomState(_shard_seed(d))
+            d = d.copy()
+            d[f"neg_{item_history_col}"] = [
+                [[int(x) for x in rng.randint(1, item_size + 1, size=len(h))]
+                 for _ in range(neg_num)]
+                for h in d[item_history_col]]
+            return d
+        return self._map(f)
+
+    def pad(self, padding_cols, seq_len: int = 100) -> "FeatureTable":
+        """Pad/truncate list columns to ``seq_len`` with 0
+        (ref table.py:473; the ragged→static-shape bridge for jit)."""
+        cols = _as_list(padding_cols)
+
+        def pad_one(h):
+            h = list(h)[:seq_len]
+            if h and isinstance(h[0], (list, np.ndarray)):
+                inner = len(h[0])
+                h = [list(x) for x in h]
+                return h + [[0] * inner] * (seq_len - len(h))
+            return h + [0] * (seq_len - len(h))
+
+        def f(d):
+            d = d.copy()
+            for c in cols:
+                d[c] = d[c].map(pad_one)
+            return d
+        return self._map(f)
+
+    def mask(self, mask_cols, seq_len: int = 100) -> "FeatureTable":
+        """Attach ``<col>_mask`` 0/1 validity vectors (ref table.py:485)."""
+        cols = _as_list(mask_cols)
+
+        def f(d):
+            d = d.copy()
+            for c in cols:
+                d[f"{c}_mask"] = d[c].map(
+                    lambda h: [1] * min(len(h), seq_len) +
+                              [0] * max(seq_len - len(h), 0))
+            return d
+        return self._map(f)
+
+    def mask_pad(self, padding_cols, mask_cols, seq_len: int = 100
+                 ) -> "FeatureTable":
+        """(ref table.py:508)"""
+        return self.mask(mask_cols, seq_len).pad(padding_cols, seq_len)
+
+    def add_length(self, col_name: str) -> "FeatureTable":
+        """Attach ``<col>_length`` (ref table.py:497)."""
+        def f(d):
+            d = d.copy()
+            d[f"{col_name}_length"] = d[col_name].map(len)
+            return d
+        return self._map(f)
+
+    def add_feature(self, item_cols, feature_tbl: "FeatureTable",
+                    default_value) -> "FeatureTable":
+        """Map item ids (scalars or lists) through a (key→feature) lookup
+        table; the lookup's first column is the key, second the feature
+        (ref table.py:548)."""
+        cols = _as_list(item_cols)
+        lookup_df = feature_tbl.to_pandas()
+        key_c, val_c = lookup_df.columns[:2]
+        lookup = dict(zip(lookup_df[key_c], lookup_df[val_c]))
+
+        def get(v):
+            if isinstance(v, (list, np.ndarray)):
+                return [lookup.get(x, default_value) for x in v]
+            return lookup.get(v, default_value)
+
+        def f(d):
+            d = d.copy()
+            for c in cols:
+                d[f"{c}_feature"] = d[c].map(get)
+            return d
+        return self._map(f)
+
+    # ---------- model feed ----------
+
+    def to_sharded_arrays(self, feature_cols, label_col: Optional[str] = None):
+        """{'x': [...], 'y': ...} ndarray shards for Estimator.fit."""
+        cols = _as_list(feature_cols)
+
+        def f(d):
+            xs = [np.stack(d[c].map(np.asarray).to_list())
+                  if d[c].map(lambda v: isinstance(v, (list, np.ndarray))).any()
+                  else d[c].to_numpy()
+                  for c in cols]
+            out = {"x": xs[0] if len(xs) == 1 else xs}
+            if label_col:
+                out["y"] = d[label_col].to_numpy()
+            return out
+        return self.shards.transform_shard(f)
+
+
+class StringIndex(Table):
+    """value→id mapping table (ref table.py:586)."""
+
+    def __init__(self, shards: HostXShards, col_name: str):
+        super().__init__(shards)
+        self.col_name = col_name
+
+    def _clone(self, shards):
+        return StringIndex(shards, self.col_name)
+
+    @classmethod
+    def read_parquet(cls, paths, col_name: Optional[str] = None):
+        """(ref table.py:596 — col name = the non-'id' column)"""
+        t = Table.read_parquet(paths)
+        cols = [c for c in t.col_names() if c != "id"]
+        return cls(t.shards, col_name or cols[0])
+
+    def to_dict(self) -> Dict:
+        df = self.to_pandas()
+        return dict(zip(df[self.col_name], df["id"]))
+
+    def size(self) -> int:
+        return super().size()

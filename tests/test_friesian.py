@@ -1,0 +1,165 @@
+"""Tests for Friesian FeatureTable (mirrors ref
+pyzoo/test/zoo/friesian/feature/test_table.py)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.friesian.feature import FeatureTable, StringIndex, Table
+
+
+def ratings_df():
+    return pd.DataFrame({
+        "user": [1, 1, 1, 2, 2, 3],
+        "item": [10, 11, 12, 10, 13, 11],
+        "time": [1, 2, 3, 1, 2, 1],
+        "price": [1.0, np.nan, 3.0, 4.0, 5.0, np.nan],
+        "cat": ["a", "b", "a", "c", "a", None],
+    })
+
+
+class TestTableBasics:
+    def test_size_select_drop_rename(self):
+        t = FeatureTable.from_pandas(ratings_df(), 2)
+        assert t.size() == 6
+        assert t.select("user", "item").col_names() == ["user", "item"]
+        assert "price" not in t.drop("price").col_names()
+        assert "u" in t.rename({"user": "u"}).col_names()
+
+    def test_fillna_dropna_fill_median(self):
+        t = FeatureTable.from_pandas(ratings_df(), 2)
+        assert t.fillna(0.0, ["price"]).to_pandas()["price"].isna().sum() == 0
+        assert t.dropna(["price"]).size() == 4
+        filled = t.fill_median("price").to_pandas()["price"]
+        assert filled.isna().sum() == 0
+        assert filled[1] == pytest.approx(3.5)  # median of 1,3,4,5
+
+    def test_clip_log_normalize(self):
+        t = FeatureTable.from_pandas(ratings_df(), 2).fillna(0.0, ["price"])
+        clipped = t.clip(["price"], min=2.0, max=4.0).to_pandas()["price"]
+        assert clipped.min() >= 2.0 and clipped.max() <= 4.0
+        logged = t.log(["price"]).to_pandas()["price"]
+        assert logged.max() == pytest.approx(np.log1p(5.0))
+        normed = t.normalize(["price"]).to_pandas()["price"]
+        assert normed.min() == 0.0 and normed.max() == 1.0
+
+    def test_filter_distinct_join(self):
+        t = FeatureTable.from_pandas(ratings_df(), 2)
+        assert t.filter("user == 1").size() == 3
+        assert t.filter(lambda d: d["user"] == 2).size() == 2
+        dup = FeatureTable.from_pandas(
+            pd.concat([ratings_df(), ratings_df()], ignore_index=True), 3)
+        assert dup.distinct().size() == 6
+        side = Table.from_pandas(pd.DataFrame({"user": [1, 2, 3],
+                                               "age": [20, 30, 40]}), 1)
+        joined = t.join(side, on="user").to_pandas()
+        assert "age" in joined.columns and len(joined) == 6
+
+    def test_merge_cols_and_udf(self):
+        t = FeatureTable.from_pandas(ratings_df(), 1).fillna(0, ["price"])
+        merged = t.merge_cols(["user", "item"], "ui").to_pandas()
+        assert merged["ui"][0] == [1, 10]
+        out = t.transform_python_udf("user", "user2", lambda u: u * 2)
+        assert out.to_pandas()["user2"].tolist() == [2, 2, 2, 4, 4, 6]
+
+    def test_parquet_roundtrip(self, tmp_path):
+        t = FeatureTable.from_pandas(ratings_df().drop(columns=["cat"]), 2)
+        t.write_parquet(str(tmp_path / "t"))
+        back = FeatureTable.read_parquet(str(tmp_path / "t"))
+        assert back.size() == 6
+
+
+class TestCategorical:
+    def test_gen_string_idx_and_encode(self):
+        t = FeatureTable.from_pandas(ratings_df(), 2)
+        [idx] = t.gen_string_idx("cat", freq_limit=None)
+        m = idx.to_dict()
+        assert m["a"] == 1  # most frequent gets id 1
+        assert set(m.values()) == {1, 2, 3}
+        enc = t.encode_string("cat", [idx]).to_pandas()
+        assert enc["cat"].tolist()[0] == 1
+        assert enc["cat"].tolist()[5] == 0  # None -> 0
+        assert enc["cat"].dtype == np.int64
+
+    def test_freq_limit(self):
+        t = FeatureTable.from_pandas(ratings_df(), 1)
+        [idx] = t.gen_string_idx("cat", freq_limit=2)
+        assert set(idx.to_dict().keys()) == {"a"}
+
+    def test_string_index_parquet_roundtrip(self, tmp_path):
+        t = FeatureTable.from_pandas(ratings_df(), 1)
+        [idx] = t.gen_string_idx("cat")
+        idx.write_parquet(str(tmp_path / "idx"))
+        back = StringIndex.read_parquet(str(tmp_path / "idx"))
+        assert back.col_name == "cat"
+        assert back.to_dict() == idx.to_dict()
+
+    def test_cross_columns(self):
+        t = FeatureTable.from_pandas(ratings_df(), 2)
+        crossed = t.cross_columns([["user", "item"]], [100]).to_pandas()
+        assert "user_item" in crossed.columns
+        assert crossed["user_item"].between(0, 99).all()
+        # deterministic
+        again = t.cross_columns([["user", "item"]], [100]).to_pandas()
+        assert crossed["user_item"].tolist() == again["user_item"].tolist()
+
+
+class TestSequenceFeatures:
+    def test_add_negative_samples(self):
+        t = FeatureTable.from_pandas(
+            pd.DataFrame({"user": [1, 2], "item": [3, 4]}), 1)
+        out = t.add_negative_samples(item_size=10, neg_num=2).to_pandas()
+        assert len(out) == 6
+        pos = out[out["label"] == 1]
+        neg = out[out["label"] == 0]
+        assert len(pos) == 2 and len(neg) == 4
+        # negatives never collide with the positive item of their row
+        for _, r in neg.iterrows():
+            orig = {1: 3, 2: 4}[r["user"]]
+            assert r["item"] != orig
+            assert 1 <= r["item"] <= 10
+
+    def test_add_hist_seq(self):
+        t = FeatureTable.from_pandas(ratings_df(), 2)
+        out = t.add_hist_seq("user", ["item"], sort_col="time",
+                             min_len=1, max_len=2)
+        df = out.to_pandas()
+        # user1 has rows at i=1,2; user2 at i=1; user3 none
+        assert len(df) == 3
+        u1 = df[df["user"] == 1].sort_values("time")
+        assert u1["item_hist_seq"].tolist() == [[10], [10, 11]]
+
+    def test_neg_hist_pad_mask_length(self):
+        t = FeatureTable.from_pandas(ratings_df(), 1)
+        out = t.add_hist_seq("user", ["item"], min_len=1, max_len=5)
+        out = out.add_neg_hist_seq(20, "item_hist_seq", neg_num=2)
+        df = out.to_pandas()
+        assert all(len(n) == 2 for n in df["neg_item_hist_seq"])
+        assert all(len(n[0]) == len(h) for n, h in
+                   zip(df["neg_item_hist_seq"], df["item_hist_seq"]))
+        out = out.add_length("item_hist_seq")
+        out = out.mask_pad(padding_cols=["item_hist_seq"],
+                           mask_cols=["item_hist_seq"], seq_len=4)
+        df = out.to_pandas()
+        assert all(len(h) == 4 for h in df["item_hist_seq"])
+        assert all(len(m) == 4 for m in df["item_hist_seq_mask"])
+        assert df["item_hist_seq_length"].tolist() == [1, 2, 1]
+
+    def test_add_feature(self):
+        t = FeatureTable.from_pandas(
+            pd.DataFrame({"item": [1, 2], "hist": [[1, 2], [2, 9]]}), 1)
+        lookup = FeatureTable.from_pandas(
+            pd.DataFrame({"item": [1, 2], "cat": [7, 8]}), 1)
+        out = t.add_feature(["item", "hist"], lookup, default_value=0)
+        df = out.to_pandas()
+        assert df["item_feature"].tolist() == [7, 8]
+        assert df["hist_feature"].tolist() == [[7, 8], [8, 0]]
+
+    def test_to_sharded_arrays(self):
+        t = FeatureTable.from_pandas(
+            pd.DataFrame({"user": [1, 2, 3, 4], "item": [5, 6, 7, 8],
+                          "label": [1, 0, 1, 0]}), 2)
+        ds = t.to_sharded_arrays(["user", "item"], "label")
+        batch = ds.collect()[0]
+        assert isinstance(batch["x"], list) and len(batch["x"]) == 2
+        assert batch["y"].shape == (2,)
