@@ -1,0 +1,3 @@
+"""Zouwu: scalable time-series analysis (TPU-native rebuild of ref
+``pyzoo/zoo/zouwu/`` — forecasters, feature transform, anomaly detection,
+AutoTS)."""

@@ -1,0 +1,3 @@
+from analytics_zoo_tpu.zouwu.feature.time_sequence import (  # noqa: F401
+    TimeSequenceFeatureTransformer,
+)

@@ -1,0 +1,3 @@
+from analytics_zoo_tpu.zouwu.model.nets import (  # noqa: F401
+    VanillaLSTMNet, Seq2SeqNet, TemporalConvNet, MTNetModule,
+)

@@ -1,0 +1,125 @@
+"""Anomaly detectors (ref ``pyzoo/zoo/zouwu/model/anomaly/anomaly.py``,
+171 LoC: ThresholdDetector, AEDetector, DBScanDetector)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class ThresholdDetector:
+    """Flag |y - y_hat| (or raw y) outside a threshold. ``fit`` derives the
+    threshold as mean + ratio·std of the residuals (ref anomaly.py
+    ThresholdDetector: absolute threshold or (mode, ratio) estimation)."""
+
+    def __init__(self, mode: str = "default", ratio: float = 3.0,
+                 threshold: Optional[float] = None):
+        self.mode = mode
+        self.ratio = ratio
+        self.threshold = threshold
+
+    def fit(self, y: np.ndarray, y_pred: Optional[np.ndarray] = None):
+        res = np.abs(y - y_pred) if y_pred is not None else np.abs(y)
+        self.threshold = float(res.mean() + self.ratio * res.std())
+        return self
+
+    def score(self, y: np.ndarray, y_pred: Optional[np.ndarray] = None
+              ) -> np.ndarray:
+        return np.abs(y - y_pred) if y_pred is not None else np.abs(y)
+
+    def anomaly_indexes(self, y: np.ndarray,
+                        y_pred: Optional[np.ndarray] = None) -> np.ndarray:
+        if self.threshold is None:
+            raise RuntimeError("fit first or pass threshold explicitly")
+        return np.nonzero(self.score(y, y_pred) > self.threshold)[0]
+
+
+class AEDetector:
+    """Autoencoder reconstruction-error detector (ref anomaly.py AEDetector).
+
+    Windows the series, trains a small flax MLP autoencoder through the zoo
+    Estimator, and flags the top ``anomaly_ratio`` fraction of windows by
+    reconstruction error."""
+
+    def __init__(self, roll_len: int = 24, hidden: Tuple[int, ...] = (16, 8),
+                 anomaly_ratio: float = 0.05, epochs: int = 5,
+                 batch_size: int = 32, seed: int = 0):
+        self.roll_len = roll_len
+        self.hidden = tuple(hidden)
+        self.anomaly_ratio = anomaly_ratio
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+        self._est = None
+        self._mu = self._sigma = None
+
+    def _windows(self, y: np.ndarray) -> np.ndarray:
+        n = len(y) - self.roll_len + 1
+        if n <= 0:
+            raise ValueError(f"series shorter than roll_len={self.roll_len}")
+        idx = np.arange(self.roll_len)[None, :] + np.arange(n)[:, None]
+        return y[idx].astype(np.float32)
+
+    def fit(self, y: np.ndarray):
+        import flax.linen as nn
+
+        from analytics_zoo_tpu.learn.estimator import Estimator
+
+        y = np.asarray(y, np.float32).ravel()
+        self._mu, self._sigma = float(y.mean()), float(y.std() or 1.0)
+        w = (self._windows(y) - self._mu) / self._sigma
+
+        hidden, roll_len = self.hidden, self.roll_len
+
+        class _AE(nn.Module):
+            @nn.compact
+            def __call__(self, x, train: bool = False):
+                h = x
+                for units in hidden:
+                    h = nn.relu(nn.Dense(units)(h))
+                for units in reversed(hidden[:-1]):
+                    h = nn.relu(nn.Dense(units)(h))
+                return nn.Dense(roll_len)(h)
+
+        self._est = Estimator.from_flax(
+            model=_AE(), loss=lambda yt, yp: ((yt - yp) ** 2).mean(),
+            sample_input=w[:1], seed=self.seed)
+        self._est.fit((w, w), epochs=self.epochs,
+                      batch_size=min(self.batch_size, len(w)))
+        return self
+
+    def score(self, y: np.ndarray) -> np.ndarray:
+        """Per-timestep anomaly score = mean reconstruction error of the
+        windows covering that step."""
+        y = np.asarray(y, np.float32).ravel()
+        w = (self._windows(y) - self._mu) / self._sigma
+        rec = np.asarray(self._est.predict(w, batch_size=256))
+        err = ((rec - w) ** 2).mean(1)                    # per window
+        # spread window scores back over timesteps
+        score = np.zeros(len(y))
+        count = np.zeros(len(y))
+        for i, e in enumerate(err):
+            score[i:i + self.roll_len] += e
+            count[i:i + self.roll_len] += 1
+        return score / np.maximum(count, 1)
+
+    def anomaly_indexes(self, y: np.ndarray) -> np.ndarray:
+        s = self.score(y)
+        k = max(1, int(len(s) * self.anomaly_ratio))
+        return np.sort(np.argsort(s)[-k:])
+
+
+class DBScanDetector:
+    """Density-based outlier detection (ref anomaly.py DBScanDetector;
+    sklearn DBSCAN labels -1 = anomaly)."""
+
+    def __init__(self, eps: float = 0.5, min_samples: int = 5):
+        self.eps, self.min_samples = eps, min_samples
+
+    def anomaly_indexes(self, y: np.ndarray) -> np.ndarray:
+        from sklearn.cluster import DBSCAN
+        y = np.asarray(y, np.float32).reshape(len(y), -1)
+        labels = DBSCAN(eps=self.eps,
+                        min_samples=self.min_samples).fit_predict(y)
+        return np.nonzero(labels == -1)[0]

@@ -1,0 +1,159 @@
+"""Forecaster API (ref ``pyzoo/zoo/zouwu/model/forecast/`` — LSTMForecaster,
+Seq2SeqForecaster, TCNForecaster, MTNetForecaster wrap tfpark KerasModels
+there; here each wraps a flax module trained through the zoo Estimator, so
+fit runs as one jitted data-parallel train step on the mesh)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.learn import losses as losses_lib
+from analytics_zoo_tpu.learn.estimator import Estimator
+from analytics_zoo_tpu.learn.metrics import MAE, MSE
+from analytics_zoo_tpu.zouwu.model.nets import (
+    MTNetModule, Seq2SeqNet, TemporalConvNet, VanillaLSTMNet,
+)
+
+_EVAL_METRICS = {"mse": MSE, "mae": MAE}
+
+
+class Forecaster:
+    """Common fit/predict/evaluate surface (ref forecast.py Forecaster
+    base; sklearn-style like the reference's)."""
+
+    def __init__(self, *, optimizer="adam", loss="mse",
+                 model_dir: Optional[str] = None, seed: int = 0):
+        self.optimizer = optimizer
+        self.loss = loss
+        self.model_dir = model_dir
+        self.seed = seed
+        self._est: Optional[object] = None
+
+    # subclasses implement
+    def _build_module(self, x: np.ndarray):  # pragma: no cover
+        raise NotImplementedError
+
+    def _ensure_est(self, x: np.ndarray):
+        if self._est is None:
+            module = self._build_module(x)
+            self._est = Estimator.from_flax(
+                model=module, loss=losses_lib.get(self.loss),
+                optimizer=self.optimizer, metrics=None,
+                sample_input=x[:1], model_dir=self.model_dir,
+                seed=self.seed)
+        return self._est
+
+    def fit(self, x: np.ndarray, y: np.ndarray, epochs: int = 1,
+            batch_size: int = 32, validation_data=None, **kwargs):
+        """x: [n, lookback, F]; y: [n, horizon]."""
+        est = self._ensure_est(x)
+        return est.fit((x, y), epochs=epochs, batch_size=batch_size,
+                       validation_data=validation_data, **kwargs)
+
+    def predict(self, x: np.ndarray, batch_size: int = 256) -> np.ndarray:
+        if self._est is None:
+            raise RuntimeError("call fit (or restore) before predict")
+        return np.asarray(self._est.predict(x, batch_size=batch_size))
+
+    def evaluate(self, x: np.ndarray, y: np.ndarray,
+                 metrics: Sequence[str] = ("mse",),
+                 batch_size: int = 256) -> dict:
+        pred = self.predict(x, batch_size)
+        out = {}
+        for m in metrics:
+            if m == "mse":
+                out[m] = float(np.mean((pred - y) ** 2))
+            elif m == "mae":
+                out[m] = float(np.mean(np.abs(pred - y)))
+            elif m == "rmse":
+                out[m] = float(np.sqrt(np.mean((pred - y) ** 2)))
+            elif m in ("smape",):
+                out[m] = float(np.mean(
+                    2 * np.abs(pred - y) /
+                    np.maximum(np.abs(pred) + np.abs(y), 1e-8)) * 100)
+            else:
+                raise ValueError(f"unknown metric {m}")
+        return out
+
+    def save(self, path: str):
+        self._est.save(path)
+
+    def restore(self, path: str, sample_x: Optional[np.ndarray] = None):
+        if self._est is None:
+            if sample_x is None:
+                raise ValueError("pass sample_x to restore an unbuilt model")
+            self._ensure_est(sample_x)
+        self._est.load(path)
+
+
+class LSTMForecaster(Forecaster):
+    """(ref forecast/LSTMForecaster)"""
+
+    def __init__(self, target_dim: int = 1,
+                 lstm_units: Tuple[int, ...] = (32, 32),
+                 dropouts: Tuple[float, ...] = (0.2, 0.2), **kwargs):
+        super().__init__(**kwargs)
+        self.target_dim = target_dim
+        self.lstm_units = tuple(lstm_units)
+        self.dropouts = tuple(dropouts)
+
+    def _build_module(self, x):
+        return VanillaLSTMNet(output_dim=self.target_dim,
+                              lstm_units=self.lstm_units,
+                              dropouts=self.dropouts)
+
+
+class Seq2SeqForecaster(Forecaster):
+    """(ref forecast/Seq2SeqForecaster)"""
+
+    def __init__(self, future_seq_len: int = 1, latent_dim: int = 64,
+                 dropout: float = 0.2, **kwargs):
+        super().__init__(**kwargs)
+        self.future_seq_len = future_seq_len
+        self.latent_dim = latent_dim
+        self.dropout = dropout
+
+    def _build_module(self, x):
+        return Seq2SeqNet(future_seq_len=self.future_seq_len,
+                          latent_dim=self.latent_dim, dropout=self.dropout)
+
+
+class TCNForecaster(Forecaster):
+    """(ref forecast/TCNForecaster → zouwu/model/tcn.py)"""
+
+    def __init__(self, future_seq_len: int = 1,
+                 num_channels: Tuple[int, ...] = (30, 30, 30),
+                 kernel_size: int = 7, dropout: float = 0.2, **kwargs):
+        super().__init__(**kwargs)
+        self.future_seq_len = future_seq_len
+        self.num_channels = tuple(num_channels)
+        self.kernel_size = kernel_size
+        self.dropout = dropout
+
+    def _build_module(self, x):
+        return TemporalConvNet(future_seq_len=self.future_seq_len,
+                               num_channels=self.num_channels,
+                               kernel_size=self.kernel_size,
+                               dropout=self.dropout)
+
+
+class MTNetForecaster(Forecaster):
+    """(ref forecast/MTNetForecaster; input seq len must equal
+    (long_series_num + 1) * series_length)"""
+
+    def __init__(self, future_seq_len: int = 1, long_series_num: int = 4,
+                 series_length: int = 8, cnn_hid_size: int = 32,
+                 rnn_hid_size: int = 32, ar_window: int = 4,
+                 cnn_kernel_size: int = 3, dropout: float = 0.1, **kwargs):
+        super().__init__(**kwargs)
+        self.kw = dict(future_seq_len=future_seq_len,
+                       long_series_num=long_series_num,
+                       series_length=series_length,
+                       cnn_hid_size=cnn_hid_size,
+                       rnn_hid_size=rnn_hid_size, ar_window=ar_window,
+                       cnn_kernel_size=cnn_kernel_size, dropout=dropout)
+
+    def _build_module(self, x):
+        return MTNetModule(**self.kw)

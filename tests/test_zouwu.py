@@ -1,0 +1,172 @@
+"""Tests for Zouwu time-series (mirrors ref pyzoo/test/zoo/zouwu/)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.zouwu.feature import TimeSequenceFeatureTransformer
+from analytics_zoo_tpu.zouwu.model.forecast import (
+    LSTMForecaster, MTNetForecaster, Seq2SeqForecaster, TCNForecaster,
+)
+from analytics_zoo_tpu.zouwu.model.anomaly import (
+    AEDetector, DBScanDetector, ThresholdDetector,
+)
+from analytics_zoo_tpu.zouwu.model.tcmf import TCMFForecaster
+
+
+def sine_df(n=200, freq="h"):
+    t = pd.date_range("2024-01-01", periods=n, freq=freq)
+    rng = np.random.RandomState(0)
+    v = np.sin(np.arange(n) * 2 * np.pi / 24) + rng.normal(0, 0.05, n)
+    return pd.DataFrame({"datetime": t, "value": v})
+
+
+class TestFeatureTransformer:
+    def test_fit_transform_shapes(self):
+        tf = TimeSequenceFeatureTransformer(past_seq_len=24, future_seq_len=3)
+        x, y = tf.fit_transform(sine_df())
+        assert x.shape == (200 - 24 - 3 + 1, 24, tf.n_features)
+        assert y.shape == (174, 3)
+        assert x.dtype == np.float32
+
+    def test_scaling_and_unscale(self):
+        tf = TimeSequenceFeatureTransformer(past_seq_len=10, future_seq_len=1)
+        df = sine_df()
+        x, y = tf.fit_transform(df)
+        assert x[..., 0].min() >= 0.0 and x[..., 0].max() <= 1.0
+        back = tf.unscale_y(y)
+        lo, hi = df["value"].min(), df["value"].max()
+        assert back.min() == pytest.approx(lo, abs=1e-4) or back.min() >= lo - 1e-4
+
+    def test_transform_uses_train_scale(self):
+        tf = TimeSequenceFeatureTransformer(past_seq_len=10)
+        train, test = sine_df(150), sine_df(60)
+        tf.fit_transform(train)
+        x, y = tf.transform(test)
+        assert x.shape[1] == 10
+        x_only = tf.transform(test, with_y=False)
+        # without labels the last horizon rows also yield windows
+        assert x_only.shape[0] == x.shape[0] + tf.future_seq_len
+        assert x_only.shape[1:] == x.shape[1:]
+
+    def test_extra_features_and_no_dt(self):
+        df = sine_df()
+        df["extra"] = np.arange(len(df), dtype=float)
+        tf = TimeSequenceFeatureTransformer(
+            past_seq_len=8, extra_features_col=["extra"],
+            with_dt_features=False)
+        x, y = tf.fit_transform(df)
+        assert x.shape[-1] == 2
+
+    def test_save_restore(self, tmp_path):
+        tf = TimeSequenceFeatureTransformer(past_seq_len=12, future_seq_len=2)
+        tf.fit_transform(sine_df())
+        tf.save(str(tmp_path / "tf"))
+        tf2 = TimeSequenceFeatureTransformer()
+        tf2.restore(str(tmp_path / "tf"))
+        assert tf2.past_seq_len == 12 and tf2.future_seq_len == 2
+        x, y = tf2.transform(sine_df(80))
+        assert x.shape[1] == 12
+
+
+def _xy(n=96, lookback=16, horizon=2, feats=3):
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=(n, lookback, feats)).astype(np.float32)
+    y = x[:, -horizon:, 0] * 0.5 + 0.1
+    return x, y.astype(np.float32)
+
+
+class TestForecasters:
+    def test_lstm_forecaster(self):
+        x, y = _xy(horizon=1)
+        f = LSTMForecaster(target_dim=1, lstm_units=(8,), dropouts=(0.0,))
+        hist = f.fit(x, y[:, :1], epochs=2, batch_size=16)
+        assert len(hist["loss"]) == 2
+        pred = f.predict(x)
+        assert pred.shape == (len(x), 1)
+        ev = f.evaluate(x, y[:, :1], metrics=["mse", "mae", "smape"])
+        assert set(ev) == {"mse", "mae", "smape"}
+
+    def test_tcn_forecaster_learns(self):
+        from analytics_zoo_tpu.learn.optimizers import Adam
+        x, y = _xy(n=128, horizon=2)
+        f = TCNForecaster(future_seq_len=2, num_channels=(8, 8),
+                          kernel_size=3, dropout=0.0,
+                          optimizer=Adam(learningrate=0.01))
+        f.fit(x, y, epochs=20, batch_size=16)
+        final = f.evaluate(x, y)["mse"]
+        assert final < 0.05  # learnable linear map
+
+    def test_seq2seq_forecaster(self):
+        x, y = _xy(horizon=3)
+        f = Seq2SeqForecaster(future_seq_len=3, latent_dim=8, dropout=0.0)
+        f.fit(x, y, epochs=2, batch_size=16)
+        assert f.predict(x).shape == (len(x), 3)
+
+    def test_mtnet_forecaster(self):
+        # seq len must be (n+1)*T = (3+1)*4 = 16
+        x, y = _xy(n=64, lookback=16, horizon=1)
+        f = MTNetForecaster(future_seq_len=1, long_series_num=3,
+                            series_length=4, cnn_hid_size=8, rnn_hid_size=8,
+                            ar_window=3)
+        f.fit(x, y[:, :1], epochs=2, batch_size=16)
+        assert f.predict(x).shape == (len(x), 1)
+
+    def test_save_restore_roundtrip(self, tmp_path):
+        x, y = _xy(horizon=1)
+        f = TCNForecaster(future_seq_len=1, num_channels=(4,), kernel_size=3)
+        f.fit(x, y[:, :1], epochs=1, batch_size=16)
+        p1 = f.predict(x)
+        f.save(str(tmp_path / "m"))
+        g = TCNForecaster(future_seq_len=1, num_channels=(4,), kernel_size=3)
+        g.restore(str(tmp_path / "m"), sample_x=x)
+        np.testing.assert_allclose(p1, g.predict(x), rtol=1e-5, atol=1e-5)
+
+
+class TestTCMF:
+    def test_fit_predict(self):
+        rng = np.random.RandomState(0)
+        t = np.arange(120)
+        basis = np.stack([np.sin(t * 2 * np.pi / 24),
+                          np.cos(t * 2 * np.pi / 24)])
+        F = rng.normal(size=(20, 2))
+        y = F @ basis + rng.normal(0, 0.01, (20, 120))
+        m = TCMFForecaster(k=4, ar_order=24, lr=0.05)
+        mse = m.fit(y[:, :96], num_steps=400)
+        assert mse < 0.1
+        pred = m.predict(horizon=24)
+        assert pred.shape == (20, 24)
+        # forecast should track the periodic structure reasonably
+        assert np.mean((pred - y[:, 96:]) ** 2) < np.mean(y[:, 96:] ** 2)
+
+
+class TestAnomaly:
+    def test_threshold_detector(self):
+        rng = np.random.RandomState(0)
+        y = rng.normal(0, 1, 500)
+        y[[50, 300]] += 12.0
+        det = ThresholdDetector(ratio=4.0).fit(y)
+        idx = det.anomaly_indexes(y)
+        assert set([50, 300]).issubset(set(idx.tolist()))
+
+    def test_threshold_with_forecast(self):
+        y = np.zeros(100)
+        y_pred = np.zeros(100)
+        y[10] = 5.0
+        det = ThresholdDetector(threshold=1.0)
+        assert det.anomaly_indexes(y, y_pred).tolist() == [10]
+
+    def test_ae_detector(self):
+        rng = np.random.RandomState(0)
+        y = np.sin(np.arange(300) * 2 * np.pi / 24) + rng.normal(0, 0.02, 300)
+        y[150:153] += 6.0
+        det = AEDetector(roll_len=12, hidden=(8, 4), anomaly_ratio=0.03,
+                         epochs=4)
+        det.fit(y)
+        idx = det.anomaly_indexes(y)
+        assert any(148 <= i <= 155 for i in idx)
+
+    def test_dbscan_detector(self):
+        y = np.concatenate([np.zeros(100), [10.0], np.zeros(100)])
+        idx = DBScanDetector(eps=0.5, min_samples=3).anomaly_indexes(y)
+        assert 100 in idx
