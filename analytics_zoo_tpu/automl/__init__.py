@@ -1,0 +1,27 @@
+"""AutoML — hyperparameter search over host-parallel trials.
+
+Replaces the reference's Ray-Tune-based stack (ref
+pyzoo/zoo/automl/search/ray_tune_search_engine.py:36,
+pyzoo/zoo/orca/automl/auto_estimator.py:20-125): instead of Ray actors, each
+trial is a jitted training run scheduled on the local host(s); the search
+loop, sampling DSL, early-stopping scheduler and checkpointing are
+self-contained.
+"""
+
+from analytics_zoo_tpu.automl import hp
+from analytics_zoo_tpu.automl.auto_estimator import AutoEstimator
+from analytics_zoo_tpu.automl.metrics import Evaluator
+from analytics_zoo_tpu.automl.search import (
+    LocalSearchEngine,
+    SearchEngine,
+    Trial,
+)
+
+__all__ = [
+    "hp",
+    "AutoEstimator",
+    "Evaluator",
+    "SearchEngine",
+    "LocalSearchEngine",
+    "Trial",
+]

@@ -1,0 +1,86 @@
+"""AutoEstimator — sklearn-style hyperparameter search entry point.
+
+API-parity with ``zoo.orca.automl.auto_estimator.AutoEstimator`` (ref
+pyzoo/zoo/orca/automl/auto_estimator.py:20-125: ``from_torch``/``from_keras``
+constructors, ``fit(data, search_space, n_sampling, epochs, metric)``,
+``get_best_model``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from analytics_zoo_tpu.automl.model_builder import (
+    FlaxModelBuilder,
+    KerasModelBuilder,
+    ModelBuilder,
+)
+from analytics_zoo_tpu.automl.search import LocalSearchEngine
+
+
+class AutoEstimator:
+    def __init__(self, model_builder: ModelBuilder,
+                 logs_dir: str = "/tmp/analytics_zoo_tpu_automl",
+                 name: str = "auto_estimator", seed: int = 0):
+        self.builder = model_builder
+        self.engine = LocalSearchEngine(model_builder, logs_dir=logs_dir,
+                                        name=name, seed=seed)
+        self._best_trial = None
+        self._best_model = None
+
+    @staticmethod
+    def from_flax(*, model_creator: Callable[[dict], object],
+                  loss_creator: Optional[Callable] = None,
+                  optimizer_creator: Optional[Callable] = None,
+                  logs_dir: str = "/tmp/analytics_zoo_tpu_automl",
+                  name: str = "auto_flax", seed: int = 0) -> "AutoEstimator":
+        """``model_creator(config) -> flax module`` (the ``from_torch`` /
+        ``from_keras`` analog for the TPU-native compute path)."""
+        return AutoEstimator(
+            FlaxModelBuilder(model_creator, loss_creator, optimizer_creator),
+            logs_dir=logs_dir, name=name, seed=seed)
+
+    @staticmethod
+    def from_keras(*, model_creator: Callable[[dict], object],
+                   logs_dir: str = "/tmp/analytics_zoo_tpu_automl",
+                   name: str = "auto_keras", seed: int = 0) -> "AutoEstimator":
+        """``model_creator(config) -> compiled zoo-keras model`` (ref
+        auto_estimator.py:from_keras)."""
+        return AutoEstimator(KerasModelBuilder(model_creator),
+                             logs_dir=logs_dir, name=name, seed=seed)
+
+    def fit(self, data, validation_data=None, search_space: dict = None,
+            n_sampling: int = 1, epochs: int = 1, metric: str = "mse",
+            mode: Optional[str] = None, scheduler: Optional[str] = None,
+            batch_size: Optional[int] = None) -> "AutoEstimator":
+        """``data``: ``(x, y)`` numpy pair (the reference also accepts
+        XShards/DataFrames — use ``.to_numpy()`` paths upstream)."""
+        if search_space is None:
+            raise ValueError("search_space is required")
+        self._best_trial = None
+        self._best_model = None
+        self.engine.compile(data, search_space, n_sampling=n_sampling,
+                            epochs=epochs, validation_data=validation_data,
+                            metric=metric, mode=mode, scheduler=scheduler,
+                            batch_size=batch_size)
+        self.engine.run()
+        self._best_trial = self.engine.get_best_trial()
+        return self
+
+    def get_best_trial(self):
+        if self._best_trial is None:
+            raise RuntimeError("fit first")
+        return self._best_trial
+
+    def get_best_config(self) -> dict:
+        return dict(self.get_best_trial().config)
+
+    def get_best_model(self):
+        """Rebuild the best config's model and restore its checkpoint."""
+        if self._best_model is None:
+            trial = self.get_best_trial()
+            model = self.builder.build(trial.config)
+            x = self.engine.data[0]
+            model.restore(trial.checkpoint, sample_x=x)
+            self._best_model = model
+        return self._best_model

@@ -1,0 +1,183 @@
+"""Search engine — trial scheduling, sampling, early stopping.
+
+Replaces ``RayTuneSearchEngine`` (ref
+pyzoo/zoo/automl/search/ray_tune_search_engine.py:36: trainables as Ray
+actors, tune schedulers, ``TrialStopper``). Here trials run on the host
+driving the one TPU mesh — sequentially by default (the mesh is the scarce
+resource, not CPU workers) with an optional thread pool — and a
+median-stopping rule replaces the tune scheduler.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.automl import hp
+from analytics_zoo_tpu.automl.metrics import Evaluator
+from analytics_zoo_tpu.automl.model_builder import ModelBuilder
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Trial:
+    trial_id: int
+    config: dict
+    metric_history: List[float] = field(default_factory=list)
+    best_metric: Optional[float] = None
+    status: str = "pending"           # pending|running|done|stopped|error
+    error: Optional[str] = None
+    checkpoint: Optional[str] = None
+    wall_s: float = 0.0
+
+    @property
+    def last_metric(self):
+        return self.metric_history[-1] if self.metric_history else None
+
+
+class SearchEngine:
+    """Abstract search engine (ref automl/search/base.py SearchEngine)."""
+
+    def compile(self, data, search_space, n_sampling=1, epochs=1, **kwargs):
+        raise NotImplementedError
+
+    def run(self) -> List[Trial]:
+        raise NotImplementedError
+
+    def get_best_trial(self) -> Trial:
+        raise NotImplementedError
+
+
+class MedianStopper:
+    """Stop a trial whose metric at epoch *e* is worse than the running
+    median of completed trials at the same epoch (tune MedianStoppingRule
+    analog; grace_period epochs always run)."""
+
+    def __init__(self, mode: str, grace_period: int = 1):
+        self.mode = mode
+        self.grace_period = grace_period
+        self._by_epoch: dict = {}
+
+    def report(self, epoch: int, value: float):
+        self._by_epoch.setdefault(epoch, []).append(value)
+
+    def should_stop(self, epoch: int, value: float) -> bool:
+        if epoch < self.grace_period:
+            return False
+        peers = self._by_epoch.get(epoch, [])
+        if len(peers) < 3:
+            return False
+        med = float(np.median(peers))
+        return value > med if self.mode == "min" else value < med
+
+
+class LocalSearchEngine(SearchEngine):
+    """Grid × random sampling over a config space, trial loop with
+    per-epoch reward reporting, best-trial checkpointing."""
+
+    def __init__(self, model_builder: ModelBuilder,
+                 logs_dir: str = "/tmp/analytics_zoo_tpu_automl",
+                 name: str = "exp", seed: int = 0, n_parallel: int = 1):
+        self.builder = model_builder
+        self.logs_dir = os.path.join(logs_dir, name)
+        self.name = name
+        self.seed = seed
+        self.n_parallel = n_parallel
+        self.trials: List[Trial] = []
+        self._compiled = False
+
+    def compile(self, data, search_space: dict, n_sampling: int = 1,
+                epochs: int = 1, validation_data=None, metric: str = "mse",
+                mode: Optional[str] = None, scheduler: Optional[str] = None,
+                batch_size: Optional[int] = None):
+        """Materialize the trial list: the grid axes cross-product, each
+        point sampled ``n_sampling`` times (ref RayTuneSearchEngine.compile
+        ray_tune_search_engine.py:61)."""
+        self.data = data
+        self.validation_data = validation_data
+        self.epochs = int(epochs)
+        self.metric = metric
+        self.mode = mode or Evaluator.get_metric_mode(metric)
+        self.scheduler = scheduler
+        self.batch_size = batch_size
+        rng = np.random.default_rng(self.seed)
+        configs = [hp.sample_config(search_space, rng, gp)
+                   for gp in hp.grid_points(search_space)
+                   for _ in range(n_sampling)]
+        self.trials = [Trial(i, c) for i, c in enumerate(configs)]
+        self._compiled = True
+        return self
+
+    def _run_trial(self, trial: Trial, stopper: Optional[MedianStopper]):
+        t0 = time.time()
+        trial.status = "running"
+        try:
+            model = self.builder.build(trial.config)
+            for epoch in range(self.epochs):
+                value = model.fit_eval(
+                    self.data, validation_data=self.validation_data,
+                    epochs=1, metric=self.metric, batch_size=self.batch_size)
+                trial.metric_history.append(float(value))
+                if stopper:
+                    stopper.report(epoch, float(value))
+                    if stopper.should_stop(epoch, float(value)):
+                        trial.status = "stopped"
+                        break
+            better = min if self.mode == "min" else max
+            trial.best_metric = better(trial.metric_history)
+            if trial.status != "stopped":
+                trial.status = "done"
+            ckpt = os.path.join(self.logs_dir, f"trial_{trial.trial_id}")
+            model.save(ckpt)
+            trial.checkpoint = ckpt
+        except Exception as e:  # trial failure is data, not crash
+            trial.status = "error"
+            trial.error = f"{type(e).__name__}: {e}"
+            logger.warning("trial %d failed: %s", trial.trial_id, trial.error)
+        trial.wall_s = time.time() - t0
+        return trial
+
+    def run(self) -> List[Trial]:
+        if not self._compiled:
+            raise RuntimeError("compile() before run()")
+        os.makedirs(self.logs_dir, exist_ok=True)
+        stopper = (MedianStopper(self.mode)
+                   if self.scheduler in ("median", "median_stopping") else None)
+        if self.n_parallel > 1:
+            with ThreadPoolExecutor(max_workers=self.n_parallel) as pool:
+                list(pool.map(lambda t: self._run_trial(t, stopper),
+                              self.trials))
+        else:
+            for t in self.trials:
+                self._run_trial(t, stopper)
+        self._write_summary()
+        return self.trials
+
+    def _write_summary(self):
+        path = os.path.join(self.logs_dir, "trials.json")
+        with open(path, "w") as f:
+            json.dump([{
+                "trial_id": t.trial_id,
+                "config": {k: (v if isinstance(v, (int, float, str, bool,
+                                                   type(None))) else str(v))
+                           for k, v in t.config.items()},
+                "metric_history": t.metric_history,
+                "best_metric": t.best_metric, "status": t.status,
+                "error": t.error, "wall_s": t.wall_s,
+            } for t in self.trials], f, indent=1)
+
+    def get_best_trial(self) -> Trial:
+        done = [t for t in self.trials if t.best_metric is not None]
+        if not done:
+            errs = {t.trial_id: t.error for t in self.trials}
+            raise RuntimeError(f"no successful trials: {errs}")
+        key = (lambda t: t.best_metric)
+        return min(done, key=key) if self.mode == "min" else max(done, key=key)

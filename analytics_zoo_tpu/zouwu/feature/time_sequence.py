@@ -120,11 +120,29 @@ class TimeSequenceFeatureTransformer:
         return (x, y) if with_y else x
 
     def save(self, path: str):
-        np.savez(path, mins=self._mins, maxs=self._maxs,
-                 past=self.past_seq_len, future=self.future_seq_len)
+        scaled = self._mins is not None
+        np.savez(
+            path,
+            mins=self._mins if scaled else np.zeros(0, np.float32),
+            maxs=self._maxs if scaled else np.zeros(0, np.float32),
+            fitted_scale=scaled,
+            past=self.past_seq_len, future=self.future_seq_len,
+            dt_col=self.dt_col, target_col=self.target_col,
+            extra_features_col=np.asarray(self.extra_features_col, dtype=object)
+            if self.extra_features_col else np.zeros(0, dtype="U1"),
+            with_dt_features=self.with_dt_features, scale=self.scale)
 
     def restore(self, path: str):
-        d = np.load(path if path.endswith(".npz") else path + ".npz")
-        self._mins, self._maxs = d["mins"], d["maxs"]
+        d = np.load(path if path.endswith(".npz") else path + ".npz",
+                    allow_pickle=True)
+        if bool(d["fitted_scale"]):
+            self._mins, self._maxs = d["mins"], d["maxs"]
+        else:
+            self._mins = self._maxs = None
         self.past_seq_len = int(d["past"])
         self.future_seq_len = int(d["future"])
+        self.dt_col = str(d["dt_col"])
+        self.target_col = str(d["target_col"])
+        self.extra_features_col = [str(c) for c in d["extra_features_col"]]
+        self.with_dt_features = bool(d["with_dt_features"])
+        self.scale = bool(d["scale"])

@@ -15,13 +15,19 @@ class ThresholdDetector:
 
     def __init__(self, mode: str = "default", ratio: float = 3.0,
                  threshold: Optional[float] = None):
+        if mode not in ("default", "percentile"):
+            raise ValueError(f"mode must be 'default' (mean + ratio·std) or "
+                             f"'percentile' (ratio = percentile), got {mode!r}")
         self.mode = mode
         self.ratio = ratio
         self.threshold = threshold
 
     def fit(self, y: np.ndarray, y_pred: Optional[np.ndarray] = None):
         res = np.abs(y - y_pred) if y_pred is not None else np.abs(y)
-        self.threshold = float(res.mean() + self.ratio * res.std())
+        if self.mode == "percentile":
+            self.threshold = float(np.percentile(res, self.ratio))
+        else:
+            self.threshold = float(res.mean() + self.ratio * res.std())
         return self
 
     def score(self, y: np.ndarray, y_pred: Optional[np.ndarray] = None

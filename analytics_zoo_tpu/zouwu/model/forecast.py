@@ -11,12 +11,9 @@ import numpy as np
 
 from analytics_zoo_tpu.learn import losses as losses_lib
 from analytics_zoo_tpu.learn.estimator import Estimator
-from analytics_zoo_tpu.learn.metrics import MAE, MSE
 from analytics_zoo_tpu.zouwu.model.nets import (
     MTNetModule, Seq2SeqNet, TemporalConvNet, VanillaLSTMNet,
 )
-
-_EVAL_METRICS = {"mse": MSE, "mae": MAE}
 
 
 class Forecaster:
@@ -60,22 +57,9 @@ class Forecaster:
     def evaluate(self, x: np.ndarray, y: np.ndarray,
                  metrics: Sequence[str] = ("mse",),
                  batch_size: int = 256) -> dict:
+        from analytics_zoo_tpu.automl.metrics import Evaluator
         pred = self.predict(x, batch_size)
-        out = {}
-        for m in metrics:
-            if m == "mse":
-                out[m] = float(np.mean((pred - y) ** 2))
-            elif m == "mae":
-                out[m] = float(np.mean(np.abs(pred - y)))
-            elif m == "rmse":
-                out[m] = float(np.sqrt(np.mean((pred - y) ** 2)))
-            elif m in ("smape",):
-                out[m] = float(np.mean(
-                    2 * np.abs(pred - y) /
-                    np.maximum(np.abs(pred) + np.abs(y), 1e-8)) * 100)
-            else:
-                raise ValueError(f"unknown metric {m}")
-        return out
+        return {m: Evaluator.evaluate(m, y, pred) for m in metrics}
 
     def save(self, path: str):
         self._est.save(path)

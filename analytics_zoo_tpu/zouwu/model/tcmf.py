@@ -90,6 +90,11 @@ class TCMFForecaster:
     def _forecast_basis_tcn(self, horizon: int) -> np.ndarray:
         from analytics_zoo_tpu.zouwu.model.forecast import TCNForecaster
         p = min(max(self.ar_order * 2, 8), self.X.shape[1] - horizon)
+        if p < 1:
+            raise ValueError(
+                f"horizon={horizon} too long for the tcn basis forecaster: "
+                f"fitted series length is {self.X.shape[1]}; need "
+                f"horizon < T (or use basis_forecaster='ar')")
         xs, ys = [], []
         for row in self.X:
             for s in range(len(row) - p - horizon + 1):
@@ -113,11 +118,6 @@ class TCMFForecaster:
         return self.F @ xf
 
     def evaluate(self, y_true: np.ndarray, metrics=("mse",)) -> dict:
+        from analytics_zoo_tpu.automl.metrics import Evaluator
         pred = self.predict(y_true.shape[1])
-        out = {}
-        for m in metrics:
-            if m == "mse":
-                out[m] = float(np.mean((pred - y_true) ** 2))
-            elif m == "mae":
-                out[m] = float(np.mean(np.abs(pred - y_true)))
-        return out
+        return {m: Evaluator.evaluate(m, y_true, pred) for m in metrics}

@@ -1,0 +1,148 @@
+"""Tests for AutoML (mirrors ref pyzoo/test/zoo/orca/automl/ +
+pyzoo/test/zoo/automl/)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.automl import (
+    AutoEstimator, Evaluator, LocalSearchEngine, hp,
+)
+from analytics_zoo_tpu.automl.model_builder import FlaxModelBuilder
+
+
+def linear_data(n=256, d=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d).astype(np.float32)
+    w = np.arange(1, d + 1, dtype=np.float32)
+    y = (x @ w[:, None] + 0.01 * rng.randn(n, 1)).astype(np.float32)
+    return x, y
+
+
+def mlp_creator(config):
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        hidden: int
+
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = nn.relu(nn.Dense(self.hidden)(x))
+            return nn.Dense(1)(x)
+
+    return MLP(hidden=int(config.get("hidden", 8)))
+
+
+class TestHp:
+    def test_samplers_in_range(self):
+        rng = np.random.default_rng(0)
+        for _ in range(50):
+            assert hp.choice([1, 2, 3]).sample(rng) in (1, 2, 3)
+            assert 0.0 <= hp.uniform(0, 1).sample(rng) <= 1.0
+            v = hp.loguniform(1e-4, 1e-1).sample(rng)
+            assert 1e-4 <= v <= 1e-1
+            assert 2 <= hp.randint(2, 5).sample(rng) < 5
+        q = hp.quniform(0, 1, 0.25).sample(rng)
+        assert abs(q / 0.25 - round(q / 0.25)) < 1e-9
+
+    def test_grid_cross_product(self):
+        space = {"a": hp.grid_search([1, 2]), "b": hp.grid_search([10, 20]),
+                 "c": hp.uniform(0, 1)}
+        pts = hp.grid_points(space)
+        assert len(pts) == 4
+        assert {(p["a"], p["b"]) for p in pts} == {(1, 10), (1, 20),
+                                                  (2, 10), (2, 20)}
+        cfg = hp.sample_config(space, np.random.default_rng(0), pts[0])
+        assert cfg["a"] == pts[0]["a"] and 0 <= cfg["c"] <= 1
+
+    def test_fixed_values_pass_through(self):
+        cfg = hp.sample_config({"lr": 0.1, "nested": {"k": hp.choice([7])}},
+                               np.random.default_rng(0))
+        assert cfg["lr"] == 0.1 and cfg["nested"]["k"] == 7
+
+
+class TestEvaluator:
+    def test_metrics(self):
+        y = np.array([1.0, 2.0, 3.0])
+        p = np.array([1.0, 2.0, 4.0])
+        assert Evaluator.evaluate("mse", y, p) == pytest.approx(1 / 3)
+        assert Evaluator.evaluate("mae", y, p) == pytest.approx(1 / 3)
+        assert Evaluator.evaluate("rmse", y, p) == pytest.approx(
+            np.sqrt(1 / 3))
+        assert Evaluator.evaluate("r2", y, y) == pytest.approx(1.0)
+        assert Evaluator.get_metric_mode("mse") == "min"
+        assert Evaluator.get_metric_mode("r2") == "max"
+        with pytest.raises(ValueError):
+            Evaluator.evaluate("nope", y, p)
+
+    def test_accuracy_handles_logits(self):
+        y = np.array([0, 1, 2])
+        logits = np.eye(3)
+        assert Evaluator.evaluate("accuracy", y, logits) == 1.0
+
+
+class TestSearchEngine:
+    def test_grid_random_counts_and_best(self, tmp_path, orca_ctx):
+        x, y = linear_data()
+        builder = FlaxModelBuilder(mlp_creator)
+        eng = LocalSearchEngine(builder, logs_dir=str(tmp_path), name="t",
+                                seed=0)
+        space = {"hidden": hp.grid_search([4, 16]), "lr": hp.choice([1e-2]),
+                 "batch_size": 64}
+        eng.compile((x, y), space, n_sampling=1, epochs=2, metric="mse")
+        trials = eng.run()
+        assert len(trials) == 2
+        assert all(t.status == "done" for t in trials)
+        assert all(len(t.metric_history) == 2 for t in trials)
+        best = eng.get_best_trial()
+        assert best.best_metric == min(t.best_metric for t in trials)
+        assert (tmp_path / "t" / "trials.json").exists()
+
+    def test_trial_error_is_captured(self, tmp_path, orca_ctx):
+        def bad_creator(config):
+            raise RuntimeError("boom")
+        eng = LocalSearchEngine(FlaxModelBuilder(bad_creator),
+                                logs_dir=str(tmp_path), name="bad")
+        x, y = linear_data(32)
+        eng.compile((x, y), {"lr": 1e-2}, epochs=1)
+        trials = eng.run()
+        assert trials[0].status == "error" and "boom" in trials[0].error
+        with pytest.raises(RuntimeError):
+            eng.get_best_trial()
+
+
+class TestAutoEstimator:
+    def test_fit_search_restores_best(self, tmp_path, orca_ctx):
+        x, y = linear_data()
+        auto = AutoEstimator.from_flax(model_creator=mlp_creator,
+                                       logs_dir=str(tmp_path), name="mlp")
+        auto.fit((x, y), validation_data=(x, y),
+                 search_space={"hidden": hp.choice([8, 32]),
+                               "lr": hp.loguniform(1e-3, 1e-2),
+                               "batch_size": 64},
+                 n_sampling=2, epochs=3, metric="mse")
+        cfg = auto.get_best_config()
+        assert cfg["hidden"] in (8, 32)
+        model = auto.get_best_model()
+        mse = model.evaluate(x, y, metrics=["mse"])["mse"]
+        # restored best model must match its recorded search reward
+        assert mse == pytest.approx(auto.get_best_trial().best_metric,
+                                    rel=0.2)
+
+    def test_from_keras_builder(self, tmp_path, orca_ctx):
+        from analytics_zoo_tpu.keras.layers import Dense
+        from analytics_zoo_tpu.keras.models import Sequential
+
+        def creator(config):
+            m = Sequential()
+            m.add(Dense(int(config["hidden"]), activation="relu",
+                        input_shape=(4,)))
+            m.add(Dense(1))
+            m.compile(optimizer="adam", loss="mse")
+            return m
+
+        x, y = linear_data(128)
+        auto = AutoEstimator.from_keras(model_creator=creator,
+                                        logs_dir=str(tmp_path), name="k")
+        auto.fit((x, y), search_space={"hidden": hp.choice([8])},
+                 n_sampling=1, epochs=2, metric="mse", batch_size=64)
+        assert auto.get_best_trial().status == "done"
