@@ -62,7 +62,8 @@ class QUniform(Uniform):
 
     def sample(self, rng):
         v = super().sample(rng)
-        return float(np.round(v / self.q) * self.q)
+        v = float(np.round(v / self.q) * self.q)
+        return min(max(v, self.lower), self.upper)
 
 
 class LogUniform(Sampler):
@@ -83,7 +84,8 @@ class QLogUniform(LogUniform):
         self.q = float(q)
 
     def sample(self, rng):
-        return float(np.round(super().sample(rng) / self.q) * self.q)
+        v = float(np.round(super().sample(rng) / self.q) * self.q)
+        return min(max(v, self.lower), self.upper)
 
 
 class RandInt(Sampler):
@@ -105,7 +107,8 @@ class QRandInt(RandInt):
         self.q = int(q)
 
     def sample(self, rng):
-        return int(round(super().sample(rng) / self.q) * self.q)
+        v = int(round(super().sample(rng) / self.q) * self.q)
+        return min(max(v, self.lower), self.upper - 1)
 
 
 class RandN(Sampler):

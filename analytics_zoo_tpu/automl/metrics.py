@@ -67,10 +67,11 @@ def mspe(y_true, y_pred):
 def accuracy(y_true, y_pred):
     y_true = np.asarray(y_true)
     y_pred = np.asarray(y_pred)
-    if y_pred.ndim > y_true.ndim or (
-            y_pred.ndim == 2 and y_pred.shape[-1] > 1 and y_true.ndim == 1):
-        y_pred = np.argmax(y_pred, axis=-1)
-    elif y_pred.dtype.kind == "f":
+    if y_pred.ndim > y_true.ndim and y_pred.shape[-1] == 1:
+        y_pred = y_pred.reshape(y_pred.shape[:-1])   # (n,1) sigmoid → (n,)
+    if y_pred.ndim > y_true.ndim and y_pred.shape[-1] > 1:
+        y_pred = np.argmax(y_pred, axis=-1)          # class logits/probs
+    elif y_pred.dtype.kind == "f" and y_true.dtype.kind in "iub":
         y_pred = (y_pred > 0.5).astype(y_true.dtype)
     return float(np.mean(y_true.reshape(-1) == y_pred.reshape(-1)))
 

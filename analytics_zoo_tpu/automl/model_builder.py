@@ -137,7 +137,12 @@ class _ObjectTrialModel:
 
     def restore(self, path: str, sample_x=None):
         loader = (getattr(self._model, "load_weights", None)
-                  or getattr(self._model, "restore", None))
+                  or getattr(self._model, "restore", None)
+                  or getattr(self._model, "load", None))
+        if loader is None:
+            raise TypeError(
+                f"{type(self._model).__name__} has none of load_weights/"
+                f"restore/load — cannot restore trial checkpoint")
         loader(os.path.join(path, "model"))
 
     @property

@@ -121,23 +121,28 @@ class LocalSearchEngine(SearchEngine):
         trial.status = "running"
         try:
             model = self.builder.build(trial.config)
+            improved = (lambda v, best: v < best) if self.mode == "min" \
+                else (lambda v, best: v > best)
+            ckpt = os.path.join(self.logs_dir, f"trial_{trial.trial_id}")
             for epoch in range(self.epochs):
-                value = model.fit_eval(
+                value = float(model.fit_eval(
                     self.data, validation_data=self.validation_data,
-                    epochs=1, metric=self.metric, batch_size=self.batch_size)
-                trial.metric_history.append(float(value))
+                    epochs=1, metric=self.metric, batch_size=self.batch_size))
+                trial.metric_history.append(value)
+                # checkpoint tracks the best epoch so get_best_model()
+                # restores the weights the reported metric came from
+                if trial.best_metric is None or improved(value,
+                                                        trial.best_metric):
+                    trial.best_metric = value
+                    model.save(ckpt)
+                    trial.checkpoint = ckpt
                 if stopper:
-                    stopper.report(epoch, float(value))
-                    if stopper.should_stop(epoch, float(value)):
+                    stopper.report(epoch, value)
+                    if stopper.should_stop(epoch, value):
                         trial.status = "stopped"
                         break
-            better = min if self.mode == "min" else max
-            trial.best_metric = better(trial.metric_history)
             if trial.status != "stopped":
                 trial.status = "done"
-            ckpt = os.path.join(self.logs_dir, f"trial_{trial.trial_id}")
-            model.save(ckpt)
-            trial.checkpoint = ckpt
         except Exception as e:  # trial failure is data, not crash
             trial.status = "error"
             trial.error = f"{type(e).__name__}: {e}"

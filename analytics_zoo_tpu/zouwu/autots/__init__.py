@@ -1,0 +1,3 @@
+from analytics_zoo_tpu.zouwu.autots.forecast import AutoTSTrainer, TSPipeline
+
+__all__ = ["AutoTSTrainer", "TSPipeline"]

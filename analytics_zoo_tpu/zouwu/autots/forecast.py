@@ -1,0 +1,230 @@
+"""AutoTS — automated time-series model selection + tuning.
+
+API-parity with ``zoo.zouwu.autots.forecast`` (ref
+pyzoo/zoo/zouwu/autots/forecast.py:22-181: ``AutoTSTrainer.fit(train_df,
+validation_df, recipe) -> TSPipeline``; the pipeline bundles the fitted
+feature transformer + best model with fit/evaluate/predict/save/load).
+The search itself runs on the local search engine instead of Ray Tune
+(ref regression/time_sequence_predictor.py:23 + automl/regression/
+base_predictor.py:66).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu.automl.metrics import Evaluator
+from analytics_zoo_tpu.automl.model_builder import ModelBuilder
+from analytics_zoo_tpu.automl.search import LocalSearchEngine
+from analytics_zoo_tpu.learn.optimizers import Adam
+from analytics_zoo_tpu.zouwu.config.recipe import Recipe, SmokeRecipe
+from analytics_zoo_tpu.zouwu.feature.time_sequence import (
+    TimeSequenceFeatureTransformer,
+)
+from analytics_zoo_tpu.zouwu.model.forecast import (
+    LSTMForecaster,
+    MTNetForecaster,
+    Seq2SeqForecaster,
+    TCNForecaster,
+)
+
+_MODEL_KEYS = {
+    "VanillaLSTM": ("lstm_units", "dropouts"),
+    "TCN": ("num_channels", "kernel_size"),
+    "Seq2Seq": ("latent_dim", "dropout"),
+    "MTNet": ("long_series_num", "series_length"),
+}
+
+
+def _build_forecaster(config: dict, future_seq_len: int):
+    model = config.get("model", "VanillaLSTM")
+    lr = float(config.get("lr", 1e-3))
+    kw = {k: config[k] for k in _MODEL_KEYS.get(model, ())
+          if k in config}
+    opt = Adam(learningrate=lr)
+    if model == "VanillaLSTM":
+        if "lstm_units" in kw:
+            kw["lstm_units"] = tuple(kw["lstm_units"])
+        if "dropouts" in kw:
+            kw["dropouts"] = tuple(kw["dropouts"])
+        return LSTMForecaster(target_dim=future_seq_len, optimizer=opt, **kw)
+    if model == "TCN":
+        if "num_channels" in kw:
+            kw["num_channels"] = tuple(kw["num_channels"])
+        return TCNForecaster(future_seq_len=future_seq_len, optimizer=opt,
+                             **kw)
+    if model == "Seq2Seq":
+        return Seq2SeqForecaster(future_seq_len=future_seq_len, optimizer=opt,
+                                 **kw)
+    if model == "MTNet":
+        return MTNetForecaster(future_seq_len=future_seq_len, optimizer=opt,
+                               **kw)
+    raise ValueError(f"unknown model family {model!r}")
+
+
+def _effective_past_seq_len(config: dict) -> int:
+    if config.get("model") == "MTNet":
+        # MTNet consumes (long_series_num + 1) contiguous windows of
+        # series_length each (ref MTNet input layout).
+        lsn = int(config.get("long_series_num", 4))
+        sl = int(config.get("series_length", 8))
+        return (lsn + 1) * sl
+    return int(config.get("past_seq_len", 24))
+
+
+class _TSTrialModel:
+    """One AutoTS trial: feature transformer + forecaster trained as a
+    unit (the search engine drives ``fit_eval`` once per epoch)."""
+
+    def __init__(self, config: dict, dt_col: str, target_col: str,
+                 extra_features_col, future_seq_len: int):
+        self.config = dict(config)
+        self.dt_col, self.target_col = dt_col, target_col
+        self.extra_features_col = extra_features_col
+        self.future_seq_len = future_seq_len
+        self.transformer = TimeSequenceFeatureTransformer(
+            past_seq_len=_effective_past_seq_len(config),
+            future_seq_len=future_seq_len, dt_col=dt_col,
+            target_col=target_col, extra_features_col=extra_features_col)
+        self.forecaster = _build_forecaster(config, future_seq_len)
+        self._train_xy = None
+        self._val_xy = None
+
+    def fit_eval(self, data, validation_data=None, epochs: int = 1,
+                 metric: str = "mse", batch_size: Optional[int] = None
+                 ) -> float:
+        if self._train_xy is None:
+            self._train_xy = self.transformer.fit_transform(data)
+        x, y = self._train_xy
+        bs = int(batch_size or self.config.get("batch_size", 32))
+        bs = min(bs, len(x))
+        self.forecaster.fit(x, y, epochs=epochs, batch_size=bs)
+        if validation_data is not None:
+            if self._val_xy is None:
+                self._val_xy = self.transformer.transform(validation_data)
+            vx, vy = self._val_xy
+        else:
+            vx, vy = x, y
+        pred = self.forecaster.predict(vx)
+        return Evaluator.evaluate(metric, vy, pred)
+
+    def save(self, path: str):
+        os.makedirs(path, exist_ok=True)
+        self.transformer.save(os.path.join(path, "transformer"))
+        self.forecaster.save(os.path.join(path, "model"))
+        meta = {"config": {k: (list(v) if isinstance(v, tuple) else v)
+                           for k, v in self.config.items()},
+                "dt_col": self.dt_col, "target_col": self.target_col,
+                "extra_features_col": list(self.extra_features_col or []),
+                "future_seq_len": self.future_seq_len}
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(meta, f)
+
+    def restore(self, path: str, sample_x=None):
+        self.transformer.restore(os.path.join(path, "transformer"))
+        n_feat = self.transformer.n_features
+        dummy = np.zeros((1, self.transformer.past_seq_len, n_feat),
+                         np.float32)
+        self.forecaster.restore(os.path.join(path, "model"), sample_x=dummy)
+
+
+class _TSModelBuilder(ModelBuilder):
+    def __init__(self, dt_col, target_col, extra_features_col,
+                 future_seq_len):
+        self.kw = dict(dt_col=dt_col, target_col=target_col,
+                       extra_features_col=extra_features_col,
+                       future_seq_len=future_seq_len)
+
+    def build(self, config):
+        return _TSTrialModel(config, **self.kw)
+
+
+class TSPipeline:
+    """Fitted transformer + model bundle (ref
+    pyzoo/zoo/zouwu/pipeline/time_sequence.py:27 TimeSequencePipeline)."""
+
+    def __init__(self, trial_model: _TSTrialModel):
+        self._m = trial_model
+
+    # -- inference ---------------------------------------------------------
+    def predict(self, input_df: pd.DataFrame) -> np.ndarray:
+        """[n_windows, horizon] forecasts in original target units."""
+        x = self._m.transformer.transform(input_df, with_y=False)
+        pred = self._m.forecaster.predict(x)
+        return self._m.transformer.unscale_y(pred)
+
+    def evaluate(self, input_df: pd.DataFrame,
+                 metrics: Sequence[str] = ("mse",)) -> dict:
+        x, y = self._m.transformer.transform(input_df)
+        pred = self._m.forecaster.predict(x)
+        y_true = self._m.transformer.unscale_y(y)
+        y_pred = self._m.transformer.unscale_y(pred)
+        return {m: Evaluator.evaluate(m, y_true, y_pred) for m in metrics}
+
+    # -- incremental fit ---------------------------------------------------
+    def fit(self, input_df: pd.DataFrame, epochs: int = 1,
+            batch_size: Optional[int] = None):
+        """Continue training on new data with the fitted scaling."""
+        x, y = self._m.transformer.transform(input_df)
+        bs = int(batch_size or self._m.config.get("batch_size", 32))
+        self._m.forecaster.fit(x, y, epochs=epochs,
+                               batch_size=min(bs, len(x)))
+        return self
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path: str):
+        self._m.save(path)
+        return path
+
+    @staticmethod
+    def load(path: str) -> "TSPipeline":
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        model = _TSTrialModel(meta["config"], meta["dt_col"],
+                              meta["target_col"],
+                              meta["extra_features_col"] or None,
+                              int(meta["future_seq_len"]))
+        model.restore(path)
+        return TSPipeline(model)
+
+    @property
+    def config(self) -> dict:
+        return dict(self._m.config)
+
+
+class AutoTSTrainer:
+    """(ref autots/forecast.py:22 AutoTSTrainer)"""
+
+    def __init__(self, dt_col: str = "datetime", target_col: str = "value",
+                 horizon: int = 1,
+                 extra_features_col: Optional[Sequence[str]] = None,
+                 logs_dir: str = "/tmp/analytics_zoo_tpu_automl",
+                 name: str = "autots", seed: int = 0):
+        self.dt_col, self.target_col = dt_col, target_col
+        self.horizon = int(horizon)
+        self.extra_features_col = extra_features_col
+        self.builder = _TSModelBuilder(dt_col, target_col,
+                                       extra_features_col, self.horizon)
+        self.engine = LocalSearchEngine(self.builder, logs_dir=logs_dir,
+                                        name=name, seed=seed)
+
+    def fit(self, train_df: pd.DataFrame,
+            validation_df: Optional[pd.DataFrame] = None,
+            recipe: Recipe = None, metric: str = "mse",
+            scheduler: Optional[str] = None) -> TSPipeline:
+        recipe = recipe or SmokeRecipe()
+        rt = recipe.runtime_params()
+        self.engine.compile(train_df, recipe.search_space(),
+                            n_sampling=rt["n_sampling"], epochs=rt["epochs"],
+                            validation_data=validation_df, metric=metric,
+                            scheduler=scheduler)
+        self.engine.run()
+        best = self.engine.get_best_trial()
+        model = self.builder.build(best.config)
+        model.restore(best.checkpoint)
+        return TSPipeline(model)

@@ -1,0 +1,14 @@
+from analytics_zoo_tpu.zouwu.config.recipe import (
+    GridRandomRecipe,
+    LSTMGridRandomRecipe,
+    MTNetGridRandomRecipe,
+    Recipe,
+    Seq2SeqRandomRecipe,
+    SmokeRecipe,
+    TCNGridRandomRecipe,
+)
+
+__all__ = [
+    "Recipe", "SmokeRecipe", "GridRandomRecipe", "LSTMGridRandomRecipe",
+    "Seq2SeqRandomRecipe", "TCNGridRandomRecipe", "MTNetGridRandomRecipe",
+]

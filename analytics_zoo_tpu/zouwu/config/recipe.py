@@ -1,0 +1,125 @@
+"""Recipes — named search-space presets for AutoTS.
+
+API-parity with ``zoo.zouwu.config.recipe`` (ref
+pyzoo/zoo/zouwu/config/recipe.py, 724 LoC: SmokeRecipe, GridRandomRecipe,
+LSTMGridRandomRecipe, Seq2SeqRandomRecipe, TCNGridRandomRecipe,
+MTNetGridRandomRecipe — each a ``search_space()`` + trial-count/stop
+settings consumed by the search engine).
+"""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.automl import hp
+
+
+class Recipe:
+    """A search space + trial budget."""
+
+    num_samples: int = 1
+    epochs: int = 1
+
+    def search_space(self, all_available_features=None) -> dict:
+        raise NotImplementedError
+
+    def runtime_params(self) -> dict:
+        return {"n_sampling": self.num_samples, "epochs": self.epochs}
+
+
+class SmokeRecipe(Recipe):
+    """One tiny config — CI smoke (ref recipe.py SmokeRecipe)."""
+
+    num_samples = 1
+    epochs = 2
+
+    def search_space(self, all_available_features=None):
+        return {
+            "model": "VanillaLSTM",
+            "past_seq_len": 12,
+            "lstm_units": (16, 16),
+            "dropouts": (0.1, 0.1),
+            "lr": 1e-2,
+            "batch_size": 32,
+        }
+
+
+class GridRandomRecipe(Recipe):
+    """Grid over model family x random draws of its hyperparameters
+    (ref recipe.py GridRandomRecipe)."""
+
+    def __init__(self, num_rand_samples: int = 1, epochs: int = 2,
+                 look_back: "int | tuple" = 24):
+        self.num_samples = num_rand_samples
+        self.epochs = epochs
+        self.look_back = look_back
+
+    def _past_seq(self):
+        if isinstance(self.look_back, (tuple, list)):
+            return hp.randint(self.look_back[0], self.look_back[1] + 1)
+        return self.look_back
+
+    def search_space(self, all_available_features=None):
+        return {
+            "model": hp.grid_search(["VanillaLSTM", "TCN"]),
+            "past_seq_len": self._past_seq(),
+            "lstm_units": hp.choice([(16, 16), (32, 32)]),
+            "dropouts": (0.2, 0.2),
+            "num_channels": hp.choice([(16, 16), (30, 30, 30)]),
+            "kernel_size": hp.choice([2, 3]),
+            "lr": hp.loguniform(1e-3, 1e-2),
+            "batch_size": hp.choice([32, 64]),
+        }
+
+
+class LSTMGridRandomRecipe(GridRandomRecipe):
+    """(ref recipe.py LSTMGridRandomRecipe)"""
+
+    def search_space(self, all_available_features=None):
+        return {
+            "model": "VanillaLSTM",
+            "past_seq_len": self._past_seq(),
+            "lstm_units": hp.choice([(16, 16), (32, 32), (64, 64)]),
+            "dropouts": hp.choice([(0.1, 0.1), (0.2, 0.2)]),
+            "lr": hp.loguniform(1e-3, 1e-2),
+            "batch_size": hp.choice([32, 64]),
+        }
+
+
+class TCNGridRandomRecipe(GridRandomRecipe):
+    """(ref recipe.py TCNGridRandomRecipe)"""
+
+    def search_space(self, all_available_features=None):
+        return {
+            "model": "TCN",
+            "past_seq_len": self._past_seq(),
+            "num_channels": hp.choice([(16, 16), (30, 30, 30)]),
+            "kernel_size": hp.grid_search([2, 3]),
+            "lr": hp.loguniform(1e-3, 1e-2),
+            "batch_size": hp.choice([32, 64]),
+        }
+
+
+class Seq2SeqRandomRecipe(GridRandomRecipe):
+    """(ref recipe.py Seq2SeqRandomRecipe)"""
+
+    def search_space(self, all_available_features=None):
+        return {
+            "model": "Seq2Seq",
+            "past_seq_len": self._past_seq(),
+            "latent_dim": hp.choice([32, 64, 128]),
+            "dropout": hp.uniform(0.0, 0.3),
+            "lr": hp.loguniform(1e-3, 1e-2),
+            "batch_size": hp.choice([32, 64]),
+        }
+
+
+class MTNetGridRandomRecipe(GridRandomRecipe):
+    """(ref recipe.py MTNetGridRandomRecipe)"""
+
+    def search_space(self, all_available_features=None):
+        return {
+            "model": "MTNet",
+            "past_seq_len": self._past_seq(),
+            "long_series_num": hp.choice([2, 4]),
+            "lr": hp.loguniform(1e-3, 1e-2),
+            "batch_size": hp.choice([32, 64]),
+        }

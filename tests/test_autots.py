@@ -1,0 +1,88 @@
+"""Tests for AutoTS (mirrors ref pyzoo/test/zoo/zouwu/autots/)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.zouwu.autots import AutoTSTrainer, TSPipeline
+from analytics_zoo_tpu.zouwu.config import (
+    GridRandomRecipe, LSTMGridRandomRecipe, MTNetGridRandomRecipe,
+    Seq2SeqRandomRecipe, SmokeRecipe, TCNGridRandomRecipe,
+)
+
+
+def sine_df(n=240):
+    t = pd.date_range("2024-01-01", periods=n, freq="h")
+    rng = np.random.RandomState(0)
+    v = np.sin(np.arange(n) * 2 * np.pi / 24) + rng.normal(0, 0.05, n)
+    return pd.DataFrame({"datetime": t, "value": v})
+
+
+class TestRecipes:
+    def test_search_spaces_materialize(self):
+        from analytics_zoo_tpu.automl import hp
+        rng = np.random.default_rng(0)
+        for recipe in [SmokeRecipe(), GridRandomRecipe(),
+                       LSTMGridRandomRecipe(), TCNGridRandomRecipe(),
+                       Seq2SeqRandomRecipe(), MTNetGridRandomRecipe()]:
+            space = recipe.search_space()
+            for gp in hp.grid_points(space):
+                cfg = hp.sample_config(space, rng, gp)
+                assert "model" in cfg
+            rt = recipe.runtime_params()
+            assert rt["n_sampling"] >= 1 and rt["epochs"] >= 1
+
+    def test_look_back_range(self):
+        r = LSTMGridRandomRecipe(look_back=(10, 20))
+        s = r.search_space()
+        rng = np.random.default_rng(0)
+        for _ in range(20):
+            v = s["past_seq_len"].sample(rng)
+            assert 10 <= v <= 20
+
+
+class TestAutoTS:
+    def test_smoke_fit_predict_evaluate(self, tmp_path, orca_ctx):
+        df = sine_df()
+        train, val = df.iloc[:200], df.iloc[180:]
+        trainer = AutoTSTrainer(dt_col="datetime", target_col="value",
+                                horizon=3, logs_dir=str(tmp_path))
+        ts = trainer.fit(train, val, recipe=SmokeRecipe(), metric="mse")
+        assert isinstance(ts, TSPipeline)
+        pred = ts.predict(val)
+        assert pred.ndim == 2 and pred.shape[1] == 3
+        res = ts.evaluate(val, metrics=["mse", "smape"])
+        assert set(res) == {"mse", "smape"} and np.isfinite(res["mse"])
+
+    def test_pipeline_save_load_roundtrip(self, tmp_path, orca_ctx):
+        df = sine_df()
+        train, val = df.iloc[:200], df.iloc[180:]
+        trainer = AutoTSTrainer(horizon=2, logs_dir=str(tmp_path / "logs"))
+        ts = trainer.fit(train, val, recipe=SmokeRecipe())
+        p1 = ts.predict(val)
+        ts.save(str(tmp_path / "pipe"))
+        ts2 = TSPipeline.load(str(tmp_path / "pipe"))
+        p2 = ts2.predict(val)
+        np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-5)
+        assert ts2.config["model"] == "VanillaLSTM"
+
+    def test_pipeline_incremental_fit(self, tmp_path, orca_ctx):
+        df = sine_df()
+        train, val = df.iloc[:200], df.iloc[180:]
+        trainer = AutoTSTrainer(horizon=2, logs_dir=str(tmp_path))
+        ts = trainer.fit(train, val, recipe=SmokeRecipe())
+        before = ts.evaluate(val, metrics=["mse"])["mse"]
+        ts.fit(train, epochs=3)
+        after = ts.evaluate(val, metrics=["mse"])["mse"]
+        assert np.isfinite(after)
+        assert after <= before * 2.0   # training continued without blowup
+
+    def test_tcn_recipe_search(self, tmp_path, orca_ctx):
+        df = sine_df(160)
+        train, val = df.iloc[:120], df.iloc[100:]
+        trainer = AutoTSTrainer(horizon=2, logs_dir=str(tmp_path))
+        recipe = TCNGridRandomRecipe(num_rand_samples=1, epochs=1,
+                                     look_back=12)
+        ts = trainer.fit(train, val, recipe=recipe)
+        assert ts.config["model"] == "TCN"
+        assert ts.predict(val).shape[1] == 2
