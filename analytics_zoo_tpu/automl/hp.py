@@ -62,8 +62,16 @@ class QUniform(Uniform):
 
     def sample(self, rng):
         v = super().sample(rng)
-        v = float(np.round(v / self.q) * self.q)
-        return min(max(v, self.lower), self.upper)
+        return _snap_to_q(v, self.q, self.lower, self.upper)
+
+
+def _snap_to_q(v: float, q: float, lower: float, upper: float) -> float:
+    """Round to a multiple of q, then clamp to the in-range multiples so
+    both the quantization and the bound contracts hold."""
+    lo = math.ceil(lower / q - 1e-9) * q
+    hi = math.floor(upper / q + 1e-9) * q
+    v = float(np.round(v / q) * q)
+    return min(max(v, lo), hi)
 
 
 class LogUniform(Sampler):
@@ -84,8 +92,7 @@ class QLogUniform(LogUniform):
         self.q = float(q)
 
     def sample(self, rng):
-        v = float(np.round(super().sample(rng) / self.q) * self.q)
-        return min(max(v, self.lower), self.upper)
+        return _snap_to_q(super().sample(rng), self.q, self.lower, self.upper)
 
 
 class RandInt(Sampler):
@@ -107,8 +114,8 @@ class QRandInt(RandInt):
         self.q = int(q)
 
     def sample(self, rng):
-        v = int(round(super().sample(rng) / self.q) * self.q)
-        return min(max(v, self.lower), self.upper - 1)
+        return int(_snap_to_q(super().sample(rng), self.q, self.lower,
+                              self.upper - 1))
 
 
 class RandN(Sampler):

@@ -1,0 +1,20 @@
+"""Model zoo (ref ``zoo/.../models/`` + ``pyzoo/zoo/models/``)."""
+
+from analytics_zoo_tpu.models.anomalydetection import AnomalyDetector
+from analytics_zoo_tpu.models.common import ZooModel, registry
+from analytics_zoo_tpu.models.image import ImageClassifier, ObjectDetector
+from analytics_zoo_tpu.models.image.objectdetection import SSDLite
+from analytics_zoo_tpu.models.recommendation import (
+    NeuralCF,
+    SessionRecommender,
+    WideAndDeep,
+)
+from analytics_zoo_tpu.models.seq2seq import Seq2Seq
+from analytics_zoo_tpu.models.textclassification import TextClassifier
+from analytics_zoo_tpu.models.textmatching import KNRM
+
+__all__ = [
+    "ZooModel", "registry", "NeuralCF", "WideAndDeep", "SessionRecommender",
+    "TextClassifier", "KNRM", "Seq2Seq", "AnomalyDetector",
+    "ImageClassifier", "ObjectDetector", "SSDLite",
+]

@@ -1,0 +1,5 @@
+from analytics_zoo_tpu.models.anomalydetection.anomaly_detector import (
+    AnomalyDetector,
+)
+
+__all__ = ["AnomalyDetector"]

@@ -1,0 +1,4 @@
+from analytics_zoo_tpu.models.image.imageclassification import ImageClassifier
+from analytics_zoo_tpu.models.image.objectdetection import ObjectDetector
+
+__all__ = ["ImageClassifier", "ObjectDetector"]

@@ -1,0 +1,5 @@
+from analytics_zoo_tpu.models.image.imageclassification.image_classifier import (
+    ImageClassifier,
+)
+
+__all__ = ["ImageClassifier"]

@@ -1,0 +1,115 @@
+"""ImageClassifier — named CNN architectures + image-pipeline predict.
+
+Ref: ``pyzoo/zoo/models/image/imageclassification/image_classifier.py``
+(190 LoC) + Scala ``ImageClassifier.scala``/``ImageClassificationConfig``:
+the reference resolves a (model name, dataset) pair to a pretrained BigDL
+graph and a preprocessing config. Here the same surface builds the
+architecture on the TPU keras engine ("lenet", "mobilenet", "resnet-lite",
+"vgg-lite") and trains/predicts through the Estimator; weight loading uses
+the zoo checkpoint format.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from analytics_zoo_tpu.keras import Input, Model
+from analytics_zoo_tpu.keras import layers as zl
+from analytics_zoo_tpu.models.common import ZooModel, registry
+
+
+def _lenet(inp, class_num):
+    h = zl.Conv2D(20, 5, 5, activation="relu", border_mode="same")(inp)
+    h = zl.MaxPooling2D((2, 2))(h)
+    h = zl.Conv2D(50, 5, 5, activation="relu", border_mode="same")(h)
+    h = zl.MaxPooling2D((2, 2))(h)
+    h = zl.Flatten()(h)
+    h = zl.Dense(500, activation="relu")(h)
+    return zl.Dense(class_num, activation="softmax")(h)
+
+
+def _vgg_lite(inp, class_num):
+    h = inp
+    for filters in (32, 64, 128):
+        h = zl.Conv2D(filters, 3, 3, activation="relu",
+                      border_mode="same")(h)
+        h = zl.Conv2D(filters, 3, 3, activation="relu",
+                      border_mode="same")(h)
+        h = zl.MaxPooling2D((2, 2))(h)
+    h = zl.GlobalAveragePooling2D()(h)
+    h = zl.Dense(256, activation="relu")(h)
+    h = zl.Dropout(0.5)(h)
+    return zl.Dense(class_num, activation="softmax")(h)
+
+
+def _mobilenet(inp, class_num):
+    h = zl.Conv2D(32, 3, 3, subsample=(2, 2), activation="relu",
+                  border_mode="same")(inp)
+    for filters, stride in ((64, 1), (128, 2), (128, 1), (256, 2), (256, 1)):
+        h = zl.SeparableConv2D(filters, 3, 3, subsample=(stride, stride),
+                               activation="relu", border_mode="same")(h)
+    h = zl.GlobalAveragePooling2D()(h)
+    return zl.Dense(class_num, activation="softmax")(h)
+
+
+def _resnet_lite(inp, class_num):
+    def block(x, filters, stride):
+        y = zl.Conv2D(filters, 3, 3, subsample=(stride, stride),
+                      border_mode="same")(x)
+        y = zl.BatchNormalization()(y)
+        y = zl.Activation("relu")(y)
+        y = zl.Conv2D(filters, 3, 3, border_mode="same")(y)
+        y = zl.BatchNormalization()(y)
+        shortcut = x
+        if stride != 1:
+            shortcut = zl.Conv2D(filters, 1, 1, subsample=(stride, stride),
+                                 border_mode="same")(x)
+        out = zl.merge([y, shortcut], mode="sum")
+        return zl.Activation("relu")(out)
+
+    h = zl.Conv2D(32, 3, 3, activation="relu", border_mode="same")(inp)
+    for filters, stride in ((32, 1), (64, 2), (128, 2)):
+        h = block(h, filters, stride)
+    h = zl.GlobalAveragePooling2D()(h)
+    return zl.Dense(class_num, activation="softmax")(h)
+
+
+_ARCHS = {"lenet": _lenet, "vgg-lite": _vgg_lite, "mobilenet": _mobilenet,
+          "resnet-lite": _resnet_lite}
+
+
+@registry.register
+class ImageClassifier(ZooModel):
+    """(ref image_classifier.py ImageClassifier(model_path/model_name);
+    predict over arrays or an ImageSet)"""
+
+    def __init__(self, class_num: int, model_name: str = "resnet-lite",
+                 image_size: int = 224, channels: int = 3):
+        super().__init__()
+        if model_name not in _ARCHS:
+            raise ValueError(
+                f"unknown model_name {model_name!r}; one of {list(_ARCHS)}")
+        self.class_num = int(class_num)
+        self.model_name = model_name
+        self.image_size = int(image_size)
+        self.channels = int(channels)
+        self.model = self.build_model()
+
+    def build_model(self):
+        inp = Input(shape=(self.image_size, self.image_size, self.channels))
+        out = _ARCHS[self.model_name](inp, self.class_num)
+        return Model(input=inp, output=out)
+
+    def predict_image_set(self, image_set, batch_size: int = 32):
+        """Predict class probabilities for every image in an ImageSet
+        (images must already be resized to ``image_size``)."""
+        images = np.stack(image_set.get_image()).astype(np.float32)
+        return self.predict(images, batch_size=batch_size)
+
+    def predict_classes(self, x, batch_size: int = 32):
+        probs = np.asarray(self.predict(x, batch_size=batch_size))
+        return np.argmax(probs, axis=-1)
+
+    def _config(self):
+        return dict(class_num=self.class_num, model_name=self.model_name,
+                    image_size=self.image_size, channels=self.channels)

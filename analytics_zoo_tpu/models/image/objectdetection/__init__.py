@@ -1,0 +1,19 @@
+from analytics_zoo_tpu.models.image.objectdetection.bbox_util import (
+    decode_boxes,
+    encode_targets,
+    generate_anchors,
+    iou_matrix,
+    nms,
+)
+from analytics_zoo_tpu.models.image.objectdetection.multibox_loss import (
+    MultiBoxLoss,
+)
+from analytics_zoo_tpu.models.image.objectdetection.object_detector import (
+    ObjectDetector,
+    SSDLite,
+)
+
+__all__ = [
+    "generate_anchors", "iou_matrix", "encode_targets", "decode_boxes",
+    "nms", "MultiBoxLoss", "SSDLite", "ObjectDetector",
+]

@@ -1,0 +1,3 @@
+from analytics_zoo_tpu.models.seq2seq.seq2seq import Seq2Seq
+
+__all__ = ["Seq2Seq"]

@@ -1,0 +1,5 @@
+from analytics_zoo_tpu.models.textclassification.text_classifier import (
+    TextClassifier,
+)
+
+__all__ = ["TextClassifier"]

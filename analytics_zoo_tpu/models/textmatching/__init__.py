@@ -1,0 +1,3 @@
+from analytics_zoo_tpu.models.textmatching.knrm import KNRM
+
+__all__ = ["KNRM"]

@@ -1,0 +1,119 @@
+"""KNRM — kernel-pooling neural ranking model for text matching.
+
+Ref: ``pyzoo/zoo/models/textmatching/knrm.py`` (192 LoC) and Scala
+``zoo/.../models/textmatching/KNRM.scala``: query/doc token ids →
+shared embedding → cosine-similarity translation matrix → RBF kernel
+pooling (``kernel_num`` gaussians, an exact-match kernel at mu=1) →
+log-sum soft-TF features → dense score. The whole kernel bank evaluates
+as one fused elementwise block on TPU; the embedding + similarity matmul
+ride the MXU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.keras import Input, Model
+from analytics_zoo_tpu.keras import layers as zl
+from analytics_zoo_tpu.models.common import ZooModel, registry
+
+
+@registry.register
+class KNRM(ZooModel):
+    """(ref knrm.py KNRM(text1_length, text2_length, embedding_file,
+    word_index, train_embed, kernel_num=21, sigma=0.1, exact_sigma=0.001,
+    target_mode="ranking"))"""
+
+    def __init__(self, text1_length: int, text2_length: int,
+                 vocab_size: int, embed_dim: int = 50,
+                 kernel_num: int = 21, sigma: float = 0.1,
+                 exact_sigma: float = 0.001, target_mode: str = "ranking"):
+        super().__init__()
+        if kernel_num < 2:
+            raise ValueError("kernel_num must be >= 2")
+        if target_mode not in ("ranking", "classification"):
+            raise ValueError(f"target_mode must be ranking|classification, "
+                             f"got {target_mode!r}")
+        self.text1_length = int(text1_length)
+        self.text2_length = int(text2_length)
+        self.vocab_size = int(vocab_size)
+        self.embed_dim = int(embed_dim)
+        self.kernel_num = int(kernel_num)
+        self.sigma = float(sigma)
+        self.exact_sigma = float(exact_sigma)
+        self.target_mode = target_mode
+        self.model = self.build_model()
+
+    def _kernel_pool(self, sim):
+        """sim: [b, t1, t2] cosine matrix → [b, kernel_num] soft-TF.
+        (ref knrm.py:101-120 kernel loop; vectorized over the kernel bank)
+        """
+        # mu evenly spaced like the ref: mu_k = 1 - 2k/(K-1), last is exact
+        k = np.arange(self.kernel_num, dtype=np.float32)
+        mu = 1.0 - 2.0 * k / (self.kernel_num - 1.0)
+        mu[0] = 1.0                         # exact-match kernel
+        sigma = np.full(self.kernel_num, self.sigma, np.float32)
+        sigma[0] = self.exact_sigma
+        mu_b = jnp.asarray(mu)[None, None, None, :]
+        sig_b = jnp.asarray(sigma)[None, None, None, :]
+        g = jnp.exp(-((sim[..., None] - mu_b) ** 2) / (2.0 * sig_b ** 2))
+        soft_tf = jnp.sum(g, axis=2)                     # [b, t1, K]
+        log_tf = jnp.log1p(jnp.maximum(soft_tf, 0.0))
+        return jnp.sum(log_tf, axis=1)                   # [b, K]
+
+    def build_model(self):
+        inp = Input(shape=(self.text1_length + self.text2_length,))
+        q_ids = zl.Narrow(1, 0, self.text1_length)(inp)
+        d_ids = zl.Narrow(1, self.text1_length, self.text2_length)(inp)
+        embed = zl.Embedding(self.vocab_size + 1, self.embed_dim,
+                             name="word_embedding")
+        q = embed(q_ids)                                 # shared table
+        d = embed(d_ids)
+
+        def cosine_sim(qe, de):
+            qn = qe / (jnp.linalg.norm(qe, axis=-1, keepdims=True) + 1e-8)
+            dn = de / (jnp.linalg.norm(de, axis=-1, keepdims=True) + 1e-8)
+            return jnp.einsum("bqe,bde->bqd", qn, dn)
+
+        sim = zl.Lambda(cosine_sim)([q, d])
+        feats = zl.Lambda(self._kernel_pool)(sim)
+        if self.target_mode == "ranking":
+            out = zl.Dense(1, activation="sigmoid")(feats)
+        else:
+            out = zl.Dense(2, activation="softmax")(feats)
+        return Model(input=inp, output=out)
+
+    def _config(self):
+        return dict(text1_length=self.text1_length,
+                    text2_length=self.text2_length,
+                    vocab_size=self.vocab_size, embed_dim=self.embed_dim,
+                    kernel_num=self.kernel_num, sigma=self.sigma,
+                    exact_sigma=self.exact_sigma,
+                    target_mode=self.target_mode)
+
+
+def evaluate_ndcg(y_true, y_score, k: int = 10) -> float:
+    """NDCG@k over one query's candidate list (ref Scala
+    models/textmatching ranking metrics surfaced via KNRM.evaluateNDCG)."""
+    y_true = np.asarray(y_true, np.float64).reshape(-1)
+    y_score = np.asarray(y_score, np.float64).reshape(-1)
+    order = np.argsort(-y_score)[:k]
+    gains = (2.0 ** y_true[order] - 1) / np.log2(np.arange(2, len(order) + 2))
+    ideal_order = np.argsort(-y_true)[:k]
+    ideal = (2.0 ** y_true[ideal_order] - 1) / np.log2(
+        np.arange(2, len(ideal_order) + 2))
+    denom = ideal.sum()
+    return float(gains.sum() / denom) if denom > 0 else 0.0
+
+
+def evaluate_map(y_true, y_score) -> float:
+    """Average precision for one query (ref KNRM.evaluateMAP)."""
+    y_true = np.asarray(y_true, np.float64).reshape(-1)
+    y_score = np.asarray(y_score, np.float64).reshape(-1)
+    order = np.argsort(-y_score)
+    rel = (y_true[order] > 0).astype(np.float64)
+    if rel.sum() == 0:
+        return 0.0
+    precision_at = np.cumsum(rel) / np.arange(1, len(rel) + 1)
+    return float((precision_at * rel).sum() / rel.sum())

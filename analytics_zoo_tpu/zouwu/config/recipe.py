@@ -116,10 +116,12 @@ class MTNetGridRandomRecipe(GridRandomRecipe):
     """(ref recipe.py MTNetGridRandomRecipe)"""
 
     def search_space(self, all_available_features=None):
+        # MTNet's window is (long_series_num + 1) * series_length, so the
+        # lookback is spelled by those two — no past_seq_len axis here
         return {
             "model": "MTNet",
-            "past_seq_len": self._past_seq(),
             "long_series_num": hp.choice([2, 4]),
+            "series_length": hp.choice([4, 8]),
             "lr": hp.loguniform(1e-3, 1e-2),
             "batch_size": hp.choice([32, 64]),
         }

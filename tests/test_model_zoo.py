@@ -1,0 +1,237 @@
+"""Tests for text/seq2seq/anomaly/image model zoo entries (mirrors ref
+pyzoo/test/zoo/models/)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.models import (
+    AnomalyDetector, ImageClassifier, KNRM, ObjectDetector, SSDLite,
+    Seq2Seq, TextClassifier, ZooModel,
+)
+from analytics_zoo_tpu.models.image.objectdetection import (
+    bbox_util, MultiBoxLoss,
+)
+from analytics_zoo_tpu.models.textmatching.knrm import (
+    evaluate_map, evaluate_ndcg,
+)
+
+
+class TestTextClassifier:
+    def test_fit_predict(self, orca_ctx):
+        m = TextClassifier(class_num=3, vocab_size=50, token_length=16,
+                           sequence_length=20, encoder="cnn",
+                           encoder_output_dim=32)
+        m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+        rng = np.random.RandomState(0)
+        x = rng.randint(1, 51, (64, 20)).astype(np.float32)
+        y = rng.randint(0, 3, 64).astype(np.int32)
+        m.fit(x, y, batch_size=16, nb_epoch=1)
+        probs = np.asarray(m.predict(x))
+        assert probs.shape == (64, 3)
+        np.testing.assert_allclose(probs.sum(-1), 1.0, atol=1e-4)
+
+    @pytest.mark.parametrize("encoder", ["lstm", "gru"])
+    def test_rnn_encoders(self, encoder, orca_ctx):
+        m = TextClassifier(class_num=2, vocab_size=30, token_length=8,
+                           sequence_length=12, encoder=encoder,
+                           encoder_output_dim=16)
+        x = np.random.RandomState(0).randint(1, 31, (8, 12)).astype(np.float32)
+        assert np.asarray(m.predict(x, distributed=False)).shape == (8, 2)
+
+    def test_bad_encoder_raises(self):
+        with pytest.raises(ValueError):
+            TextClassifier(2, 10, encoder="transformer")
+
+    def test_save_load_roundtrip(self, tmp_path, orca_ctx):
+        m = TextClassifier(class_num=2, vocab_size=30, token_length=8,
+                           sequence_length=12, encoder="cnn",
+                           encoder_output_dim=16)
+        x = np.random.RandomState(0).randint(1, 31, (4, 12)).astype(np.float32)
+        p1 = np.asarray(m.predict(x, distributed=False))
+        m.save_model(str(tmp_path / "tc"))
+        m2 = ZooModel.load_model(str(tmp_path / "tc"))
+        p2 = np.asarray(m2.predict(x, distributed=False))
+        np.testing.assert_allclose(p1, p2, atol=1e-5)
+
+
+class TestKNRM:
+    def test_forward_shapes_ranking(self, orca_ctx):
+        m = KNRM(text1_length=5, text2_length=10, vocab_size=40,
+                 embed_dim=16, kernel_num=11)
+        x = np.random.RandomState(0).randint(1, 41, (6, 15)).astype(np.float32)
+        out = np.asarray(m.predict(x, distributed=False))
+        assert out.shape == (6, 1)
+        assert (out >= 0).all() and (out <= 1).all()
+
+    def test_classification_mode_and_fit(self, orca_ctx):
+        m = KNRM(text1_length=4, text2_length=6, vocab_size=30, embed_dim=8,
+                 kernel_num=5, target_mode="classification")
+        m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+        rng = np.random.RandomState(0)
+        x = rng.randint(1, 31, (32, 10)).astype(np.float32)
+        y = rng.randint(0, 2, 32).astype(np.int32)
+        m.fit(x, y, batch_size=16, nb_epoch=1)
+        assert np.asarray(m.predict(x)).shape == (32, 2)
+
+    def test_ranking_metrics(self):
+        y_true = [1, 0, 0, 1]
+        perfect = [0.9, 0.1, 0.2, 0.8]
+        assert evaluate_map(y_true, perfect) == 1.0
+        assert evaluate_ndcg(y_true, perfect, k=4) == pytest.approx(1.0)
+        worst = [0.1, 0.9, 0.8, 0.2]
+        assert evaluate_map(y_true, worst) < 1.0
+
+
+class TestSeq2Seq:
+    def test_teacher_forced_fit_and_infer(self, orca_ctx):
+        m = Seq2Seq(input_dim=3, output_dim=2, hidden_size=16,
+                    num_layers=1, encoder_seq_len=6, decoder_seq_len=4)
+        m.compile(optimizer="adam", loss="mse")
+        rng = np.random.RandomState(0)
+        enc = rng.randn(32, 6, 3).astype(np.float32)
+        dec = rng.randn(32, 4, 2).astype(np.float32)
+        tgt = rng.randn(32, 4, 2).astype(np.float32)
+        m.fit([enc, dec], tgt, batch_size=16, nb_epoch=1)
+        out = np.asarray(m.predict([enc, dec]))
+        assert out.shape == (32, 4, 2)
+        gen = m.infer(enc[:2], start_sign=np.zeros(2, np.float32),
+                      max_seq_len=4)
+        assert gen.shape == (2, 3, 2)
+
+    def test_gru_and_bad_rnn(self, orca_ctx):
+        m = Seq2Seq(input_dim=2, output_dim=1, hidden_size=8,
+                    rnn_type="gru", encoder_seq_len=5, decoder_seq_len=3)
+        enc = np.zeros((2, 5, 2), np.float32)
+        dec = np.zeros((2, 3, 1), np.float32)
+        assert np.asarray(m.predict([enc, dec],
+                                    distributed=False)).shape == (2, 3, 1)
+        with pytest.raises(ValueError):
+            Seq2Seq(2, 1, rnn_type="cnn")
+
+
+class TestAnomalyDetector:
+    def test_unroll_and_detect(self):
+        data = np.arange(20, dtype=np.float32)
+        x, y = AnomalyDetector.unroll(data, unroll_length=5)
+        assert x.shape == (15, 5, 1)
+        np.testing.assert_array_equal(y, np.arange(5, 20, dtype=np.float32))
+        y_pred = y.copy()
+        y_pred[3] += 100.0
+        idx = AnomalyDetector.detect_anomalies(y, y_pred, anomaly_size=1)
+        assert idx.tolist() == [3]
+
+    def test_fit_predict(self, orca_ctx):
+        m = AnomalyDetector(feature_shape=(8, 1), hidden_layers=(8, 8),
+                            dropouts=(0.1, 0.1))
+        m.compile(optimizer="adam", loss="mse")
+        series = np.sin(np.arange(120) / 5).astype(np.float32)
+        x, y = AnomalyDetector.unroll(series, 8)
+        m.fit(x, y, batch_size=32, nb_epoch=2)
+        pred = np.asarray(m.predict(x))
+        assert pred.shape == (len(x), 1)
+
+    def test_mismatched_config_raises(self):
+        with pytest.raises(ValueError):
+            AnomalyDetector((8, 1), hidden_layers=(8, 8), dropouts=(0.1,))
+
+
+class TestImageClassifier:
+    @pytest.mark.parametrize("arch", ["lenet", "vgg-lite", "mobilenet",
+                                      "resnet-lite"])
+    def test_forward(self, arch, orca_ctx):
+        m = ImageClassifier(class_num=4, model_name=arch, image_size=32)
+        x = np.random.RandomState(0).rand(4, 32, 32, 3).astype(np.float32)
+        probs = np.asarray(m.predict(x, distributed=False))
+        assert probs.shape == (4, 4)
+        np.testing.assert_allclose(probs.sum(-1), 1.0, atol=1e-4)
+        assert m.predict_classes(x).shape == (4,)
+
+    def test_fit(self, orca_ctx):
+        m = ImageClassifier(class_num=2, model_name="lenet", image_size=16)
+        m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+        rng = np.random.RandomState(0)
+        x = rng.rand(32, 16, 16, 3).astype(np.float32)
+        y = rng.randint(0, 2, 32).astype(np.int32)
+        m.fit(x, y, batch_size=16, nb_epoch=1)
+
+
+class TestBboxUtil:
+    def test_anchor_count_and_range(self):
+        anchors = bbox_util.generate_anchors([4, 2], [0.2, 0.4, 0.8])
+        assert anchors.shape == ((16 + 4) * 4, 4)
+        assert (anchors >= 0).all() and (anchors <= 1).all()
+        assert (anchors[:, 2] >= anchors[:, 0]).all()
+
+    def test_iou_identity(self):
+        b = np.array([[0.1, 0.1, 0.5, 0.5], [0.6, 0.6, 0.9, 0.9]])
+        iou = bbox_util.iou_matrix(b, b)
+        np.testing.assert_allclose(np.diag(iou), 1.0, atol=1e-6)
+        assert iou[0, 1] == 0.0
+
+    def test_encode_decode_roundtrip(self):
+        anchors = bbox_util.generate_anchors([4], [0.3, 0.6])
+        gt = np.array([[0.2, 0.2, 0.55, 0.55]], np.float32)
+        targets = bbox_util.encode_targets(gt, np.array([2]), anchors)
+        pos = targets[:, 4] > 0
+        assert pos.any()
+        decoded = bbox_util.decode_boxes(targets[:, :4], anchors)
+        # every positive anchor should decode back to the gt box
+        np.testing.assert_allclose(decoded[pos], np.tile(gt, (pos.sum(), 1)),
+                                   atol=1e-4)
+
+    def test_empty_gt(self):
+        anchors = bbox_util.generate_anchors([2], [0.3, 0.6])
+        t = bbox_util.encode_targets(np.zeros((0, 4)), np.zeros(0), anchors)
+        assert (t == 0).all()
+
+    def test_nms_suppresses_overlaps(self):
+        boxes = np.array([[0.1, 0.1, 0.5, 0.5],
+                          [0.12, 0.12, 0.52, 0.52],
+                          [0.6, 0.6, 0.9, 0.9]], np.float32)
+        keep = bbox_util.nms(boxes, np.array([0.9, 0.8, 0.7]), 0.45)
+        assert keep.tolist() == [0, 2]
+
+
+class TestSSD:
+    def test_forward_and_loss_step(self, orca_ctx):
+        ssd = SSDLite(class_num=2, image_size=32)
+        A = ssd.n_anchors
+        x = np.random.RandomState(0).rand(8, 32, 32, 3).astype(np.float32)
+        out = np.asarray(ssd.predict(x, distributed=False))
+        assert out.shape == (8, A, 4 + 3)
+
+        gt_boxes = [np.array([[0.1, 0.1, 0.6, 0.6]], np.float32),
+                    np.array([[0.3, 0.3, 0.8, 0.8],
+                              [0.0, 0.0, 0.2, 0.2]], np.float32)] * 4
+        gt_labels = [np.array([1]), np.array([2, 1])] * 4
+        y = ssd.encode_ground_truth(gt_boxes, gt_labels)
+        assert y.shape == (8, A, 5)
+
+        ssd.compile(optimizer="adam", loss=ssd.loss())
+        ssd.fit(x, y, batch_size=8, nb_epoch=1)
+
+    def test_detector_output_format(self, orca_ctx):
+        ssd = SSDLite(class_num=2, image_size=32)
+        det = ObjectDetector(ssd, conf_threshold=0.05)
+        x = np.random.RandomState(0).rand(2, 32, 32, 3).astype(np.float32)
+        results = det.predict(x)
+        assert len(results) == 2
+        for r in results:
+            assert r.ndim == 2 and (r.shape[1] == 6 or r.shape[0] == 0)
+            if len(r):
+                assert set(np.unique(r[:, 0])) <= {1.0, 2.0}
+
+    def test_multibox_loss_positive_sensitivity(self):
+        import jax.numpy as jnp
+        loss = MultiBoxLoss(n_classes=2)
+        A = 20
+        y_true = np.zeros((1, A, 5), np.float32)
+        y_true[0, 0, 4] = 1           # one positive anchor
+        good = np.zeros((1, A, 4 + 3), np.float32)
+        good[0, :, 4] = 5.0           # confident background...
+        good[0, 0, 4] = 0.0
+        good[0, 0, 5] = 5.0           # ...but class-1 at the positive
+        bad = np.zeros((1, A, 4 + 3), np.float32)
+        bad[0, 0, 4] = 5.0            # background at the positive anchor
+        assert float(loss(jnp.asarray(y_true), jnp.asarray(good))) < \
+            float(loss(jnp.asarray(y_true), jnp.asarray(bad)))
