@@ -70,6 +70,9 @@ def _snap_to_q(v: float, q: float, lower: float, upper: float) -> float:
     both the quantization and the bound contracts hold."""
     lo = math.ceil(lower / q - 1e-9) * q
     hi = math.floor(upper / q + 1e-9) * q
+    if lo > hi:
+        # no multiple of q inside [lower, upper]; bounds win over quantization
+        return min(max(v, lower), upper)
     v = float(np.round(v / q) * q)
     return min(max(v, lo), hi)
 

@@ -71,8 +71,10 @@ def accuracy(y_true, y_pred):
         y_pred = y_pred.reshape(y_pred.shape[:-1])   # (n,1) sigmoid → (n,)
     if y_pred.ndim > y_true.ndim and y_pred.shape[-1] > 1:
         y_pred = np.argmax(y_pred, axis=-1)          # class logits/probs
-    elif y_pred.dtype.kind == "f":
-        y_pred = (y_pred > 0.5).astype(y_true.dtype)   # binary probabilities
+    elif y_pred.dtype.kind == "f" and y_true.dtype.kind in "iub":
+        # float scores against integer labels: binary probabilities.
+        # float-vs-float label arrays are compared directly.
+        y_pred = (y_pred > 0.5).astype(y_true.dtype)
     return float(np.mean(y_true.reshape(-1) == y_pred.reshape(-1)))
 
 

@@ -1,0 +1,3 @@
+from analytics_zoo_tpu.inference.inference_model import InferenceModel
+
+__all__ = ["InferenceModel"]

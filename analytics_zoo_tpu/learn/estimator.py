@@ -96,6 +96,20 @@ class FlaxModelAdapter:
         return out, model_state
 
 
+class FnModelAdapter:
+    """Adapter over a bare pure function ``apply_fn(params, *inputs)`` —
+    used by ``from_torch`` (translated torch graphs) and ``from_fn``."""
+
+    def __init__(self, apply_fn, params, n_inputs: int):
+        self._fn = apply_fn
+        self.params = params
+        self.model_state = {}
+        self.n_inputs = n_inputs
+
+    def apply(self, params, model_state, x, train: bool, rng):
+        return self._fn(params, *_as_args(x)), model_state
+
+
 class Estimator:
     """Factory façade (ref orca/learn/tf/estimator.py Estimator)."""
 
@@ -114,6 +128,39 @@ class Estimator:
         import jax
         adapter = FlaxModelAdapter(model, sample_input,
                                    rng=jax.random.PRNGKey(seed))
+        return JaxEstimator(adapter, loss=loss, optimizer=optimizer,
+                            metrics=metrics, model_dir=model_dir,
+                            strategy=strategy, param_rules=param_rules,
+                            seed=seed)
+
+    @staticmethod
+    def from_torch(*, model, loss, optimizer="adam", metrics=None,
+                   sample_input, model_dir: Optional[str] = None,
+                   strategy="dp", param_rules=None, seed: int = 0
+                   ) -> "JaxEstimator":
+        """Train a PyTorch ``nn.Module`` on the TPU mesh
+        (ref pyzoo/zoo/orca/learn/pytorch/estimator.py:35 Estimator.from_torch).
+
+        The reference runs torch itself inside executors (Jep/DDP); here the
+        module is translated to a pure jax function (net/torch_net.py) so
+        the SAME pjit train step applies — grads flow through the translated
+        graph, not through torch autograd."""
+        from analytics_zoo_tpu.net.torch_net import torch_to_jax
+        apply_fn, params = torch_to_jax(model)
+        adapter = FnModelAdapter(apply_fn, params,
+                                 len(_as_args(sample_input)))
+        return JaxEstimator(adapter, loss=loss, optimizer=optimizer,
+                            metrics=metrics, model_dir=model_dir,
+                            strategy=strategy, param_rules=param_rules,
+                            seed=seed)
+
+    @staticmethod
+    def from_fn(*, apply_fn, params, loss, optimizer="adam", metrics=None,
+                n_inputs: int = 1, model_dir: Optional[str] = None,
+                strategy="dp", param_rules=None, seed: int = 0
+                ) -> "JaxEstimator":
+        """Escape hatch: any pure ``apply_fn(params, *inputs)``."""
+        adapter = FnModelAdapter(apply_fn, params, n_inputs)
         return JaxEstimator(adapter, loss=loss, optimizer=optimizer,
                             metrics=metrics, model_dir=model_dir,
                             strategy=strategy, param_rules=param_rules,

@@ -40,9 +40,14 @@ def generate_anchors(feature_map_sizes: Sequence[int],
         cx, cy = cx.reshape(-1), cy.reshape(-1)
         whs = [(s * np.sqrt(r), s / np.sqrt(r)) for r in aspect_ratios]
         whs.append((s_prime, s_prime))
-        for w, h in whs:
-            boxes.append(np.stack([cx - w / 2, cy - h / 2,
-                                   cx + w / 2, cy + h / 2], axis=1))
+        # cell-major layout (index = cell*A + a) to match the head reshape
+        # [b, H, W, A*4] → [b, H*W*A, 4] in object_detector._reshape_head
+        w = np.array([w for w, _ in whs], np.float32)       # [A]
+        h = np.array([h for _, h in whs], np.float32)
+        cx, cy = cx[:, None], cy[:, None]                    # [fm*fm, 1]
+        cell = np.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2, cy + h / 2], axis=2)    # [fm*fm, A, 4]
+        boxes.append(cell.reshape(-1, 4))
     out = np.concatenate(boxes, axis=0).astype(np.float32)
     return np.clip(out, 0.0, 1.0)
 
@@ -158,10 +163,10 @@ def detect_post_process(loc: np.ndarray, conf: np.ndarray,
         mask = sc > conf_threshold
         if not mask.any():
             continue
-        keep = nms(boxes[mask], sc[mask], nms_threshold)
+        bm, sm = boxes[mask], sc[mask]
+        keep = nms(bm, sm, nms_threshold)
         for i in keep:
-            b = boxes[mask][i]
-            results.append([c, sc[mask][i], *b])
+            results.append([c, sm[i], *bm[i]])
     if not results:
         return np.zeros((0, 6), np.float32)
     res = np.asarray(results, np.float32)

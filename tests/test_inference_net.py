@@ -1,0 +1,177 @@
+"""Tests for InferenceModel + Net/TorchNet (mirrors ref
+pyzoo/test/zoo/pipeline/inference/ and .../net/test_torch_net.py)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.inference import InferenceModel
+from analytics_zoo_tpu.net import Net, TorchNet, torch_to_jax
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+
+
+def _mlp():
+    torch.manual_seed(0)
+    return tnn.Sequential(
+        tnn.Linear(4, 16), tnn.ReLU(),
+        tnn.Linear(16, 3), tnn.Softmax(dim=-1))
+
+
+class TestTorchTranslation:
+    def test_mlp_matches_torch(self):
+        m = _mlp()
+        x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        want = m(torch.from_numpy(x)).detach().numpy()
+        got = TorchNet(m).predict(x)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_conv_bn_pool_matches_torch(self):
+        torch.manual_seed(1)
+        m = tnn.Sequential(
+            tnn.Conv2d(3, 8, 3, stride=1, padding=1),
+            tnn.BatchNorm2d(8), tnn.ReLU(),
+            tnn.MaxPool2d(2),
+            tnn.Flatten(1),
+            tnn.Linear(8 * 4 * 4, 5))
+        m.eval()
+        x = np.random.RandomState(1).randn(2, 3, 8, 8).astype(np.float32)
+        want = m(torch.from_numpy(x)).detach().numpy()
+        got = TorchNet(m).predict(x)
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_residual_and_methods(self):
+        class Res(tnn.Module):
+            def __init__(self):
+                super().__init__()
+                self.fc = tnn.Linear(6, 6)
+
+            def forward(self, x):
+                h = torch.relu(self.fc(x))
+                return (x + h).mean(dim=1)
+
+        m = Res().eval()
+        x = np.random.RandomState(2).randn(5, 6).astype(np.float32)
+        want = m(torch.from_numpy(x)).detach().numpy()
+        got = TorchNet(m).predict(x)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_unsupported_module_raises(self):
+        m = tnn.Sequential(tnn.Linear(4, 4), tnn.PReLU())
+        with pytest.raises(NotImplementedError, match="PReLU"):
+            torch_to_jax(m)
+
+    def test_estimator_from_torch_trains(self, orca_ctx):
+        from analytics_zoo_tpu.learn.estimator import Estimator
+        torch.manual_seed(3)
+        m = tnn.Sequential(tnn.Linear(4, 8), tnn.Tanh(), tnn.Linear(8, 2))
+        rng = np.random.RandomState(3)
+        x = rng.randn(64, 4).astype(np.float32)
+        y = (x.sum(1) > 0).astype(np.int32)
+        est = Estimator.from_torch(
+            model=m, loss="sparse_categorical_crossentropy",
+            optimizer="adam", sample_input=x[:2])
+        h1 = est.fit((x, y), epochs=1, batch_size=16)
+        h5 = est.fit((x, y), epochs=5, batch_size=16)
+        assert h5["loss"][-1] < h1["loss"][0]
+        preds = est.predict(x, batch_size=16)
+        assert np.asarray(preds).shape == (64, 2)
+
+
+class TestNet:
+    def test_load_torch_file_roundtrip(self, tmp_path):
+        m = _mlp()
+        p = str(tmp_path / "m.pt")
+        torch.save(m, p)
+        net = Net.load_torch_file(p)
+        x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        want = m(torch.from_numpy(x)).detach().numpy()
+        np.testing.assert_allclose(net.predict(x), want, atol=1e-5)
+
+    def test_load_torch_file_rejects_state_dict(self, tmp_path):
+        p = str(tmp_path / "sd.pt")
+        torch.save(_mlp().state_dict(), p)
+        with pytest.raises(ValueError, match="state_dict|not a torch module"):
+            Net.load_torch_file(p)
+
+    def test_load_zoo_model_dir(self, tmp_path, orca_ctx):
+        from analytics_zoo_tpu.models import TextClassifier
+        m = TextClassifier(class_num=2, vocab_size=30, token_length=8,
+                           sequence_length=12, encoder="cnn",
+                           encoder_output_dim=16)
+        x = np.random.RandomState(0).randint(1, 31, (4, 12)).astype(np.float32)
+        p1 = np.asarray(m.predict(x, distributed=False))
+        path = str(tmp_path / "model")
+        m.save_model(path)
+        m2 = Net.load(path)
+        np.testing.assert_allclose(np.asarray(m2.predict(x)), p1, atol=1e-5)
+
+
+class TestInferenceModel:
+    def test_load_zoo_and_predict(self, orca_ctx):
+        from analytics_zoo_tpu.models import TextClassifier
+        m = TextClassifier(class_num=3, vocab_size=30, token_length=8,
+                           sequence_length=12, encoder="cnn",
+                           encoder_output_dim=16)
+        x = np.random.RandomState(0).randint(1, 31, (10, 12)).astype(np.float32)
+        want = np.asarray(m.predict(x, distributed=False))
+        im = InferenceModel(concurrent_num=2).load_zoo(m)
+        got = im.predict(x)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        # tail-batch padding path: batch_size that doesn't divide n
+        got2 = im.predict(x, batch_size=4)
+        np.testing.assert_allclose(got2, want, atol=1e-5)
+        cls = im.predict_classes(x)
+        assert cls.shape == (10,) and cls.max() < 3
+
+    def test_load_torch(self):
+        m = _mlp()
+        x = np.random.RandomState(1).randn(6, 4).astype(np.float32)
+        want = m(torch.from_numpy(x)).detach().numpy()
+        im = InferenceModel().load_torch(m, x[:1])
+        np.testing.assert_allclose(im.predict(x), want, atol=1e-5)
+
+    def test_concurrent_predicts(self, orca_ctx):
+        m = _mlp()
+        x = np.random.RandomState(2).randn(32, 4).astype(np.float32)
+        im = InferenceModel(concurrent_num=4).load_torch(m, x[:1])
+        want = im.predict(x)
+        results, errors = [None] * 8, []
+
+        def worker(i):
+            try:
+                results[i] = im.predict(x, batch_size=8)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert not errors
+        for r in results:
+            np.testing.assert_allclose(r, want, atol=1e-6)
+
+    def test_load_checkpoint(self, tmp_path, orca_ctx):
+        from analytics_zoo_tpu.learn.estimator import Estimator
+        m = tnn.Sequential(tnn.Linear(4, 8), tnn.Tanh(), tnn.Linear(8, 2))
+        rng = np.random.RandomState(3)
+        x = rng.randn(32, 4).astype(np.float32)
+        y = (x.sum(1) > 0).astype(np.int32)
+        est = Estimator.from_torch(
+            model=m, loss="sparse_categorical_crossentropy",
+            optimizer="adam", sample_input=x[:2])
+        est.fit((x, y), epochs=2, batch_size=8)
+        ckpt = str(tmp_path / "ckpt")
+        est.save(ckpt)
+        want = np.asarray(est.predict(x, batch_size=8))
+
+        im = InferenceModel().load_torch(m, x[:1]).load_checkpoint(ckpt)
+        np.testing.assert_allclose(im.predict(x, batch_size=8), want,
+                                   atol=1e-5)
+
+    def test_predict_without_model_raises(self):
+        with pytest.raises(RuntimeError, match="no model"):
+            InferenceModel().predict(np.zeros((2, 2)))
