@@ -71,9 +71,9 @@ def accuracy(y_true, y_pred):
         y_pred = y_pred.reshape(y_pred.shape[:-1])   # (n,1) sigmoid → (n,)
     if y_pred.ndim > y_true.ndim and y_pred.shape[-1] > 1:
         y_pred = np.argmax(y_pred, axis=-1)          # class logits/probs
-    elif y_pred.dtype.kind == "f" and y_true.dtype.kind in "iub":
-        # float scores against integer labels: binary probabilities.
-        # float-vs-float label arrays are compared directly.
+    elif y_pred.dtype.kind == "f" and set(np.unique(y_true)) <= {0, 1}:
+        # binary labels (any dtype) with float scores: threshold the
+        # probabilities. Multiclass float label arrays compare directly.
         y_pred = (y_pred > 0.5).astype(y_true.dtype)
     return float(np.mean(y_true.reshape(-1) == y_pred.reshape(-1)))
 

@@ -102,13 +102,15 @@ class InferenceModel:
         on the TPU)."""
         from analytics_zoo_tpu.net.torch_net import torch_to_jax
 
-        apply_fn, params = torch_to_jax(torch_module)
+        apply_fn, variables = torch_to_jax(torch_module)
         n = len(_as_tuple(sample_input))
 
         def wrapped(state, *xs):
-            return apply_fn(state["params"], *xs)
+            return apply_fn({"params": state["params"],
+                             "buffers": state["model_state"]}, *xs)
 
-        self._install(wrapped, {"params": params}, n)
+        self._install(wrapped, {"params": variables["params"],
+                                "model_state": variables["buffers"]}, n)
         return self
 
     def load_checkpoint(self, path: str) -> "InferenceModel":
@@ -157,17 +159,24 @@ class InferenceModel:
         (ref InferenceModel.doPredict + model-queue take/offer)."""
         import jax
 
-        if self._apply is None:
+        with self._lock:
+            # one consistent snapshot: a concurrent load_* or
+            # load_checkpoint can't mix model versions across chunks
+            apply_ok = self._apply is not None
+            params, jitted, n_inputs = self._params, self._jitted, self._n_inputs
+        if not apply_ok:
             raise RuntimeError("no model loaded")
         xs = _as_tuple(x)
-        if len(xs) != self._n_inputs:
-            if self._n_inputs == 1:
+        if len(xs) != n_inputs:
+            if n_inputs == 1:
                 xs = (np.asarray(x),)
             else:
                 raise ValueError(
-                    f"model takes {self._n_inputs} inputs, got {len(xs)}")
+                    f"model takes {n_inputs} inputs, got {len(xs)}")
         xs = tuple(np.asarray(a) for a in xs)
         n = xs[0].shape[0]
+        if n == 0:
+            raise ValueError("predict called on an empty batch")
         bs = int(batch_size) if batch_size else n
         outs = []
         with self._sem:
@@ -181,7 +190,7 @@ class InferenceModel:
                         np.concatenate(
                             [a, np.repeat(a[-1:], bs - valid, axis=0)])
                         for a in chunk)
-                out = self._jitted(self._params, *chunk)
+                out = jitted(params, *chunk)
                 out = jax.device_get(out)
                 out = jax.tree_util.tree_map(lambda a: a[:valid], out)
                 outs.append(out)

@@ -97,17 +97,29 @@ class FlaxModelAdapter:
 
 
 class FnModelAdapter:
-    """Adapter over a bare pure function ``apply_fn(params, *inputs)`` —
-    used by ``from_torch`` (translated torch graphs) and ``from_fn``."""
+    """Adapter over a bare pure function — used by ``from_torch``
+    (translated torch graphs) and ``from_fn``.
 
-    def __init__(self, apply_fn, params, n_inputs: int):
+    Two conventions: without ``buffers`` the fn is
+    ``apply_fn(params, *inputs)``; with ``buffers`` it is
+    ``apply_fn({"params", "buffers"}, *inputs)`` and the buffers ride the
+    estimator's model_state — frozen (no grads, no optimizer updates), which
+    is how translated BatchNorm running statistics stay fixed."""
+
+    def __init__(self, apply_fn, params, n_inputs: int, buffers=None):
         self._fn = apply_fn
+        self._variables_style = buffers is not None
         self.params = params
-        self.model_state = {}
+        self.model_state = buffers or {}
         self.n_inputs = n_inputs
 
     def apply(self, params, model_state, x, train: bool, rng):
-        return self._fn(params, *_as_args(x)), model_state
+        if self._variables_style:
+            out = self._fn({"params": params, "buffers": model_state},
+                           *_as_args(x))
+        else:
+            out = self._fn(params, *_as_args(x))
+        return out, model_state
 
 
 class Estimator:
@@ -146,9 +158,10 @@ class Estimator:
         the SAME pjit train step applies — grads flow through the translated
         graph, not through torch autograd."""
         from analytics_zoo_tpu.net.torch_net import torch_to_jax
-        apply_fn, params = torch_to_jax(model)
-        adapter = FnModelAdapter(apply_fn, params,
-                                 len(_as_args(sample_input)))
+        apply_fn, variables = torch_to_jax(model)
+        adapter = FnModelAdapter(apply_fn, variables["params"],
+                                 len(_as_args(sample_input)),
+                                 buffers=variables["buffers"])
         return JaxEstimator(adapter, loss=loss, optimizer=optimizer,
                             metrics=metrics, model_dir=model_dir,
                             strategy=strategy, param_rules=param_rules,

@@ -38,9 +38,14 @@ def _conv_general(x, w, b, stride, padding, dims):
     import jax.numpy as jnp
     if isinstance(stride, int):
         stride = (stride,) * dims
-    if isinstance(padding, int):
-        padding = (padding,) * dims
-    pad = [(p, p) for p in padding]
+    if isinstance(padding, str):
+        if padding.lower() not in ("same", "valid"):
+            raise NotImplementedError(f"conv padding {padding!r} unsupported")
+        pad = padding.upper()
+    else:
+        if isinstance(padding, int):
+            padding = (padding,) * dims
+        pad = [(p, p) for p in padding]
     spec = ("NCH", "OIH", "NCH") if dims == 1 else ("NCHW", "OIHW", "NCHW")
     out = lax.conv_general_dilated(
         x, w, window_strides=tuple(stride), padding=pad,
@@ -50,11 +55,27 @@ def _conv_general(x, w, b, stride, padding, dims):
     return out
 
 
+def _pool_args(mod):
+    k, s = mod.kernel_size, mod.stride or mod.kernel_size
+    k = (k, k) if isinstance(k, int) else tuple(k)
+    s = (s, s) if isinstance(s, int) else tuple(s)
+    p = mod.padding
+    p = (p, p) if isinstance(p, int) else tuple(p)
+    if getattr(mod, "ceil_mode", False):
+        raise NotImplementedError("pooling ceil_mode=True not supported")
+    return k, s, p
+
+
 class _ModuleRule:
-    """Translate one torch layer instance into (param-extractor, jax fn)."""
+    """Translate one torch layer instance into
+    ``(trainable params, frozen buffers, jax fn)``; the executor calls
+    ``fn(merged_params_and_buffers, x)``. Putting running statistics in
+    buffers (not params) keeps Estimator.from_torch from gradient-updating
+    them — they ride the estimator's model_state instead."""
 
     @staticmethod
-    def translate(mod) -> Tuple[Dict[str, np.ndarray], Callable]:
+    def translate(mod) -> Tuple[Dict[str, np.ndarray],
+                                Dict[str, np.ndarray], Callable]:
         import torch.nn as tnn
         import jax.numpy as jnp
         import jax
@@ -63,7 +84,7 @@ class _ModuleRule:
             p = {"kernel": _np(mod.weight).T}
             if mod.bias is not None:
                 p["bias"] = _np(mod.bias)
-            return p, lambda pr, x: x @ pr["kernel"] + pr.get("bias", 0.0)
+            return p, {}, lambda pr, x: x @ pr["kernel"] + pr.get("bias", 0.0)
         if isinstance(mod, (tnn.Conv1d, tnn.Conv2d)):
             dims = 1 if isinstance(mod, tnn.Conv1d) else 2
             if any(d != 1 for d in np.atleast_1d(mod.dilation)) or mod.groups != 1:
@@ -72,11 +93,13 @@ class _ModuleRule:
             if mod.bias is not None:
                 p["bias"] = _np(mod.bias)
             stride, padding = mod.stride, mod.padding
-            return p, lambda pr, x: _conv_general(
+            return p, {}, lambda pr, x: _conv_general(
                 x, pr["kernel"], pr.get("bias"), stride, padding, dims)
         if isinstance(mod, (tnn.BatchNorm1d, tnn.BatchNorm2d)):
-            p = {"scale": _np(mod.weight), "bias": _np(mod.bias),
-                 "mean": _np(mod.running_mean), "var": _np(mod.running_var)}
+            # inference-mode normalization with frozen running statistics
+            # (fine-tuning keeps them fixed, like torch eval-mode finetune)
+            p = {"scale": _np(mod.weight), "bias": _np(mod.bias)}
+            buf = {"mean": _np(mod.running_mean), "var": _np(mod.running_var)}
             eps = mod.eps
 
             def bn(pr, x):
@@ -84,7 +107,7 @@ class _ModuleRule:
                 inv = jax.lax.rsqrt(pr["var"].reshape(shape) + eps)
                 return (x - pr["mean"].reshape(shape)) * inv \
                     * pr["scale"].reshape(shape) + pr["bias"].reshape(shape)
-            return p, bn
+            return p, buf, bn
         if isinstance(mod, tnn.LayerNorm):
             p = {"scale": _np(mod.weight), "bias": _np(mod.bias)}
             eps = mod.eps
@@ -94,66 +117,69 @@ class _ModuleRule:
                 var = ((x - mu) ** 2).mean(-1, keepdims=True)
                 return (x - mu) * jax.lax.rsqrt(var + eps) * pr["scale"] \
                     + pr["bias"]
-            return p, ln
+            return p, {}, ln
         if isinstance(mod, tnn.Embedding):
             p = {"embedding": _np(mod.weight)}
-            return p, lambda pr, x: pr["embedding"][x.astype(jnp.int32)]
-        if isinstance(mod, tnn.Dropout):
-            return {}, lambda pr, x: x  # inference/translated mode
-        if isinstance(mod, tnn.Identity):
-            return {}, lambda pr, x: x
+            return p, {}, lambda pr, x: pr["embedding"][x.astype(jnp.int32)]
+        if isinstance(mod, (tnn.Dropout, tnn.Identity)):
+            return {}, {}, lambda pr, x: x  # inference/translated mode
         if isinstance(mod, tnn.Flatten):
             start = mod.start_dim
-            return {}, lambda pr, x: x.reshape(x.shape[:start] + (-1,))
+            return {}, {}, lambda pr, x: x.reshape(x.shape[:start] + (-1,))
         if isinstance(mod, tnn.ReLU):
-            return {}, lambda pr, x: jnp.maximum(x, 0)
+            return {}, {}, lambda pr, x: jnp.maximum(x, 0)
         if isinstance(mod, tnn.GELU):
-            return {}, lambda pr, x: jax.nn.gelu(x)
+            return {}, {}, lambda pr, x: jax.nn.gelu(x)
         if isinstance(mod, tnn.Tanh):
-            return {}, lambda pr, x: jnp.tanh(x)
+            return {}, {}, lambda pr, x: jnp.tanh(x)
         if isinstance(mod, tnn.Sigmoid):
-            return {}, lambda pr, x: jax.nn.sigmoid(x)
+            return {}, {}, lambda pr, x: jax.nn.sigmoid(x)
         if isinstance(mod, tnn.Softmax):
             dim = mod.dim if mod.dim is not None else -1
-            return {}, lambda pr, x: jax.nn.softmax(x, axis=dim)
+            return {}, {}, lambda pr, x: jax.nn.softmax(x, axis=dim)
         if isinstance(mod, tnn.LogSoftmax):
             dim = mod.dim if mod.dim is not None else -1
-            return {}, lambda pr, x: jax.nn.log_softmax(x, axis=dim)
+            return {}, {}, lambda pr, x: jax.nn.log_softmax(x, axis=dim)
         if isinstance(mod, tnn.MaxPool2d):
-            k, s = mod.kernel_size, mod.stride or mod.kernel_size
-            k = (k, k) if isinstance(k, int) else tuple(k)
-            s = (s, s) if isinstance(s, int) else tuple(s)
+            k, s, p = _pool_args(mod)
 
             def mp(pr, x):
                 import jax.lax as lax
                 return lax.reduce_window(
-                    x, -jnp.inf, lax.max, (1, 1) + k, (1, 1) + s, "VALID")
-            return {}, mp
+                    x, -jnp.inf, lax.max, (1, 1) + k, (1, 1) + s,
+                    [(0, 0), (0, 0)] + [(a, a) for a in p])
+            return {}, {}, mp
         if isinstance(mod, tnn.AvgPool2d):
-            k, s = mod.kernel_size, mod.stride or mod.kernel_size
-            k = (k, k) if isinstance(k, int) else tuple(k)
-            s = (s, s) if isinstance(s, int) else tuple(s)
+            k, s, p = _pool_args(mod)
+            if not mod.count_include_pad:
+                raise NotImplementedError(
+                    "AvgPool2d count_include_pad=False not supported")
 
             def ap(pr, x):
                 import jax.lax as lax
                 summed = lax.reduce_window(
-                    x, 0.0, lax.add, (1, 1) + k, (1, 1) + s, "VALID")
+                    x, 0.0, lax.add, (1, 1) + k, (1, 1) + s,
+                    [(0, 0), (0, 0)] + [(a, a) for a in p])
                 return summed / (k[0] * k[1])
-            return {}, ap
+            return {}, {}, ap
         if isinstance(mod, tnn.AdaptiveAvgPool2d):
             size = mod.output_size
             if size not in (1, (1, 1)):
                 raise NotImplementedError("AdaptiveAvgPool2d only to (1,1)")
-            return {}, lambda pr, x: x.mean(axis=(2, 3), keepdims=True)
+            return {}, {}, lambda pr, x: x.mean(axis=(2, 3), keepdims=True)
         raise NotImplementedError(
             f"torch module {type(mod).__name__} has no TPU translation rule")
 
 
 def torch_to_jax(module) -> Tuple[Callable, Dict[str, Any]]:
-    """Translate ``module`` (torch.nn.Module) → ``(apply_fn, params)`` where
-    ``apply_fn(params, *inputs)`` is a pure jax function. Uses torch.fx
-    symbolic tracing, so data-dependent Python control flow in the module is
-    rejected by fx itself — the same restriction XLA imposes."""
+    """Translate ``module`` (torch.nn.Module) →
+    ``(apply_fn, {"params": ..., "buffers": ...})`` where
+    ``apply_fn(variables, *inputs)`` is a pure jax function. ``params`` are
+    the trainable leaves; ``buffers`` (BN running stats, plain-tensor
+    attributes) are frozen state. Uses torch.fx symbolic tracing, so
+    data-dependent Python control flow in the module is rejected by fx
+    itself — the same restriction XLA imposes. All torch-side tensors are
+    copied out during translation; nothing retains the torch module."""
     import torch
     import torch.fx as fx
     import operator
@@ -165,14 +191,31 @@ def torch_to_jax(module) -> Tuple[Callable, Dict[str, Any]]:
     modules = dict(graph_module.named_modules())
 
     params: Dict[str, Any] = {}
+    buffers: Dict[str, Any] = {}
     fns: Dict[str, Callable] = {}
     for node in graph_module.graph.nodes:
         if node.op == "call_module":
-            p, fn = _ModuleRule.translate(modules[node.target])
-            key = node.target.replace(".", "/")
+            p, buf, fn = _ModuleRule.translate(modules[node.target])
+            # dots, not slashes: estimator param paths join dict keys with
+            # "/" so a slash inside one key would split the path
+            key = node.target
             if p:
                 params[key] = p
+            if buf:
+                buffers[key] = buf
             fns[node.name] = (key, fn)
+        elif node.op == "get_attr":
+            # nn.Parameter used directly in forward → trainable; any other
+            # tensor attribute → frozen buffer
+            t = graph_module
+            for part in node.target.split("."):
+                t = getattr(t, part)
+            key = "attr." + node.target
+            if isinstance(t, torch.nn.Parameter):
+                params[key] = _np(t)
+            else:
+                buffers[key] = _np(torch.as_tensor(t))
+            fns[node.name] = (key, None)
 
     _FN_MAP = {
         torch.relu: lambda *a, **k: jnp.maximum(a[0], 0),
@@ -188,6 +231,7 @@ def torch_to_jax(module) -> Tuple[Callable, Dict[str, Any]]:
         operator.mul: lambda a, b: a * b,
         operator.truediv: lambda a, b: a / b,
         operator.getitem: lambda a, idx: a[idx],
+        operator.matmul: lambda a, b: a @ b,
         torch.matmul: lambda a, b, **k: a @ b,
         torch.flatten: lambda x, start_dim=0, **k: x.reshape(
             x.shape[:start_dim] + (-1,)),
@@ -215,52 +259,81 @@ def torch_to_jax(module) -> Tuple[Callable, Dict[str, Any]]:
         "unsqueeze": lambda x, dim: jnp.expand_dims(x, axis=dim),
     }
 
-    nodes = list(graph_module.graph.nodes)
+    # Node records with fx.Node references replaced by name refs and torch
+    # tensors copied out, so the closure holds NO reference to graph_module
+    # (otherwise every torch-side weight tensor stays alive for the model's
+    # lifetime).
+    class _Ref:
+        __slots__ = ("name",)
 
-    def apply_fn(prms, *inputs):
+        def __init__(self, name):
+            self.name = name
+
+    def freeze(a):
+        if isinstance(a, fx.Node):
+            return _Ref(a.name)
+        if isinstance(a, (tuple, list)):
+            return type(a)(freeze(v) for v in a)
+        if isinstance(a, dict):
+            return {k: freeze(v) for k, v in a.items()}
+        if isinstance(a, torch.Tensor):
+            return np.asarray(_np(a))
+        return a
+
+    node_recs = [(n.op, n.name, n.target, freeze(tuple(n.args)),
+                  freeze(dict(n.kwargs)))
+                 for n in graph_module.graph.nodes]
+    for op, name, target, _, _ in node_recs:
+        if op == "call_function" and target not in _FN_MAP:
+            raise NotImplementedError(
+                f"torch fn {target} has no TPU translation")
+        if op == "call_method" and target not in _METHODS:
+            raise NotImplementedError(
+                f"torch method .{target}() has no TPU translation")
+    del graph_module, modules
+
+    def apply_fn(variables, *inputs):
+        prms = dict(variables.get("params", {}))
+        for k, v in variables.get("buffers", {}).items():
+            if k in prms and isinstance(prms[k], dict):
+                prms[k] = {**prms[k], **v}
+            else:
+                prms.setdefault(k, v)
         env: Dict[str, Any] = {}
         it = iter(inputs)
 
         def lookup(a):
-            if isinstance(a, fx.Node):
+            if isinstance(a, _Ref):
                 return env[a.name]
             if isinstance(a, (tuple, list)):
                 return type(a)(lookup(v) for v in a)
+            if isinstance(a, dict):
+                return {k: lookup(v) for k, v in a.items()}
             return a
 
-        for node in nodes:
-            if node.op == "placeholder":
-                env[node.name] = next(it)
-            elif node.op == "get_attr":
-                t = graph_module
-                for part in node.target.split("."):
-                    t = getattr(t, part)
-                env[node.name] = jnp.asarray(_np(t))
-            elif node.op == "call_module":
-                key, fn = fns[node.name]
-                env[node.name] = fn(prms.get(key, {}),
-                                    *[lookup(a) for a in node.args])
-            elif node.op == "call_function":
-                fn = _FN_MAP.get(node.target)
-                if fn is None:
-                    raise NotImplementedError(
-                        f"torch fn {node.target} has no TPU translation")
-                env[node.name] = fn(*[lookup(a) for a in node.args],
-                                    **{k: lookup(v)
-                                       for k, v in node.kwargs.items()})
-            elif node.op == "call_method":
-                fn = _METHODS.get(node.target)
-                if fn is None:
-                    raise NotImplementedError(
-                        f"torch method .{node.target}() has no TPU translation")
-                env[node.name] = fn(*[lookup(a) for a in node.args],
-                                    **{k: lookup(v)
-                                       for k, v in node.kwargs.items()})
-            elif node.op == "output":
-                return lookup(node.args[0])
+        for op, name, target, args, kwargs in node_recs:
+            if op == "placeholder":
+                env[name] = next(it)
+            elif op == "get_attr":
+                key, _ = fns[name]
+                env[name] = jnp.asarray(prms[key])
+            elif op == "call_module":
+                key, fn = fns[name]
+                env[name] = fn(prms.get(key, {}),
+                               *[lookup(a) for a in args])
+            elif op == "call_function":
+                env[name] = _FN_MAP[target](
+                    *[lookup(a) for a in args],
+                    **{k: lookup(v) for k, v in kwargs.items()})
+            elif op == "call_method":
+                env[name] = _METHODS[target](
+                    *[lookup(a) for a in args],
+                    **{k: lookup(v) for k, v in kwargs.items()})
+            elif op == "output":
+                return lookup(args[0])
         raise RuntimeError("graph had no output node")
 
-    return apply_fn, params
+    return apply_fn, {"params": params, "buffers": buffers}
 
 
 class TorchNet:
@@ -270,12 +343,16 @@ class TorchNet:
 
     def __init__(self, module, jit: bool = True):
         import jax
-        self.apply_fn, self.params = torch_to_jax(module)
+        self.apply_fn, self.variables = torch_to_jax(module)
         self._call = jax.jit(self.apply_fn) if jit else self.apply_fn
+
+    @property
+    def params(self):
+        return self.variables["params"]
 
     def predict(self, *inputs):
         import jax
         arrs = tuple(np.asarray(a) for a in inputs)
-        return np.asarray(jax.device_get(self._call(self.params, *arrs)))
+        return np.asarray(jax.device_get(self._call(self.variables, *arrs)))
 
     __call__ = predict

@@ -1,0 +1,354 @@
+"""Broker management — build, launch and talk to the serving data plane.
+
+The reference's data plane is a Redis server: streams in, hash out
+(SURVEY.md §3.4). Here the equivalent is ``zbroker``, a native C++ broker
+(serving/native/zbroker.cpp) compiled on first use with g++ and launched as
+a subprocess — same process model as Redis, no external dependency. A
+pure-Python broker with the identical wire protocol backs environments
+without a toolchain (and doubles as the protocol's executable spec).
+
+Protocol: newline-delimited text; payloads are opaque base64 (see
+zbroker.cpp header for the command set).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_NATIVE_SRC = os.path.join(os.path.dirname(__file__), "native", "zbroker.cpp")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "native", "build")
+
+
+def build_native_broker(force: bool = False) -> Optional[str]:
+    """Compile zbroker.cpp → build/zbroker. Returns binary path or None if
+    no toolchain. Rebuilds when the source is newer than the binary."""
+    binary = os.path.join(_BUILD_DIR, "zbroker")
+    if not force and os.path.exists(binary) and \
+            os.path.getmtime(binary) >= os.path.getmtime(_NATIVE_SRC):
+        return binary
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-pthread", "-o", binary,
+             _NATIVE_SRC],
+            check=True, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        err = getattr(e, "stderr", "")
+        import logging
+        logging.getLogger(__name__).warning(
+            "native broker build failed (%s); falling back to python broker",
+            err or e)
+        return None
+    return binary
+
+
+class BrokerClient:
+    """One TCP connection to the broker. Thread-compatible: callers must
+    not share one client across threads (make one per thread — connects
+    are cheap; matches redis-py usage in the reference client)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6399,
+                 timeout: float = 30.0):
+        self.addr = (host, port)
+        self.sock = socket.create_connection(self.addr, timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._buf = b""
+
+    # --- wire ---
+    def _send(self, *parts: str):
+        self.sock.sendall((" ".join(parts) + "\n").encode())
+
+    def _readline(self) -> str:
+        while b"\n" not in self._buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("broker closed connection")
+            self._buf += chunk
+        line, self._buf = self._buf.split(b"\n", 1)
+        return line.decode()
+
+    def _reply(self):
+        line = self._readline()
+        kind, rest = line[0], line[1:]
+        if kind == "+":
+            return rest
+        if kind == ":":
+            return int(rest)
+        if kind == "$":
+            return None if rest == "-1" else rest
+        if kind == "*":
+            return [self._readline() for _ in range(int(rest))]
+        if kind == "-":
+            raise RuntimeError(f"broker error: {rest}")
+        raise RuntimeError(f"bad reply line: {line!r}")
+
+    def _cmd(self, *parts: str):
+        self._send(*parts)
+        return self._reply()
+
+    # --- commands ---
+    def ping(self) -> bool:
+        return self._cmd("PING") == "PONG"
+
+    def xadd(self, stream: str, payload_b64: str) -> int:
+        return int(self._cmd("XADD", stream, payload_b64))
+
+    def xlen(self, stream: str) -> int:
+        return self._cmd("XLEN", stream)
+
+    def xreadgroup(self, group: str, consumer: str, stream: str,
+                   count: int, block_ms: int = 0) -> List[Tuple[int, str]]:
+        old = self.sock.gettimeout()
+        if block_ms:
+            self.sock.settimeout(max(old or 0, block_ms / 1000.0 + 10))
+        try:
+            lines = self._cmd("XREADGROUP", group, consumer, stream,
+                              str(count), str(block_ms))
+        finally:
+            self.sock.settimeout(old)
+        out = []
+        for ln in lines:
+            i, payload = ln.split(" ", 1)
+            out.append((int(i), payload))
+        return out
+
+    def xack(self, stream: str, group: str, entry_id: int) -> int:
+        return self._cmd("XACK", stream, group, str(entry_id))
+
+    def xpending(self, stream: str, group: str) -> int:
+        return self._cmd("XPENDING", stream, group)
+
+    def hset(self, key: str, field: str, value_b64: str):
+        return self._cmd("HSET", key, field, value_b64)
+
+    def hget(self, key: str, field: str) -> Optional[str]:
+        return self._cmd("HGET", key, field)
+
+    def hkeys(self, key: str) -> List[str]:
+        return self._cmd("HKEYS", key)
+
+    def hdel(self, key: str, field: str) -> int:
+        return self._cmd("HDEL", key, field)
+
+    def delete(self, key: str):
+        return self._cmd("DEL", key)
+
+    def shutdown_broker(self):
+        try:
+            self._cmd("SHUTDOWN")
+        except (ConnectionError, OSError):
+            pass
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------- python impl
+class _PyState:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.streams: Dict[str, dict] = {}
+        self.hashes: Dict[str, Dict[str, str]] = {}
+
+    def stream(self, name):
+        return self.streams.setdefault(
+            name, {"entries": [], "next_id": 1, "groups": {}})
+
+    def group(self, st, name):
+        return st["groups"].setdefault(name, {"cursor": 0, "pending": set()})
+
+
+class _PyHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        state: _PyState = self.server.state  # type: ignore[attr-defined]
+        while True:
+            raw = self.rfile.readline()
+            if not raw:
+                return
+            line = raw.decode().rstrip("\r\n")
+            if not line:
+                continue
+            p = line.split(" ")
+            cmd = p[0]
+            w = self.wfile
+            if cmd == "PING":
+                w.write(b"+PONG\n")
+            elif cmd == "SHUTDOWN":
+                w.write(b"+BYE\n")
+                threading.Thread(target=self.server.shutdown,
+                                 daemon=True).start()
+                return
+            elif cmd == "XADD" and len(p) >= 3:
+                with state.cv:
+                    st = state.stream(p[1])
+                    eid = st["next_id"]
+                    st["next_id"] += 1
+                    st["entries"].append((eid, p[2]))
+                    state.cv.notify_all()
+                w.write(f"+{eid}\n".encode())
+            elif cmd == "XLEN" and len(p) >= 2:
+                with state.lock:
+                    n = len(state.stream(p[1])["entries"])
+                w.write(f":{n}\n".encode())
+            elif cmd == "XREADGROUP" and len(p) >= 6:
+                group, stream = p[1], p[3]
+                count, block_ms = int(p[4]), int(p[5])
+
+                def deliver():
+                    st = state.stream(stream)
+                    gr = state.group(st, group)
+                    got = []
+                    for eid, payload in st["entries"]:
+                        if eid <= gr["cursor"]:
+                            continue
+                        got.append((eid, payload))
+                        gr["cursor"] = eid
+                        gr["pending"].add(eid)
+                        if len(got) >= count:
+                            break
+                    return got
+                with state.cv:
+                    got = deliver()
+                    if not got and block_ms > 0:
+                        deadline = time.time() + block_ms / 1000.0
+                        while not got:
+                            left = deadline - time.time()
+                            if left <= 0:
+                                break
+                            state.cv.wait(left)
+                            got = deliver()
+                out = [f"*{len(got)}\n"]
+                out += [f"{eid} {payload}\n" for eid, payload in got]
+                w.write("".join(out).encode())
+            elif cmd == "XACK" and len(p) >= 4:
+                with state.lock:
+                    st = state.stream(p[1])
+                    gr = state.group(st, p[2])
+                    n = 1 if int(p[3]) in gr["pending"] else 0
+                    gr["pending"].discard(int(p[3]))
+                    # GC entries delivered+acked by every group (see
+                    # zbroker.cpp XACK)
+                    if st["groups"]:
+                        low = st["next_id"]
+                        for g in st["groups"].values():
+                            bound = g["cursor"]
+                            if g["pending"]:
+                                bound = min(bound, min(g["pending"]) - 1)
+                            low = min(low, bound)
+                        drop = 0
+                        entries = st["entries"]
+                        while drop < len(entries) and entries[drop][0] <= low:
+                            drop += 1
+                        if drop:
+                            st["entries"] = entries[drop:]
+                w.write(f":{n}\n".encode())
+            elif cmd == "XPENDING" and len(p) >= 3:
+                with state.lock:
+                    gr = state.group(state.stream(p[1]), p[2])
+                    n = len(gr["pending"])
+                w.write(f":{n}\n".encode())
+            elif cmd == "HSET" and len(p) >= 4:
+                with state.cv:
+                    state.hashes.setdefault(p[1], {})[p[2]] = p[3]
+                    state.cv.notify_all()
+                w.write(b"+OK\n")
+            elif cmd == "HGET" and len(p) >= 3:
+                with state.lock:
+                    val = state.hashes.get(p[1], {}).get(p[2])
+                w.write(f"${val}\n".encode() if val is not None else b"$-1\n")
+            elif cmd == "HKEYS" and len(p) >= 2:
+                with state.lock:
+                    keys = list(state.hashes.get(p[1], {}).keys())
+                w.write(("".join([f"*{len(keys)}\n"] +
+                                 [k + "\n" for k in keys])).encode())
+            elif cmd == "HDEL" and len(p) >= 3:
+                with state.lock:
+                    n = 1 if state.hashes.get(p[1], {}).pop(p[2], None) \
+                        is not None else 0
+                w.write(f":{n}\n".encode())
+            elif cmd == "DEL" and len(p) >= 2:
+                with state.lock:
+                    state.streams.pop(p[1], None)
+                    state.hashes.pop(p[1], None)
+                w.write(b"+OK\n")
+            else:
+                w.write(b"-ERR unknown command\n")
+            w.flush()
+
+
+class _PyBrokerServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class Broker:
+    """Owns a broker process (native) or thread (python fallback).
+
+    ``Broker.launch()`` prefers the native binary; ``backend="python"``
+    forces the in-process fallback (used by tests for both parities)."""
+
+    def __init__(self, port: int, proc=None, server=None):
+        self.port = port
+        self._proc = proc
+        self._server = server
+
+    @property
+    def backend(self) -> str:
+        return "native" if self._proc is not None else "python"
+
+    @classmethod
+    def launch(cls, port: int = 0, backend: str = "auto") -> "Broker":
+        if port == 0:
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+        if backend in ("auto", "native"):
+            binary = build_native_broker()
+            if binary is not None:
+                proc = subprocess.Popen(
+                    [binary, str(port)], stdout=subprocess.PIPE,
+                    stderr=subprocess.DEVNULL, text=True)
+                line = proc.stdout.readline()
+                if line.startswith("READY"):
+                    return cls(port, proc=proc)
+                proc.kill()
+            if backend == "native":
+                raise RuntimeError("native broker unavailable")
+        server = _PyBrokerServer(("127.0.0.1", port), _PyHandler)
+        server.state = _PyState()  # type: ignore[attr-defined]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        return cls(port, server=server)
+
+    def client(self, timeout: float = 30.0) -> BrokerClient:
+        return BrokerClient(port=self.port, timeout=timeout)
+
+    def stop(self):
+        if self._proc is not None:
+            try:
+                self.client(timeout=5.0).shutdown_broker()
+                self._proc.wait(timeout=5)
+            except Exception:
+                self._proc.kill()
+            self._proc = None
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

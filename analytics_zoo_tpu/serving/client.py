@@ -1,0 +1,80 @@
+"""Serving clients — InputQueue / OutputQueue.
+
+API parity with the reference python client (pyzoo/zoo/serving/client.py:
+``InputQueue:82`` with ``enqueue:144``, ``OutputQueue:234`` with
+``query``/``dequeue``): enqueue named tensors under a uri, poll the result
+store for the answer. The transport is the zbroker stream/hash protocol
+instead of Redis, and tensors ride the schema.py record format.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Dict, Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.serving.broker import BrokerClient
+from analytics_zoo_tpu.serving import schema
+
+INPUT_STREAM = "serving_stream"
+RESULT_HASH = "result"
+
+
+class InputQueue:
+    def __init__(self, host: str = "127.0.0.1", port: int = 6399,
+                 stream: str = INPUT_STREAM, cipher: schema.Cipher = None):
+        self._client = BrokerClient(host, port)
+        self.stream = stream
+        self.cipher = cipher
+
+    def enqueue(self, uri: Optional[str] = None, **inputs) -> str:
+        """``enqueue("img1", x=ndarray)``; returns the uri (generated when
+        not given). Multi-input models pass several named tensors."""
+        if not inputs:
+            raise ValueError("enqueue needs at least one named tensor")
+        uri = schema.validate_uri(uri or uuid.uuid4().hex)
+        payload = schema.encode_record(
+            uri, {k: np.asarray(v) for k, v in inputs.items()}, self.cipher)
+        self._client.xadd(self.stream, payload)
+        return uri
+
+    def __len__(self):
+        return self._client.xlen(self.stream)
+
+    def close(self):
+        self._client.close()
+
+
+class OutputQueue:
+    def __init__(self, host: str = "127.0.0.1", port: int = 6399,
+                 result_key: str = RESULT_HASH, cipher: schema.Cipher = None):
+        self._client = BrokerClient(host, port)
+        self.result_key = result_key
+        self.cipher = cipher
+
+    def query(self, uri: str, timeout: float = 0.0,
+              poll_interval: float = 0.01) -> Optional[np.ndarray]:
+        """Result for ``uri`` or None. ``timeout > 0`` polls until then
+        (the reference client polls the Redis hash the same way)."""
+        deadline = time.time() + timeout
+        while True:
+            val = self._client.hget(self.result_key, uri)
+            if val is not None:
+                return schema.decode_result(val, self.cipher)
+            if time.time() >= deadline:
+                return None
+            time.sleep(poll_interval)
+
+    def dequeue(self) -> Dict[str, np.ndarray]:
+        """Drain all available results (ref OutputQueue.dequeue)."""
+        out = {}
+        for uri in self._client.hkeys(self.result_key):
+            val = self._client.hget(self.result_key, uri)
+            if val is not None and self._client.hdel(self.result_key, uri):
+                out[uri] = schema.decode_result(val, self.cipher)
+        return out
+
+    def close(self):
+        self._client.close()

@@ -1,0 +1,91 @@
+"""Serving config — the reference's ``config.yaml`` surface
+(ref zoo/.../serving/utils/ConfigParser.scala:27 and
+scripts/cluster-serving/config.yaml: model path, redis host/port,
+batch size, record encryption flag).
+
+Parsed with PyYAML when available; otherwise a built-in reader that covers
+the two-level ``section: / key: value`` shape the serving config uses.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+def _parse_scalar(s: str):
+    s = s.strip().strip('"').strip("'")
+    low = s.lower()
+    if low in ("true", "yes"):
+        return True
+    if low in ("false", "no"):
+        return False
+    if low in ("null", "~", ""):
+        return None
+    for cast in (int, float):
+        try:
+            return cast(s)
+        except ValueError:
+            pass
+    return s
+
+
+def _mini_yaml(text: str) -> dict:
+    root: dict = {}
+    section = None
+    for raw in text.splitlines():
+        line = raw.split("#", 1)[0].rstrip()
+        if not line.strip():
+            continue
+        indented = line[0] in " \t"
+        key, _, val = line.strip().partition(":")
+        if not _:
+            continue
+        if not indented:
+            if val.strip():
+                root[key] = _parse_scalar(val)
+                section = None
+            else:
+                section = root.setdefault(key, {})
+        elif section is not None:
+            section[key] = _parse_scalar(val)
+    return root
+
+
+def load_yaml(path: str) -> dict:
+    with open(path) as f:
+        text = f.read()
+    try:
+        import yaml
+        return yaml.safe_load(text) or {}
+    except ImportError:
+        return _mini_yaml(text)
+
+
+@dataclass
+class ServingConfig:
+    model_path: str = ""
+    broker_host: str = "127.0.0.1"
+    broker_port: int = 6399
+    batch_size: int = 8
+    record_encrypted: bool = False
+    stream: str = "serving_stream"
+    result_key: str = "result"
+
+    @classmethod
+    def load(cls, path: str) -> "ServingConfig":
+        raw = load_yaml(path)
+        model = raw.get("model", {}) or {}
+        data = raw.get("data", {}) or {}
+        params = raw.get("params", {}) or {}
+        src = (data.get("src") or
+               f"{cls.broker_host}:{cls.broker_port}")
+        host, _, port = str(src).partition(":")
+        return cls(
+            model_path=model.get("path", "") or "",
+            broker_host=host or "127.0.0.1",
+            broker_port=int(port or 6399),
+            batch_size=int(params.get("batch_size", 8) or 8),
+            record_encrypted=bool(data.get("record_encrypted", False)),
+            stream=data.get("stream", "serving_stream") or "serving_stream",
+            result_key=data.get("result_key", "result") or "result")

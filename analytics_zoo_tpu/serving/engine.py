@@ -1,0 +1,197 @@
+"""ClusterServing engine — stream in → batch → TPU inference → result store.
+
+TPU-native replacement for the reference's Flink job (SURVEY.md §3.4):
+``FlinkRedisSource`` (XREADGROUP consumer-group batches,
+FlinkRedisSource.scala:81) → ``FlinkInference.map`` (decode, batch predict
+through InferenceModel, FlinkInference.scala:67-81) → ``FlinkRedisSink``
+(HSET results). The Flink ``RichMapFunction`` parallelism becomes host
+threads feeding ONE compiled executable: on TPU the model replica count of
+the reference ("parallelism = model parallelism", ClusterServing.scala:54-67)
+is the wrong knob — a single jitted forward at a fixed batch bucket keeps
+the MXU saturated, so the engine pads each dequeued batch up to
+``batch_size`` and masks the tail (same trick the reference applies per-core
+via its batch slicing, tf_dataset.py:117).
+
+Per-stage latency stats mirror serving ``Timer.scala:26``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.serving import schema
+from analytics_zoo_tpu.serving.broker import Broker, BrokerClient
+from analytics_zoo_tpu.serving.client import INPUT_STREAM, RESULT_HASH
+
+logger = logging.getLogger(__name__)
+
+
+class StageTimer:
+    """Per-stage wall-time stats (ref serving/utils/Timer.scala:26)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.stats: Dict[str, List[float]] = {}
+
+    def record(self, stage: str, dt: float):
+        with self._lock:
+            self.stats.setdefault(stage, []).append(dt)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            out = {}
+            for stage, xs in self.stats.items():
+                arr = np.asarray(xs)
+                out[stage] = {"count": len(xs), "mean_ms": float(arr.mean() * 1e3),
+                              "p99_ms": float(np.percentile(arr, 99) * 1e3),
+                              "total_s": float(arr.sum())}
+            return out
+
+
+class ClusterServing:
+    """The serving job (ref ClusterServing.scala:31).
+
+    ``model``: an InferenceModel (already loaded). ``input_cols``: the order
+    in which record tensors feed the model's inputs (single-input models
+    take the record's only tensor).
+    """
+
+    def __init__(self, model, broker_port: int, batch_size: int = 8,
+                 stream: str = INPUT_STREAM, result_key: str = RESULT_HASH,
+                 group: str = "serving", consumer: str = "c0",
+                 input_cols: Optional[List[str]] = None,
+                 cipher: schema.Cipher = None,
+                 postprocess=None, block_ms: int = 50):
+        self.model = model
+        self.batch_size = int(batch_size)
+        self.broker_port = broker_port
+        self.stream, self.result_key = stream, result_key
+        self.group, self.consumer = group, consumer
+        self.input_cols = input_cols
+        self.cipher = cipher
+        self.postprocess = postprocess
+        self.block_ms = block_ms
+        self.timer = StageTimer()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.records_out = 0
+
+    # --------------------------------------------------------------- loop
+    def _serve_once(self, client: BrokerClient) -> int:
+        t0 = time.time()
+        entries = client.xreadgroup(self.group, self.consumer, self.stream,
+                                    self.batch_size, self.block_ms)
+        if not entries:
+            return 0
+        self.timer.record("dequeue", time.time() - t0)
+
+        t0 = time.time()
+        uris, rows = [], []
+        for eid, payload in entries:
+            # one bad record (corrupt b64, wrong cipher, bad uri) must not
+            # take the batch or the serve loop down: store an error result
+            # for it and continue
+            try:
+                uri, inputs = schema.decode_record(payload, self.cipher)
+                schema.validate_uri(uri)
+                uris.append(uri)
+                rows.append(inputs)
+            except Exception as e:
+                logger.warning("dropping undecodable record %s: %s", eid, e)
+                client.xack(self.stream, self.group, eid)
+        if rows:
+            shapes = {k: np.shape(v) for k, v in rows[0].items()}
+            kept_uris, kept = [], []
+            for uri, r in zip(uris, rows):
+                if {k: np.shape(v) for k, v in r.items()} == shapes:
+                    kept_uris.append(uri)
+                    kept.append(r)
+                else:
+                    client.hset(self.result_key, uri, schema.encode_error(
+                        f"tensor shapes {shapes} expected", self.cipher))
+            uris, rows = kept_uris, kept
+        if not rows:
+            for eid, _ in entries:
+                client.xack(self.stream, self.group, eid)
+            return 0
+        cols = self.input_cols or sorted(rows[0].keys())
+        batch = [np.stack([r[c] for r in rows]) for c in cols]
+        n = len(rows)
+        if n < self.batch_size:  # pad to the compile bucket
+            batch = [np.concatenate(
+                [b, np.repeat(b[-1:], self.batch_size - n, axis=0)])
+                for b in batch]
+        self.timer.record("preprocess", time.time() - t0)
+
+        t0 = time.time()
+        x = batch[0] if len(batch) == 1 else tuple(batch)
+        preds = np.asarray(self.model.predict(x))[:n]
+        self.timer.record("inference", time.time() - t0)
+
+        t0 = time.time()
+        for uri, pred in zip(uris, preds):
+            if self.postprocess is not None:
+                pred = self.postprocess(pred)
+            client.hset(self.result_key, uri,
+                        schema.encode_result(pred, self.cipher))
+        for eid, _ in entries:
+            client.xack(self.stream, self.group, eid)
+        self.timer.record("postprocess", time.time() - t0)
+        self.records_out += n
+        return n
+
+    def _run(self):
+        client = BrokerClient(port=self.broker_port)
+        logger.info("serving started: stream=%s batch=%d",
+                    self.stream, self.batch_size)
+        while not self._stop.is_set():
+            try:
+                self._serve_once(client)
+            except ConnectionError:
+                if self._stop.is_set():
+                    break
+                logger.exception("broker connection lost; reconnecting")
+                time.sleep(0.2)
+                try:
+                    client.close()
+                    client = BrokerClient(port=self.broker_port)
+                except OSError:
+                    continue
+            except Exception:
+                # the loop is the service — survive anything per-batch
+                logger.exception("serve step failed; continuing")
+                time.sleep(0.05)
+        client.close()
+
+    # ---------------------------------------------------------------- api
+    def start(self) -> "ClusterServing":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def metrics(self) -> Dict:
+        """Throughput + stage latencies (ref Flink numRecordsOutPerSecond +
+        Timer stats)."""
+        out = {"records_out": self.records_out}
+        out.update(self.timer.summary())
+        return out
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

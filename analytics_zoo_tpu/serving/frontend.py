@@ -1,0 +1,112 @@
+"""HTTP frontend — synchronous predict endpoint over the serving plane.
+
+Replaces the reference's akka-http frontend
+(zoo/.../serving/http/FrontEndApp.scala:41,362: POST a payload, the handler
+enqueues to Redis and awaits the result). Endpoints:
+
+- ``POST /predict``  body = JSON ``{"inputs": {name: {dtype, shape, data}}}``
+  (schema.py tensor encoding) → ``{"uri", "result": tensor}``
+- ``GET  /metrics``  → engine metrics JSON
+- ``GET  /``         → liveness
+
+stdlib ``ThreadingHTTPServer`` — no framework dependency; each request
+thread owns its queue clients (the broker protocol is connection-oriented).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from analytics_zoo_tpu.serving import schema
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _json(self, code: int, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        srv = self.server  # type: ignore[assignment]
+        if self.path == "/metrics":
+            engine = srv.engine
+            self._json(200, engine.metrics() if engine else {})
+        else:
+            self._json(200, {"status": "ok"})
+
+    def do_POST(self):
+        srv = self.server  # type: ignore[assignment]
+        if self.path != "/predict":
+            self._json(404, {"error": "unknown path"})
+            return
+        in_q = out_q = None
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(n))
+            inputs = {k: schema.decode_tensor(v)
+                      for k, v in payload["inputs"].items()}
+            in_q = InputQueue(port=srv.broker_port, cipher=srv.cipher)
+            uri = in_q.enqueue(payload.get("uri"), **inputs)
+        except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+            self._json(400, {"error": f"bad request: {e}"})
+            return
+        finally:
+            if in_q is not None:
+                in_q.close()
+        try:
+            out_q = OutputQueue(port=srv.broker_port, cipher=srv.cipher)
+            result = out_q.query(uri, timeout=srv.timeout_s)
+        except schema.ServingError as e:
+            self._json(422, {"uri": uri, "error": str(e)})
+            return
+        finally:
+            if out_q is not None:
+                out_q.close()
+        if result is None:
+            self._json(504, {"uri": uri, "error": "timed out"})
+        else:
+            self._json(200, {"uri": uri,
+                             "result": schema.encode_tensor(result)})
+
+
+class FrontEnd:
+    """``FrontEnd(broker_port, engine).start()`` → serving HTTP on ``port``."""
+
+    def __init__(self, broker_port: int, engine=None, port: int = 0,
+                 timeout: float = 30.0, cipher: schema.Cipher = None):
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._httpd.broker_port = broker_port       # type: ignore[attr-defined]
+        self._httpd.engine = engine                 # type: ignore[attr-defined]
+        self._httpd.timeout_s = timeout             # type: ignore[attr-defined]
+        self._httpd.cipher = cipher                 # type: ignore[attr-defined]
+        # BaseHTTPRequestHandler reads .timeout off the server for socket
+        # timeouts; keep our own name distinct
+        self._httpd.timeout = None                  # type: ignore[attr-defined]
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "FrontEnd":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,300 @@
+// zbroker — native stream broker for Cluster Serving.
+//
+// TPU-native analog of the Redis server the reference uses as its serving
+// data plane (ref zoo/.../serving/engine/FlinkRedisSource.scala:32-106
+// consumes via XREADGROUP, FlinkRedisSink XADDs results; the python client
+// pyzoo/zoo/serving/client.py speaks the same stream + hash commands).
+// Rather than embed a Redis dependency, this is a single-file C++ broker
+// speaking a line protocol with the subset of semantics serving needs:
+//
+//   PING                                        -> +PONG
+//   XADD <stream> <b64>                         -> +<id>
+//   XLEN <stream>                               -> :<n>
+//   XREADGROUP <group> <consumer> <stream> <count> <block_ms>
+//                                               -> *<n> then n lines "<id> <b64>"
+//   XACK <stream> <group> <id>                  -> :<n-acked>
+//   XPENDING <stream> <group>                   -> :<n-pending>
+//   HSET <key> <field> <b64>                    -> +OK
+//   HGET <key> <field>                          -> $<b64> | $-1
+//   HKEYS <key>                                 -> *<n> then n lines "<field>"
+//   HDEL <key> <field>                          -> :<n-deleted>
+//   DEL <key>                                   -> +OK
+//   SHUTDOWN                                    -> +BYE (process exits)
+//
+// Concurrency: one thread per connection; one global mutex over state (the
+// payloads are opaque b64 strings, so critical sections are pointer work);
+// blocking XREADGROUP waits on a condition_variable. Delivery semantics
+// mirror Redis streams: per-(stream,group) cursor of last-delivered id;
+// un-ACKed entries are tracked per group (XPENDING) for crash visibility.
+//
+// Build: g++ -O2 -std=c++17 -pthread -o zbroker zbroker.cpp
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Entry {
+  long long id;
+  std::string payload;
+};
+
+struct Group {
+  long long cursor = 0;                 // last delivered id
+  std::set<long long> pending;          // delivered, not yet acked
+};
+
+struct Stream {
+  std::vector<Entry> entries;
+  long long next_id = 1;
+  std::map<std::string, Group> groups;
+};
+
+std::mutex g_mu;
+std::condition_variable g_cv;
+std::map<std::string, Stream> g_streams;
+std::map<std::string, std::map<std::string, std::string>> g_hashes;
+bool g_shutdown = false;
+int g_srv_fd = -1;
+
+std::string ReadLine(int fd, bool* ok) {
+  std::string line;
+  char c;
+  while (true) {
+    ssize_t n = recv(fd, &c, 1, 0);
+    if (n <= 0) { *ok = false; return line; }
+    if (c == '\n') break;
+    if (c != '\r') line.push_back(c);
+    if (line.size() > (64u << 20)) { *ok = false; return line; }
+  }
+  *ok = true;
+  return line;
+}
+
+void SendAll(int fd, const std::string& s) {
+  size_t off = 0;
+  while (off < s.size()) {
+    ssize_t n = send(fd, s.data() + off, s.size() - off, MSG_NOSIGNAL);
+    if (n <= 0) return;
+    off += static_cast<size_t>(n);
+  }
+}
+
+std::vector<std::string> Split(const std::string& s, size_t max_parts) {
+  std::vector<std::string> out;
+  size_t i = 0;
+  while (i < s.size() && out.size() + 1 < max_parts) {
+    size_t j = s.find(' ', i);
+    if (j == std::string::npos) break;
+    out.push_back(s.substr(i, j - i));
+    i = j + 1;
+  }
+  if (i <= s.size()) out.push_back(s.substr(i));
+  return out;
+}
+
+void HandleConn(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  while (true) {
+    bool ok;
+    std::string line = ReadLine(fd, &ok);
+    if (!ok) break;
+    if (line.empty()) continue;
+    std::vector<std::string> p = Split(line, 8);
+    const std::string& cmd = p[0];
+
+    if (cmd == "PING") {
+      SendAll(fd, "+PONG\n");
+    } else if (cmd == "SHUTDOWN") {
+      SendAll(fd, "+BYE\n");
+      {
+        std::lock_guard<std::mutex> lk(g_mu);
+        g_shutdown = true;
+      }
+      g_cv.notify_all();
+      if (g_srv_fd >= 0) shutdown(g_srv_fd, SHUT_RDWR);  // unblock accept()
+      break;
+    } else if (cmd == "XADD" && p.size() >= 3) {
+      long long id;
+      {
+        std::lock_guard<std::mutex> lk(g_mu);
+        Stream& st = g_streams[p[1]];
+        id = st.next_id++;
+        st.entries.push_back({id, p[2]});
+      }
+      g_cv.notify_all();
+      SendAll(fd, "+" + std::to_string(id) + "\n");
+    } else if (cmd == "XLEN" && p.size() >= 2) {
+      std::lock_guard<std::mutex> lk(g_mu);
+      SendAll(fd, ":" + std::to_string(g_streams[p[1]].entries.size()) + "\n");
+    } else if (cmd == "XREADGROUP" && p.size() >= 6) {
+      const std::string &group = p[1], &stream = p[3];
+      int count = atoi(p[4].c_str());
+      int block_ms = atoi(p[5].c_str());
+      std::vector<Entry> got;
+      {
+        std::unique_lock<std::mutex> lk(g_mu);
+        auto deliver = [&]() {
+          Stream& st = g_streams[stream];
+          Group& gr = st.groups[group];
+          for (const Entry& e : st.entries) {
+            if (e.id <= gr.cursor) continue;
+            got.push_back(e);
+            gr.cursor = e.id;
+            gr.pending.insert(e.id);
+            if (static_cast<int>(got.size()) >= count) break;
+          }
+          return !got.empty();
+        };
+        if (!deliver() && block_ms > 0) {
+          g_cv.wait_for(lk, std::chrono::milliseconds(block_ms), [&]() {
+            return g_shutdown || deliver();
+          });
+        }
+      }
+      std::ostringstream os;
+      os << "*" << got.size() << "\n";
+      for (const Entry& e : got) os << e.id << " " << e.payload << "\n";
+      SendAll(fd, os.str());
+    } else if (cmd == "XACK" && p.size() >= 4) {
+      int n = 0;
+      {
+        std::lock_guard<std::mutex> lk(g_mu);
+        Stream& st = g_streams[p[1]];
+        Group& gr = st.groups[p[2]];
+        n = static_cast<int>(gr.pending.erase(atoll(p[3].c_str())));
+        // GC: drop entries delivered to every group and acked everywhere
+        // (Redis needs explicit XTRIM; serving never re-reads old ids)
+        if (!st.groups.empty()) {
+          long long low = st.next_id;
+          for (auto& kv : st.groups) {
+            long long bound = kv.second.cursor;
+            if (!kv.second.pending.empty())
+              bound = std::min(bound, *kv.second.pending.begin() - 1);
+            low = std::min(low, bound);
+          }
+          size_t drop = 0;
+          while (drop < st.entries.size() && st.entries[drop].id <= low)
+            ++drop;
+          if (drop > 0)
+            st.entries.erase(st.entries.begin(), st.entries.begin() + drop);
+        }
+      }
+      SendAll(fd, ":" + std::to_string(n) + "\n");
+    } else if (cmd == "XPENDING" && p.size() >= 3) {
+      std::lock_guard<std::mutex> lk(g_mu);
+      Group& gr = g_streams[p[1]].groups[p[2]];
+      SendAll(fd, ":" + std::to_string(gr.pending.size()) + "\n");
+    } else if (cmd == "HSET" && p.size() >= 4) {
+      {
+        std::lock_guard<std::mutex> lk(g_mu);
+        g_hashes[p[1]][p[2]] = p[3];
+      }
+      g_cv.notify_all();
+      SendAll(fd, "+OK\n");
+    } else if (cmd == "HGET" && p.size() >= 3) {
+      std::string val;
+      bool found = false;
+      {
+        std::lock_guard<std::mutex> lk(g_mu);
+        auto h = g_hashes.find(p[1]);
+        if (h != g_hashes.end()) {
+          auto f = h->second.find(p[2]);
+          if (f != h->second.end()) { val = f->second; found = true; }
+        }
+      }
+      SendAll(fd, found ? "$" + val + "\n" : "$-1\n");
+    } else if (cmd == "HKEYS" && p.size() >= 2) {
+      std::ostringstream os;
+      {
+        std::lock_guard<std::mutex> lk(g_mu);
+        auto h = g_hashes.find(p[1]);
+        size_t n = (h == g_hashes.end()) ? 0 : h->second.size();
+        os << "*" << n << "\n";
+        if (h != g_hashes.end())
+          for (auto& kv : h->second) os << kv.first << "\n";
+      }
+      SendAll(fd, os.str());
+    } else if (cmd == "HDEL" && p.size() >= 3) {
+      int n = 0;
+      {
+        std::lock_guard<std::mutex> lk(g_mu);
+        auto h = g_hashes.find(p[1]);
+        if (h != g_hashes.end())
+          n = static_cast<int>(h->second.erase(p[2]));
+      }
+      SendAll(fd, ":" + std::to_string(n) + "\n");
+    } else if (cmd == "DEL" && p.size() >= 2) {
+      {
+        std::lock_guard<std::mutex> lk(g_mu);
+        g_streams.erase(p[1]);
+        g_hashes.erase(p[1]);
+      }
+      SendAll(fd, "+OK\n");
+    } else {
+      SendAll(fd, "-ERR unknown command\n");
+    }
+    {
+      std::lock_guard<std::mutex> lk(g_mu);
+      if (g_shutdown) break;
+    }
+  }
+  close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = argc > 1 ? atoi(argv[1]) : 6399;
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  g_srv_fd = srv;
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(srv, 64) != 0) {
+    perror("listen");
+    return 1;
+  }
+  // readiness handshake for the launcher
+  fprintf(stdout, "READY %d\n", port);
+  fflush(stdout);
+  while (true) {
+    int fd = accept(srv, nullptr, nullptr);
+    {
+      std::lock_guard<std::mutex> lk(g_mu);
+      if (g_shutdown) { if (fd >= 0) close(fd); break; }
+    }
+    if (fd < 0) {
+      std::lock_guard<std::mutex> lk(g_mu);
+      if (g_shutdown) break;
+      continue;
+    }
+    // detached: connections are short-lived client sessions; keeping a
+    // growing vector of finished threads would leak
+    std::thread(HandleConn, fd).detach();
+  }
+  close(srv);
+  return 0;
+}

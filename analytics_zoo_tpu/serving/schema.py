@@ -1,0 +1,98 @@
+"""Serving wire format — how tensors travel through the data plane.
+
+The reference's client b64-encodes either Arrow-serialized ndarrays or raw
+image bytes into Redis stream fields (pyzoo/zoo/serving/client.py:144
+``enqueue``; JVM decode in serving/preprocessing/PreProcessing.scala:67-90).
+Here a record is one JSON object — ``{"uri", "inputs": {name: tensor}}`` —
+where each tensor carries dtype/shape plus b64 raw bytes (C-order), the
+whole record b64-wrapped for the line protocol. Arrow adds nothing for
+fixed-dtype dense tensors and this keeps the broker payloads opaque ASCII.
+
+Optional record encryption (the reference's PPML ``recordEncrypted`` flag,
+FlinkInference.scala:55) plugs in as an (encrypt, decrypt) byte-callable
+pair.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import re
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+Cipher = Optional[Tuple[Callable[[bytes], bytes], Callable[[bytes], bytes]]]
+
+# uris become fields of the space/newline-delimited broker protocol: a
+# permissive uri would corrupt the framing (or inject commands), so the
+# charset is locked down at the schema boundary.
+_URI_RE = re.compile(r"^[A-Za-z0-9._:-]{1,256}$")
+
+
+class ServingError(RuntimeError):
+    """An error result stored in place of a prediction."""
+
+
+def validate_uri(uri: str) -> str:
+    if not _URI_RE.match(uri or ""):
+        raise ValueError(
+            f"bad uri {uri!r}: use 1-256 chars of [A-Za-z0-9._:-]")
+    return uri
+
+
+def encode_tensor(arr: np.ndarray) -> dict:
+    arr = np.ascontiguousarray(arr)
+    return {"dtype": arr.dtype.str, "shape": list(arr.shape),
+            "data": base64.b64encode(arr.tobytes()).decode()}
+
+
+def decode_tensor(obj: dict) -> np.ndarray:
+    raw = base64.b64decode(obj["data"])
+    return np.frombuffer(raw, dtype=np.dtype(obj["dtype"])).reshape(
+        obj["shape"]).copy()
+
+
+def encode_record(uri: str, inputs: Dict[str, np.ndarray],
+                  cipher: Cipher = None) -> str:
+    body = json.dumps(
+        {"uri": uri,
+         "inputs": {k: encode_tensor(np.asarray(v))
+                    for k, v in inputs.items()}}).encode()
+    if cipher is not None:
+        body = cipher[0](body)
+    return base64.b64encode(body).decode()
+
+
+def decode_record(payload_b64: str, cipher: Cipher = None
+                  ) -> Tuple[str, Dict[str, np.ndarray]]:
+    body = base64.b64decode(payload_b64)
+    if cipher is not None:
+        body = cipher[1](body)
+    obj = json.loads(body)
+    return obj["uri"], {k: decode_tensor(v)
+                        for k, v in obj["inputs"].items()}
+
+
+def encode_result(arr: np.ndarray, cipher: Cipher = None) -> str:
+    body = json.dumps(encode_tensor(np.asarray(arr))).encode()
+    if cipher is not None:
+        body = cipher[0](body)
+    return base64.b64encode(body).decode()
+
+
+def encode_error(message: str, cipher: Cipher = None) -> str:
+    body = json.dumps({"error": str(message)[:2000]}).encode()
+    if cipher is not None:
+        body = cipher[0](body)
+    return base64.b64encode(body).decode()
+
+
+def decode_result(payload_b64: str, cipher: Cipher = None) -> np.ndarray:
+    body = base64.b64decode(payload_b64)
+    if cipher is not None:
+        body = cipher[1](body)
+    obj = json.loads(body)
+    if "error" in obj:
+        raise ServingError(obj["error"])
+    return decode_tensor(obj)

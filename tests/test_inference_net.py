@@ -63,6 +63,70 @@ class TestTorchTranslation:
         with pytest.raises(NotImplementedError, match="PReLU"):
             torch_to_jax(m)
 
+    def test_pool_padding_matches_torch(self):
+        torch.manual_seed(4)
+        m = tnn.Sequential(tnn.MaxPool2d(3, stride=2, padding=1),
+                           tnn.AvgPool2d(2, padding=1)).eval()
+        x = np.random.RandomState(4).randn(1, 2, 8, 8).astype(np.float32)
+        want = m(torch.from_numpy(x)).detach().numpy()
+        got = TorchNet(m).predict(x)
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_pool_ceil_mode_raises(self):
+        m = tnn.Sequential(tnn.MaxPool2d(2, ceil_mode=True))
+        with pytest.raises(NotImplementedError, match="ceil_mode"):
+            torch_to_jax(m)
+
+    def test_bn_stats_are_frozen_buffers(self, orca_ctx):
+        from analytics_zoo_tpu.learn.estimator import Estimator
+        torch.manual_seed(5)
+        m = tnn.Sequential(tnn.Linear(4, 8), tnn.BatchNorm1d(8),
+                           tnn.ReLU(), tnn.Linear(8, 2))
+        # prime the running stats so they are non-trivial
+        m.train()
+        m(torch.randn(32, 4))
+        m.eval()
+        _, variables = torch_to_jax(m)
+        assert "mean" in variables["buffers"]["1"]
+        rng = np.random.RandomState(5)
+        x = rng.randn(64, 4).astype(np.float32)
+        y = (x.sum(1) > 0).astype(np.int32)
+        est = Estimator.from_torch(
+            model=m, loss="sparse_categorical_crossentropy",
+            optimizer="adam", sample_input=x[:2])
+        before = np.array(variables["buffers"]["1"]["mean"])
+        h = est.fit((x, y), epochs=3, batch_size=16)
+        assert all(np.isfinite(v) for v in h["loss"])
+        import jax
+        after = jax.device_get(est._state["model_state"]["1"]["mean"])
+        np.testing.assert_allclose(after, before, atol=1e-7)
+
+    def test_direct_parameter_is_trained(self, orca_ctx):
+        from analytics_zoo_tpu.learn.estimator import Estimator
+
+        class M(tnn.Module):
+            def __init__(self):
+                super().__init__()
+                self.w = tnn.Parameter(torch.zeros(4, 2))
+
+            def forward(self, x):
+                return x @ self.w
+
+        m = M()
+        apply_fn, variables = torch_to_jax(m)
+        assert "attr.w" in variables["params"]
+        rng = np.random.RandomState(6)
+        x = rng.randn(64, 4).astype(np.float32)
+        y = (x.sum(1) > 0).astype(np.int32)
+        est = Estimator.from_torch(
+            model=m, loss="sparse_categorical_crossentropy_logits",
+            optimizer="adam", sample_input=x[:2])
+        est.fit((x, y), epochs=2, batch_size=16)
+        import jax
+        trained = jax.device_get(est._state["params"]["attr.w"])
+        assert np.abs(trained).max() > 0, "direct nn.Parameter never trained"
+
     def test_estimator_from_torch_trains(self, orca_ctx):
         from analytics_zoo_tpu.learn.estimator import Estimator
         torch.manual_seed(3)
@@ -71,7 +135,7 @@ class TestTorchTranslation:
         x = rng.randn(64, 4).astype(np.float32)
         y = (x.sum(1) > 0).astype(np.int32)
         est = Estimator.from_torch(
-            model=m, loss="sparse_categorical_crossentropy",
+            model=m, loss="sparse_categorical_crossentropy_logits",
             optimizer="adam", sample_input=x[:2])
         h1 = est.fit((x, y), epochs=1, batch_size=16)
         h5 = est.fit((x, y), epochs=5, batch_size=16)
@@ -161,7 +225,7 @@ class TestInferenceModel:
         x = rng.randn(32, 4).astype(np.float32)
         y = (x.sum(1) > 0).astype(np.int32)
         est = Estimator.from_torch(
-            model=m, loss="sparse_categorical_crossentropy",
+            model=m, loss="sparse_categorical_crossentropy_logits",
             optimizer="adam", sample_input=x[:2])
         est.fit((x, y), epochs=2, batch_size=8)
         ckpt = str(tmp_path / "ckpt")

@@ -1,0 +1,233 @@
+"""Cluster Serving tests (mirrors ref pyzoo/test/zoo/serving/ + Scala
+serving specs): broker protocol, wire schema, end-to-end stream → inference
+→ result, HTTP frontend, config parsing."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.serving import (
+    Broker, ClusterServing, FrontEnd, InputQueue, OutputQueue, ServingConfig,
+)
+from analytics_zoo_tpu.serving import schema
+from analytics_zoo_tpu.serving.broker import build_native_broker
+
+
+BACKENDS = ["python"] + (["native"] if build_native_broker() else [])
+
+
+@pytest.fixture(params=BACKENDS)
+def broker(request):
+    b = Broker.launch(backend=request.param)
+    yield b
+    b.stop()
+
+
+class TestBrokerProtocol:
+    def test_ping_xadd_xlen(self, broker):
+        c = broker.client()
+        assert c.ping()
+        assert c.xadd("s", "YWJj") == 1
+        assert c.xadd("s", "ZGVm") == 2
+        assert c.xlen("s") == 2
+
+    def test_consumer_group_delivery_and_ack(self, broker):
+        c = broker.client()
+        for i in range(5):
+            c.xadd("s", f"cGF5bG9hZA{i}=")
+        got = c.xreadgroup("g", "c0", "s", 3)
+        assert [e[0] for e in got] == [1, 2, 3]
+        # same group continues at cursor; different group restarts
+        got2 = c.xreadgroup("g", "c1", "s", 10)
+        assert [e[0] for e in got2] == [4, 5]
+        other = c.xreadgroup("g2", "c0", "s", 10)
+        assert len(other) == 5
+        assert c.xpending("s", "g") == 5
+        assert c.xack("s", "g", 1) == 1
+        assert c.xack("s", "g", 1) == 0  # double-ack
+        assert c.xpending("s", "g") == 4
+
+    def test_blocking_read_wakes_on_add(self, broker):
+        c_reader = broker.client()
+        results = []
+
+        def reader():
+            results.extend(c_reader.xreadgroup("g", "c", "s", 1, 3000))
+
+        t = threading.Thread(target=reader)
+        t.start()
+        c = broker.client()
+        c.xadd("s", "aGk=")
+        t.join(timeout=5)
+        assert not t.is_alive() and results and results[0][0] == 1
+
+    def test_hash_ops(self, broker):
+        c = broker.client()
+        assert c.hget("h", "k") is None
+        c.hset("h", "k", "dg==")
+        assert c.hget("h", "k") == "dg=="
+        assert sorted(c.hkeys("h")) == ["k"]
+        assert c.hdel("h", "k") == 1
+        assert c.hdel("h", "k") == 0
+
+
+class TestSchema:
+    def test_tensor_roundtrip(self):
+        for arr in (np.random.randn(3, 4).astype(np.float32),
+                    np.arange(6, dtype=np.int64).reshape(2, 3),
+                    np.array(3.5)):
+            got = schema.decode_tensor(schema.encode_tensor(arr))
+            np.testing.assert_array_equal(got, arr)
+            assert got.dtype == arr.dtype
+
+    def test_record_roundtrip(self):
+        x = np.random.randn(2, 5).astype(np.float32)
+        y = np.arange(2)
+        uri, inputs = schema.decode_record(
+            schema.encode_record("r1", {"x": x, "y": y}))
+        assert uri == "r1"
+        np.testing.assert_array_equal(inputs["x"], x)
+        np.testing.assert_array_equal(inputs["y"], y)
+
+
+def _make_model():
+    import torch
+    import torch.nn as tnn
+    from analytics_zoo_tpu.inference import InferenceModel
+    torch.manual_seed(0)
+    m = tnn.Sequential(tnn.Linear(4, 8), tnn.ReLU(), tnn.Linear(8, 3),
+                       tnn.Softmax(dim=-1))
+    return InferenceModel().load_torch(m, np.zeros((1, 4), np.float32)), m
+
+
+class TestEndToEnd:
+    def test_stream_to_result(self, broker):
+        im, torch_m = _make_model()
+        rng = np.random.RandomState(0)
+        xs = {f"u{i}": rng.randn(4).astype(np.float32) for i in range(10)}
+        with ClusterServing(im, broker.port, batch_size=4).start() as serving:
+            in_q = InputQueue(port=broker.port)
+            out_q = OutputQueue(port=broker.port)
+            for uri, x in xs.items():
+                in_q.enqueue(uri, x=x)
+            results = {u: out_q.query(u, timeout=20.0) for u in xs}
+        import torch
+        for uri, x in xs.items():
+            assert results[uri] is not None, f"no result for {uri}"
+            want = torch_m(torch.from_numpy(x[None])).detach().numpy()[0]
+            np.testing.assert_allclose(results[uri], want, atol=1e-4)
+        m = serving.metrics()
+        assert m["records_out"] == 10
+        assert "inference" in m and m["inference"]["count"] >= 1
+
+    def test_dequeue_drains(self, broker):
+        im, _ = _make_model()
+        with ClusterServing(im, broker.port, batch_size=2).start():
+            in_q = InputQueue(port=broker.port)
+            out_q = OutputQueue(port=broker.port)
+            for i in range(4):
+                in_q.enqueue(f"d{i}", x=np.zeros(4, np.float32))
+            got = {}
+            import time
+            deadline = time.time() + 20
+            while len(got) < 4 and time.time() < deadline:
+                got.update(out_q.dequeue())
+                time.sleep(0.02)
+        assert sorted(got) == [f"d{i}" for i in range(4)]
+        # drained: a second dequeue is empty
+        assert out_q.dequeue() == {}
+
+    def test_http_frontend(self, broker):
+        im, torch_m = _make_model()
+        x = np.random.RandomState(1).randn(4).astype(np.float32)
+        with ClusterServing(im, broker.port, batch_size=2).start() as eng, \
+                FrontEnd(broker.port, engine=eng, timeout=20.0).start() as fe:
+            body = json.dumps(
+                {"inputs": {"x": schema.encode_tensor(x)}}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{fe.port}/predict", data=body,
+                headers={"Content-Type": "application/json"})
+            resp = json.loads(urllib.request.urlopen(req, timeout=30).read())
+            assert "result" in resp, resp
+            got = schema.decode_tensor(resp["result"])
+            mreq = urllib.request.urlopen(
+                f"http://127.0.0.1:{fe.port}/metrics", timeout=10)
+            metrics = json.loads(mreq.read())
+        import torch
+        want = torch_m(torch.from_numpy(x[None])).detach().numpy()[0]
+        np.testing.assert_allclose(got, want, atol=1e-4)
+        assert metrics["records_out"] >= 1
+
+
+class TestResilience:
+    def test_bad_uri_rejected(self, broker):
+        in_q = InputQueue(port=broker.port)
+        for bad in ("has space", "new\nline", "x" * 300):
+            with pytest.raises(ValueError, match="bad uri"):
+                in_q.enqueue(bad, x=np.zeros(2, np.float32))
+        # empty/None uri is not an error — it auto-generates
+        assert in_q.enqueue("", x=np.zeros(2, np.float32))
+
+    def test_malformed_record_does_not_kill_engine(self, broker):
+        im, _ = _make_model()
+        with ClusterServing(im, broker.port, batch_size=2).start():
+            c = broker.client()
+            # undecodable payload: skipped with a warning, acked
+            c.xadd("serving_stream", "bm90anNvbg==")  # not a record
+            in_q = InputQueue(port=broker.port)
+            out_q = OutputQueue(port=broker.port)
+            in_q.enqueue("okshape", x=np.zeros(4, np.float32))
+            assert out_q.query("okshape", timeout=20.0) is not None
+            # inference-breaking shape (wrong inner dim): the serve step
+            # fails but the loop survives
+            in_q.enqueue("badshape", x=np.zeros(5, np.float32))
+            # wait until the bad record was consumed (it never resolves)
+            # before sending more, so they don't share its batch
+            assert out_q.query("badshape", timeout=2.0) is None
+            # engine still alive for subsequent good records
+            in_q.enqueue("after", x=np.ones(4, np.float32))
+            assert out_q.query("after", timeout=20.0) is not None
+
+    def test_broker_gc_trims_acked_entries(self, broker):
+        c = broker.client()
+        for i in range(10):
+            c.xadd("s", "ZA==")
+        got = c.xreadgroup("g", "c0", "s", 10)
+        for eid, _ in got:
+            c.xack("s", "g", eid)
+        assert c.xlen("s") == 0  # all delivered+acked → trimmed
+
+    def test_frontend_empty_inputs_is_400(self, broker):
+        im, _ = _make_model()
+        with ClusterServing(im, broker.port, batch_size=2).start() as eng, \
+                FrontEnd(broker.port, engine=eng, timeout=5.0).start() as fe:
+            body = json.dumps({"inputs": {}}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{fe.port}/predict", data=body)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 400
+
+
+class TestConfig:
+    def test_yaml_parse(self, tmp_path):
+        p = tmp_path / "config.yaml"
+        p.write_text(
+            "model:\n  path: /models/ncf\n"
+            "data:\n  src: 127.0.0.1:7012\n  record_encrypted: true\n"
+            "params:\n  batch_size: 32\n")
+        cfg = ServingConfig.load(str(p))
+        assert cfg.model_path == "/models/ncf"
+        assert cfg.broker_port == 7012
+        assert cfg.batch_size == 32
+        assert cfg.record_encrypted is True
+
+    def test_defaults(self, tmp_path):
+        p = tmp_path / "c.yaml"
+        p.write_text("model:\n  path: m\n")
+        cfg = ServingConfig.load(str(p))
+        assert cfg.batch_size == 8 and cfg.broker_port == 6399
