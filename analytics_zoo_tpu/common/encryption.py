@@ -111,13 +111,15 @@ def make_cipher(secret: str, mode: str = "gcm") -> Tuple:
     if mode not in ("gcm", "cbc"):
         raise ValueError(f"unknown cipher mode {mode!r}; use 'gcm' or 'cbc'")
     enc_salt = os.urandom(SALT_LEN)
-    keys: dict = {enc_salt: _derive_key(secret, enc_salt)}
+    enc_key = _derive_key(secret, enc_salt)
+    keys: dict = {enc_salt: enc_key}
 
     def key_for(salt: bytes) -> bytes:
         k = keys.get(salt)
         if k is None:
             if len(keys) > 1024:  # bound the cache: one salt per peer cipher
                 keys.clear()
+                keys[enc_salt] = enc_key  # never evict our own encrypt key
             k = keys[salt] = _derive_key(secret, salt)
         return k
 

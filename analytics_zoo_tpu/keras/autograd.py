@@ -1,0 +1,275 @@
+"""Autograd — symbolic tensor math for custom layers and losses.
+
+Parity with the reference's autograd surface
+(pyzoo/zoo/pipeline/api/autograd.py:32-568: module-level math functions,
+``Variable:369`` operator overloads, ``Lambda:393``, ``CustomLoss``; Scala
+lowering in zoo/.../pipeline/api/autograd/math.scala). There every
+expression becomes a BigDL layer graph; here every expression is a
+``keras.engine.Node`` whose op is a param-free jax lambda — the same graph
+machinery the Keras API compiles, so autograd expressions mix freely with
+zoo layers and everything fuses under jit.
+
+Usage (matches ref examples, e.g. KNRM's custom loss / variable math):
+
+    from analytics_zoo_tpu.keras import autograd as A
+    v = A.Variable(input_shape=(3,))
+    out = A.mean(A.abs(v1 - v2), axis=1)
+    loss = CustomLoss(lambda yt, yp: A.mean(A.square(yt - yp)), (3,))
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from analytics_zoo_tpu.keras.engine import (
+    Input, KerasLayer, Node, topo_sort,
+)
+
+
+class LambdaLayer(KerasLayer):
+    """A param-free op node: applies ``fn(*jax_arrays)``
+    (ref autograd.Lambda:393 / LambdaLayer). ``out_shape``: shape without
+    batch dim, or a callable of the input shapes."""
+
+    def __init__(self, fn: Callable, out_shape=None, name=None):
+        super().__init__(name)
+        self.fn = fn
+        self.out_shape = out_shape
+
+    def _infer_shape(self, in_shapes):
+        if callable(self.out_shape):
+            return self.out_shape(in_shapes)
+        if self.out_shape is not None:
+            return tuple(self.out_shape)
+        return in_shapes[0]
+
+    def apply(self, module, args, train):
+        return self.fn(*args)
+
+
+# public alias matching the reference spelling
+Lambda = LambdaLayer
+
+
+def Variable(input_shape: Sequence[int], name: str = "") -> Node:
+    """A symbolic tensor (ref autograd.Variable:369; batch dim excluded)."""
+    return Input(shape=input_shape, name=name)
+
+
+def _unary(fname: str, jfn, shape=None):
+    def op(x: Node, **kw) -> Node:
+        fn = (lambda a: jfn(a, **kw)) if kw else jfn
+        return LambdaLayer(fn, out_shape=shape, name=None)(x)
+    op.__name__ = fname
+    return op
+
+
+def _import_jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---- elementwise unary (ref autograd.py abs/exp/log/sqrt/square/...) ----
+def abs(x: Node) -> Node:  # noqa: A001 — reference API name
+    return LambdaLayer(lambda a: _import_jnp().abs(a))(x)
+
+
+def exp(x: Node) -> Node:
+    return LambdaLayer(lambda a: _import_jnp().exp(a))(x)
+
+
+def log(x: Node) -> Node:
+    return LambdaLayer(lambda a: _import_jnp().log(a))(x)
+
+
+def sqrt(x: Node) -> Node:
+    return LambdaLayer(lambda a: _import_jnp().sqrt(a))(x)
+
+
+def square(x: Node) -> Node:
+    return LambdaLayer(lambda a: _import_jnp().square(a))(x)
+
+
+def neg(x: Node) -> Node:
+    return LambdaLayer(lambda a: -a)(x)
+
+
+def softsign(x: Node) -> Node:
+    return LambdaLayer(lambda a: a / (1 + _import_jnp().abs(a)))(x)
+
+
+def softplus(x: Node) -> Node:
+    def f(a):
+        import jax
+        return jax.nn.softplus(a)
+    return LambdaLayer(f)(x)
+
+
+def clip(x: Node, min: float, max: float) -> Node:  # noqa: A002
+    return LambdaLayer(
+        lambda a: _import_jnp().clip(a, min, max))(x)
+
+
+def pow(x: Node, a: float) -> Node:  # noqa: A001
+    return LambdaLayer(lambda v: v ** a)(x)
+
+
+def epsilon() -> float:
+    return 1e-7
+
+
+# ---- axis reductions (axis counts the batch dim, as in the reference) ----
+def _reduce_shape(axis, keepdims):
+    def infer(in_shapes):
+        s = in_shapes[0]
+        if s is None:
+            return None
+        full = (None,) + tuple(s)  # batch-dim placeholder
+        ax = axis % len(full) if axis is not None else None
+        if ax is None:
+            return ()
+        out = [d for i, d in enumerate(full) if i != ax or keepdims]
+        if keepdims:
+            out[ax] = 1
+        return tuple(out[1:])
+    return infer
+
+
+def mean(x: Node, axis: int = None, keepDims: bool = False) -> Node:
+    return LambdaLayer(
+        lambda a: _import_jnp().mean(a, axis=axis, keepdims=keepDims),
+        out_shape=_reduce_shape(axis, keepDims))(x)
+
+
+def sum(x: Node, axis: int = None, keepDims: bool = False) -> Node:  # noqa: A001
+    return LambdaLayer(
+        lambda a: _import_jnp().sum(a, axis=axis, keepdims=keepDims),
+        out_shape=_reduce_shape(axis, keepDims))(x)
+
+
+def max(x: Node, axis: int = None, keepDims: bool = False) -> Node:  # noqa: A001
+    return LambdaLayer(
+        lambda a: _import_jnp().max(a, axis=axis, keepdims=keepDims),
+        out_shape=_reduce_shape(axis, keepDims))(x)
+
+
+def min(x: Node, axis: int = None, keepDims: bool = False) -> Node:  # noqa: A001
+    return LambdaLayer(
+        lambda a: _import_jnp().min(a, axis=axis, keepdims=keepDims),
+        out_shape=_reduce_shape(axis, keepDims))(x)
+
+
+# ---- binary ----
+def maximum(x: Node, y: Union[Node, float]) -> Node:
+    if isinstance(y, Node):
+        return LambdaLayer(lambda a, b: _import_jnp().maximum(a, b))([x, y])
+    return LambdaLayer(lambda a: _import_jnp().maximum(a, y))(x)
+
+
+def minimum(x: Node, y: Union[Node, float]) -> Node:
+    if isinstance(y, Node):
+        return LambdaLayer(lambda a, b: _import_jnp().minimum(a, b))([x, y])
+    return LambdaLayer(lambda a: _import_jnp().minimum(a, y))(x)
+
+
+def batch_dot(x: Node, y: Node, axes: Tuple[int, int] = (2, 1)) -> Node:
+    """Per-sample matmul (ref autograd.batch_dot; axes as in keras-1)."""
+    def f(a, b):
+        jnp = _import_jnp()
+        # keras batch_dot with default axes == batched matmul
+        if axes == (2, 1):
+            return jnp.einsum("bij,bjk->bik", a, b)
+        if axes == (1, 1):
+            return jnp.einsum("bi,bi->b", a, b)[:, None]
+        if axes == (2, 2):
+            return jnp.einsum("bij,bkj->bik", a, b)
+        raise ValueError(f"unsupported batch_dot axes {axes}")
+    return LambdaLayer(f)([x, y])
+
+
+def dot(x: Node, y: Node) -> Node:
+    return LambdaLayer(lambda a, b: a @ b)([x, y])
+
+
+def l2_normalize(x: Node, axis: int = -1) -> Node:
+    def f(a):
+        jnp = _import_jnp()
+        return a / jnp.maximum(
+            jnp.linalg.norm(a, axis=axis, keepdims=True), 1e-12)
+    return LambdaLayer(f)(x)
+
+
+# ---- shape ops ----
+def expand_dims(x: Node, axis: int) -> Node:
+    return LambdaLayer(
+        lambda a: _import_jnp().expand_dims(a, axis))(x)
+
+
+def squeeze(x: Node, axis: int) -> Node:
+    return LambdaLayer(lambda a: _import_jnp().squeeze(a, axis))(x)
+
+
+def stack(nodes: List[Node], axis: int = 1) -> Node:
+    return LambdaLayer(
+        lambda *xs: _import_jnp().stack(xs, axis=axis))(list(nodes))
+
+
+def concatenate(nodes: List[Node], axis: int = -1) -> Node:
+    return LambdaLayer(
+        lambda *xs: _import_jnp().concatenate(xs, axis=axis))(list(nodes))
+
+
+def contiguous(x: Node) -> Node:
+    return x
+
+
+# ------------------------------------------------------------- evaluation
+def to_function(inputs: List[Node], output: Node) -> Callable:
+    """Compile a param-free autograd graph into a plain jax function
+    ``fn(*arrays)``. Raises if the graph contains parameterized layers
+    (those need the full Keras compile path)."""
+    order = topo_sort([output])
+    for node in order:
+        if node.layer is not None and node.layer.make_module() is not None:
+            raise ValueError(
+                f"graph contains parameterized layer {node.layer.name!r}; "
+                "use the Keras Model API instead of to_function")
+    input_ids = [n.id for n in inputs]
+
+    def fn(*xs):
+        env = dict(zip(input_ids, xs))
+        for node in order:
+            if node.id in env:
+                continue
+            if node.layer is None:
+                raise ValueError(
+                    "graph references an Input that was not passed in")
+            env[node.id] = node.layer.apply(
+                None, [env[i.id] for i in node.inputs], False)
+        return env[output.id]
+
+    return fn
+
+
+class CustomLoss:
+    """A loss defined as an autograd expression over (y_true, y_pred)
+    (ref autograd.CustomLoss / CustomLossWithVariable). Usable anywhere a
+    loss is accepted: ``model.compile(loss=CustomLoss(fn, y_shape))``."""
+
+    def __init__(self, loss_func: Callable[[Node, Node], Node],
+                 y_shape: Sequence[int]):
+        y_true = Variable(input_shape=tuple(y_shape), name="y_true")
+        y_pred = Variable(input_shape=tuple(y_shape), name="y_pred")
+        out = loss_func(y_true, y_pred)
+        self._fn = to_function([y_true, y_pred], out)
+
+    def __call__(self, y_true, y_pred):
+        return self._fn(y_true, y_pred)
+
+    # reference spelling: loss.forward(y_true, y_pred) for spot-checking
+    def forward(self, y_true, y_pred):
+        import jax
+        return np.asarray(jax.device_get(
+            self._fn(np.asarray(y_true), np.asarray(y_pred))))

@@ -30,7 +30,10 @@ import numpy as np
 
 
 def _np(t):
-    return np.asarray(t.detach().cpu().numpy())
+    # copy: .numpy() returns a VIEW of the torch storage — without it the
+    # translated params would alias live torch tensors (mutated by torch
+    # optimizers / BN updates) and keep them alive
+    return np.array(t.detach().cpu().numpy(), copy=True)
 
 
 def _conv_general(x, w, b, stride, padding, dims):

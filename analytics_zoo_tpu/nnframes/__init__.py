@@ -1,0 +1,6 @@
+from analytics_zoo_tpu.nnframes.nn_classifier import (
+    NNClassifier, NNClassifierModel, NNEstimator, NNImageReader, NNModel,
+)
+
+__all__ = ["NNEstimator", "NNModel", "NNClassifier", "NNClassifierModel",
+           "NNImageReader"]

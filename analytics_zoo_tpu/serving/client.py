@@ -55,13 +55,18 @@ class OutputQueue:
         self.cipher = cipher
 
     def query(self, uri: str, timeout: float = 0.0,
-              poll_interval: float = 0.01) -> Optional[np.ndarray]:
+              poll_interval: float = 0.01,
+              delete: bool = False) -> Optional[np.ndarray]:
         """Result for ``uri`` or None. ``timeout > 0`` polls until then
-        (the reference client polls the Redis hash the same way)."""
+        (the reference client polls the Redis hash the same way).
+        ``delete=True`` removes the entry once fetched — one-shot consumers
+        (the HTTP frontend) use it so the result hash stays bounded."""
         deadline = time.time() + timeout
         while True:
             val = self._client.hget(self.result_key, uri)
             if val is not None:
+                if delete:
+                    self._client.hdel(self.result_key, uri)
                 return schema.decode_result(val, self.cipher)
             if time.time() >= deadline:
                 return None

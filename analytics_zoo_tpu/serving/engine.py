@@ -105,15 +105,24 @@ class ClusterServing:
                 logger.warning("dropping undecodable record %s: %s", eid, e)
                 client.xack(self.stream, self.group, eid)
         if rows:
-            shapes = {k: np.shape(v) for k, v in rows[0].items()}
+            # batch by the MAJORITY shape signature — a single malformed
+            # leading record must not reject the whole batch
+            sig = lambda r: tuple(sorted(  # noqa: E731
+                (k, np.shape(v)) for k, v in r.items()))
+            counts: Dict = {}
+            for r in rows:
+                counts[sig(r)] = counts.get(sig(r), 0) + 1
+            best = max(counts, key=lambda s: counts[s])
             kept_uris, kept = [], []
             for uri, r in zip(uris, rows):
-                if {k: np.shape(v) for k, v in r.items()} == shapes:
+                if sig(r) == best:
                     kept_uris.append(uri)
                     kept.append(r)
                 else:
                     client.hset(self.result_key, uri, schema.encode_error(
-                        f"tensor shapes {shapes} expected", self.cipher))
+                        f"tensor shapes {dict(best)} expected, got "
+                        f"{ {k: np.shape(v) for k, v in r.items()} }",
+                        self.cipher))
             uris, rows = kept_uris, kept
         if not rows:
             for eid, _ in entries:
@@ -130,7 +139,20 @@ class ClusterServing:
 
         t0 = time.time()
         x = batch[0] if len(batch) == 1 else tuple(batch)
-        preds = np.asarray(self.model.predict(x))[:n]
+        try:
+            preds = np.asarray(self.model.predict(x))[:n]
+        except Exception as e:
+            # model incompatibility: every record gets an error result and
+            # the entries are acked — losing them silently would hang the
+            # clients AND pin the broker's GC low-water mark forever
+            logger.exception("inference failed for batch of %d", n)
+            for uri in uris:
+                client.hset(self.result_key, uri, schema.encode_error(
+                    f"inference failed: {e}", self.cipher))
+            for eid, _ in entries:
+                client.xack(self.stream, self.group, eid)
+            self.timer.record("inference_error", time.time() - t0)
+            return 0
         self.timer.record("inference", time.time() - t0)
 
         t0 = time.time()

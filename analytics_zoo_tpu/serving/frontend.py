@@ -65,7 +65,7 @@ class _Handler(BaseHTTPRequestHandler):
                 in_q.close()
         try:
             out_q = OutputQueue(port=srv.broker_port, cipher=srv.cipher)
-            result = out_q.query(uri, timeout=srv.timeout_s)
+            result = out_q.query(uri, timeout=srv.timeout_s, delete=True)
         except schema.ServingError as e:
             self._json(422, {"uri": uri, "error": str(e)})
             return

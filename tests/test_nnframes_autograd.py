@@ -1,0 +1,204 @@
+"""Tests for NNFrames (ref pyzoo/test/zoo/pipeline/nnframes/) and autograd
+(ref pyzoo/test/zoo/pipeline/autograd/test_autograd.py)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.keras import autograd as A
+from analytics_zoo_tpu.keras.autograd import CustomLoss, Lambda
+from analytics_zoo_tpu.nnframes import (
+    NNClassifier, NNEstimator, NNImageReader, NNModel,
+)
+
+
+class TestAutogradMath:
+    def _eval(self, build, *arrays):
+        """build(*vars) -> output node; evaluated on arrays."""
+        vs = [A.Variable(input_shape=a.shape[1:]) for a in arrays]
+        out = build(*vs)
+        fn = A.to_function(vs, out)
+        import jax
+        return np.asarray(jax.device_get(fn(*arrays)))
+
+    def test_elementwise_ops_match_numpy(self):
+        x = np.random.RandomState(0).rand(4, 3).astype(np.float32) + 0.5
+        np.testing.assert_allclose(
+            self._eval(lambda v: A.abs(v * -2.0), x), np.abs(x * -2),
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            self._eval(A.exp, x), np.exp(x), rtol=1e-5)
+        np.testing.assert_allclose(
+            self._eval(A.log, x), np.log(x), rtol=1e-5)
+        np.testing.assert_allclose(
+            self._eval(A.sqrt, x), np.sqrt(x), rtol=1e-6)
+        np.testing.assert_allclose(
+            self._eval(lambda v: A.clip(v, 0.6, 1.0), x),
+            np.clip(x, 0.6, 1.0), rtol=1e-6)
+        np.testing.assert_allclose(
+            self._eval(lambda v: A.pow(v, 3.0), x), x ** 3, rtol=1e-5)
+        np.testing.assert_allclose(
+            self._eval(A.softsign, x), x / (1 + np.abs(x)), rtol=1e-6)
+
+    def test_operator_sugar(self):
+        x = np.random.RandomState(1).randn(4, 3).astype(np.float32)
+        y = np.random.RandomState(2).randn(4, 3).astype(np.float32)
+        got = self._eval(lambda a, b: (a - b) * 2.0 + 1.0, x, y)
+        np.testing.assert_allclose(got, (x - y) * 2 + 1, rtol=1e-6)
+        got = self._eval(lambda a, b: a / (b * b + 4.0), x, y)
+        np.testing.assert_allclose(got, x / (y * y + 4), rtol=1e-5)
+
+    def test_reductions_axis_counts_batch(self):
+        x = np.random.RandomState(3).randn(4, 3, 2).astype(np.float32)
+        np.testing.assert_allclose(
+            self._eval(lambda v: A.mean(v, axis=1), x), x.mean(1), rtol=1e-6)
+        np.testing.assert_allclose(
+            self._eval(lambda v: A.sum(v, axis=2), x), x.sum(2), rtol=1e-5)
+        np.testing.assert_allclose(
+            self._eval(lambda v: A.max(v, axis=1), x), x.max(1), rtol=1e-6)
+
+    def test_batch_dot_and_l2_normalize(self):
+        a = np.random.RandomState(4).randn(3, 2, 4).astype(np.float32)
+        b = np.random.RandomState(5).randn(3, 4, 5).astype(np.float32)
+        got = self._eval(lambda u, v: A.batch_dot(u, v), a, b)
+        np.testing.assert_allclose(got, np.einsum("bij,bjk->bik", a, b),
+                                   rtol=1e-5)
+        x = np.random.RandomState(6).randn(4, 3).astype(np.float32)
+        got = self._eval(lambda v: A.l2_normalize(v, axis=1), x)
+        np.testing.assert_allclose(
+            got, x / np.linalg.norm(x, axis=1, keepdims=True), rtol=1e-5)
+
+    def test_shape_ops(self):
+        x = np.random.RandomState(7).randn(4, 3).astype(np.float32)
+        got = self._eval(lambda v: A.expand_dims(v, 1), x)
+        assert got.shape == (4, 1, 3)
+        got = self._eval(lambda v: A.squeeze(A.expand_dims(v, 2), 2), x)
+        np.testing.assert_allclose(got, x)
+
+    def test_to_function_rejects_parameterized(self):
+        from analytics_zoo_tpu.keras.layers import Dense
+        v = A.Variable(input_shape=(3,))
+        out = Dense(2)(v)
+        with pytest.raises(ValueError, match="parameterized"):
+            A.to_function([v], out)
+
+    def test_custom_loss_in_training(self, orca_ctx):
+        from analytics_zoo_tpu.keras.models import Sequential
+        from analytics_zoo_tpu.keras.layers import Dense
+
+        loss = CustomLoss(
+            lambda yt, yp: A.mean(A.square(yt - yp)), y_shape=(1,))
+        # spot check (ref CustomLoss.forward)
+        val = loss.forward(np.zeros((2, 1)), np.ones((2, 1)))
+        np.testing.assert_allclose(val, 1.0, rtol=1e-6)
+
+        m = Sequential()
+        m.add(Dense(8, input_shape=(4,), activation="relu"))
+        m.add(Dense(1))
+        m.compile(optimizer="adam", loss=loss)
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 4).astype(np.float32)
+        y = x.sum(1, keepdims=True).astype(np.float32)
+        h = m.fit(x, y, batch_size=16, nb_epoch=5)
+        assert h["loss"][-1] < h["loss"][0]
+
+    def test_lambda_layer_in_model(self, orca_ctx):
+        from analytics_zoo_tpu.keras.models import Model
+        from analytics_zoo_tpu.keras.layers import Dense
+        from analytics_zoo_tpu.keras.engine import Input
+
+        inp = Input(shape=(4,))
+        h = Dense(6)(inp)
+        out = Lambda(lambda a: a * 2.0 + 1.0)(h)
+        m = Model(inp, out)
+        x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        base = np.asarray(Model(inp, h).predict(x, distributed=False))
+        got = np.asarray(m.predict(x, distributed=False))
+        np.testing.assert_allclose(got, base * 2 + 1, rtol=1e-5)
+
+
+def _toy_df(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int64)
+    return pd.DataFrame({
+        "features": [row for row in x],
+        "label": y,
+        "f0": x[:, 0], "f1": x[:, 1], "f2": x[:, 2], "f3": x[:, 3],
+    })
+
+
+def _mlp(num_out=2, activation="softmax"):
+    from analytics_zoo_tpu.keras.models import Sequential
+    from analytics_zoo_tpu.keras.layers import Dense
+    m = Sequential()
+    m.add(Dense(8, input_shape=(4,), activation="relu"))
+    m.add(Dense(num_out, activation=activation))
+    return m
+
+
+class TestNNFrames:
+    def test_nnestimator_fit_transform(self, orca_ctx):
+        df = _toy_df()
+        est = (NNEstimator(_mlp(), "sparse_categorical_crossentropy")
+               .setBatchSize(16).setMaxEpoch(3)
+               .set_features_col("features").set_label_col("label"))
+        model = est.fit(df)
+        assert isinstance(model, NNModel)
+        out = model.transform(df)
+        assert "prediction" in out.columns
+        probs = np.stack(out["prediction"].tolist())
+        assert probs.shape == (64, 2)
+        np.testing.assert_allclose(probs.sum(1), 1.0, atol=1e-4)
+
+    def test_scalar_feature_cols(self, orca_ctx):
+        df = _toy_df()
+        est = (NNEstimator(_mlp(), "sparse_categorical_crossentropy")
+               .set_features_col(["f0", "f1", "f2", "f3"])
+               .set_label_col("label").setMaxEpoch(2).setBatchSize(16))
+        model = est.fit(df)
+        out = model.transform(df)
+        assert len(out["prediction"]) == 64
+
+    def test_nnclassifier_argmax(self, orca_ctx):
+        df = _toy_df()
+        clf = (NNClassifier(_mlp(), "sparse_categorical_crossentropy")
+               .setBatchSize(16).setMaxEpoch(30)
+               .set_features_col("features").set_label_col("label"))
+        model = clf.fit(df)
+        out = model.transform(df)
+        preds = out["prediction"].to_numpy()
+        assert set(np.unique(preds)) <= {0.0, 1.0}
+        acc = (preds == df["label"].to_numpy()).mean()
+        assert acc > 0.7, f"classifier barely better than chance: {acc}"
+
+    def test_model_save_load(self, orca_ctx, tmp_path):
+        df = _toy_df()
+        est = (NNEstimator(_mlp(), "sparse_categorical_crossentropy")
+               .setBatchSize(16).setMaxEpoch(1)
+               .set_features_col("features").set_label_col("label"))
+        model = est.fit(df)
+        p1 = np.stack(model.transform(df)["prediction"].tolist())
+        path = str(tmp_path / "nnmodel")
+        model.save(path)
+        est2 = (NNEstimator(_mlp(), "sparse_categorical_crossentropy")
+                .setBatchSize(8)
+                .set_features_col("features").set_label_col("label"))
+        model2 = est2.fit(df.head(8))  # build params, then overwrite
+        model2.load(path)
+        p2 = np.stack(model2.transform(df)["prediction"].tolist())
+        np.testing.assert_allclose(p2, p1, atol=1e-5)
+
+    def test_image_reader(self, tmp_path, orca_ctx):
+        from PIL import Image
+        d = tmp_path / "imgs"
+        d.mkdir()
+        rng = np.random.RandomState(0)
+        for i in range(3):
+            Image.fromarray(
+                rng.randint(0, 255, (10, 12, 3), dtype=np.uint8)).save(
+                d / f"im{i}.png")
+        df = NNImageReader.read_images(str(d), resize_h=8, resize_w=8)
+        assert len(df) == 3
+        assert df["image"][0].shape == (8, 8, 3)
+        assert all(df["origin"].str.endswith(".png"))

@@ -182,12 +182,11 @@ class TestResilience:
             out_q = OutputQueue(port=broker.port)
             in_q.enqueue("okshape", x=np.zeros(4, np.float32))
             assert out_q.query("okshape", timeout=20.0) is not None
-            # inference-breaking shape (wrong inner dim): the serve step
-            # fails but the loop survives
+            # inference-breaking shape (wrong inner dim): the record gets an
+            # error result (not silence) and the loop survives
             in_q.enqueue("badshape", x=np.zeros(5, np.float32))
-            # wait until the bad record was consumed (it never resolves)
-            # before sending more, so they don't share its batch
-            assert out_q.query("badshape", timeout=2.0) is None
+            with pytest.raises(schema.ServingError, match="inference failed"):
+                out_q.query("badshape", timeout=20.0)
             # engine still alive for subsequent good records
             in_q.enqueue("after", x=np.ones(4, np.float32))
             assert out_q.query("after", timeout=20.0) is not None
