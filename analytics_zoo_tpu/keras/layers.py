@@ -682,3 +682,76 @@ class TimeDistributed(KerasLayer):
 class GetShape(KerasLayer):
     def apply(self, module, args, train):
         return jnp.asarray(args[0].shape)
+
+
+# ---------------- transformer / BERT ----------------
+
+class TransformerLayer(KerasLayer):
+    """GPT-style causal transformer over token ids
+    (ref zoo/.../keras/layers/TransformerLayer.scala:56). Input: [b, L]
+    token ids; output: [b, L, hidden_size]."""
+
+    def __init__(self, vocab: int, hidden_size: int = 768, n_block: int = 12,
+                 n_head: int = 12, seq_len: int = 512,
+                 hidden_drop: float = 0.1, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.vocab, self.hidden_size = vocab, hidden_size
+        self.n_block, self.n_head = n_block, n_head
+        self.seq_len, self.hidden_drop = seq_len, hidden_drop
+
+    def _infer_shape(self, in_shapes):
+        s = in_shapes[0]
+        return (None if s is None else s[0], self.hidden_size) \
+            if s and len(s) == 1 else (s + (self.hidden_size,) if s else None)
+
+    def make_module(self):
+        from analytics_zoo_tpu.text.bert import TransformerModule
+        return TransformerModule(
+            vocab=self.vocab, hidden_size=self.hidden_size,
+            n_block=self.n_block, n_head=self.n_head,
+            hidden_drop=self.hidden_drop, max_position_len=self.seq_len,
+            name=self.name)
+
+    def apply(self, module, args, train):
+        return module(args[0], train=train)
+
+
+class BERT(KerasLayer):
+    """BERT encoder layer (ref zoo/.../keras/layers/BERT.scala:66).
+
+    Call on ``[ids]`` or ``[ids, token_types, mask]`` nodes. ``output``:
+    ``"pooled"`` (default, [b, hidden]) or ``"sequence"`` ([b, L, hidden]).
+    """
+
+    def __init__(self, vocab: int = 30522, hidden_size: int = 768,
+                 n_block: int = 12, n_head: int = 12,
+                 intermediate_size: int = 3072, max_position_len: int = 512,
+                 hidden_drop: float = 0.1, attn_drop: float = 0.1,
+                 output: str = "pooled", input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        from analytics_zoo_tpu.text.bert import BertConfig
+        if output not in ("pooled", "sequence"):
+            raise ValueError("output must be 'pooled' or 'sequence'")
+        self.config = BertConfig(
+            vocab=vocab, hidden_size=hidden_size, n_block=n_block,
+            n_head=n_head, intermediate_size=intermediate_size,
+            max_position_len=max_position_len, hidden_drop=hidden_drop,
+            attn_drop=attn_drop)
+        self.output = output
+
+    def _infer_shape(self, in_shapes):
+        s = in_shapes[0]
+        if self.output == "pooled":
+            return (self.config.hidden_size,)
+        return (None if s is None else s[0], self.config.hidden_size)
+
+    def make_module(self):
+        from analytics_zoo_tpu.text.bert import BertModule
+        return BertModule(self.config, name=self.name)
+
+    def apply(self, module, args, train):
+        ids = args[0]
+        seg = args[1] if len(args) > 1 else None
+        mask = args[2] if len(args) > 2 else None
+        seq, pooled = module(ids, seg, mask, train=train)
+        return pooled if self.output == "pooled" else seq

@@ -1,0 +1,9 @@
+from analytics_zoo_tpu.text.bert import (
+    BertConfig, BertModule, TransformerModule,
+)
+from analytics_zoo_tpu.text.estimators import (
+    BERTClassifier, BERTNER, BERTSQuAD,
+)
+
+__all__ = ["BertConfig", "BertModule", "TransformerModule",
+           "BERTClassifier", "BERTNER", "BERTSQuAD"]

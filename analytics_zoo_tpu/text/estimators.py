@@ -1,0 +1,205 @@
+"""BERT task estimators — classification, NER, SQuAD.
+
+Parity with the reference's TFPark text estimators
+(pyzoo/zoo/tfpark/text/estimator/: ``BERTClassifier``, ``BERTNER``,
+``BERTSQuAD`` built on ``BERTBaseEstimator`` + TF-Estimator model_fns).
+There each wraps a TF1 graph in the TFEstimator clone; here each is a flax
+head module over ``BertModule`` driven by the standard JaxEstimator, so
+fit/evaluate/predict run the same sharded train step as everything else
+(tensor-parallel via ``bert_tp_rules`` when a ``tp`` strategy is set).
+
+Inputs follow the reference's feature dict: ``(input_ids, token_type_ids,
+input_mask)`` arrays of shape [b, L].
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.learn.estimator import Estimator, JaxEstimator
+from analytics_zoo_tpu.learn.losses import jax_logsumexp
+from analytics_zoo_tpu.text.bert import BertConfig, BertModule, bert_tp_rules
+
+
+class _ClassifierModule(nn.Module):
+    config: BertConfig
+    n_classes: int
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids, input_mask,
+                 train: bool = False):
+        _, pooled = BertModule(self.config, name="bert")(
+            input_ids, token_type_ids, input_mask, train=train)
+        if self.config.hidden_drop > 0:
+            pooled = nn.Dropout(self.config.hidden_drop,
+                                deterministic=not train)(pooled)
+        return nn.Dense(self.n_classes, name="classifier")(pooled)
+
+
+class _NERModule(nn.Module):
+    config: BertConfig
+    n_entities: int
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids, input_mask,
+                 train: bool = False):
+        seq, _ = BertModule(self.config, name="bert")(
+            input_ids, token_type_ids, input_mask, train=train)
+        if self.config.hidden_drop > 0:
+            seq = nn.Dropout(self.config.hidden_drop,
+                             deterministic=not train)(seq)
+        return nn.Dense(self.n_entities, name="ner")(seq)
+
+
+class _SQuADModule(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids, input_mask,
+                 train: bool = False):
+        seq, _ = BertModule(self.config, name="bert")(
+            input_ids, token_type_ids, input_mask, train=train)
+        logits = nn.Dense(2, name="qa")(seq)           # [b, L, 2]
+        return logits[..., 0], logits[..., 1]          # start, end
+
+
+def _ner_loss(y_true, logits):
+    """Per-token CE with padding positions excluded: labels < 0 are
+    ignored (BERTNER.fit writes -1 at masked positions). Without this,
+    short sequences would take most of their gradient from padding
+    (ref BERTNER model_fn masks the loss the same way)."""
+    y = jnp.asarray(y_true).astype(jnp.int32)
+    logp = logits - jax_logsumexp(logits)
+    ce = -jnp.take_along_axis(
+        logp, jnp.maximum(y, 0)[..., None], axis=-1)[..., 0]
+    valid = (y >= 0).astype(ce.dtype)
+    return (ce * valid).sum(axis=-1) / jnp.maximum(valid.sum(axis=-1), 1.0)
+
+
+def _squad_loss(y_true, preds):
+    """y_true: [b, 2] (start_pos, end_pos); preds: (start_logits,
+    end_logits) each [b, L] (ref BERTSQuAD model_fn loss)."""
+    start_logits, end_logits = preds
+    y = jnp.asarray(y_true).astype(jnp.int32)
+
+    def ce(logits, idx):
+        logp = logits - jax_logsumexp(logits)
+        return -jnp.take_along_axis(logp, idx[:, None], axis=-1)[:, 0]
+
+    return 0.5 * (ce(start_logits, y[:, 0]) + ce(end_logits, y[:, 1]))
+
+
+class _BertTaskEstimator:
+    """Shared surface (ref BERTBaseEstimator: fit/evaluate/predict over
+    bert feature dicts)."""
+
+    def __init__(self, module, loss, optimizer, metrics, config: BertConfig,
+                 seq_len: int, model_dir, strategy, seed):
+        from analytics_zoo_tpu.parallel.strategy import ShardingStrategy
+        sample = tuple(np.zeros((2, seq_len), np.int32) for _ in range(3))
+        rules = (bert_tp_rules()
+                 if "tp" in ShardingStrategy.parse(strategy).uses else None)
+        self.config = config
+        self.seq_len = seq_len
+        self.estimator: JaxEstimator = Estimator.from_flax(
+            model=module, loss=loss, optimizer=optimizer, metrics=metrics,
+            sample_input=sample, model_dir=model_dir, strategy=strategy,
+            param_rules=rules, seed=seed)
+
+    @staticmethod
+    def _xy(input_ids, token_type_ids=None, input_mask=None, labels=None):
+        ids = np.asarray(input_ids)
+        seg = (np.zeros_like(ids) if token_type_ids is None
+               else np.asarray(token_type_ids))
+        msk = (np.ones_like(ids) if input_mask is None
+               else np.asarray(input_mask))
+        x = (ids, seg, msk)
+        return x if labels is None else (x, np.asarray(labels))
+
+    def fit(self, input_ids, labels, token_type_ids=None, input_mask=None,
+            epochs: int = 1, batch_size: int = 32, **kw):
+        data = self._xy(input_ids, token_type_ids, input_mask, labels)
+        return self.estimator.fit(data, epochs=epochs,
+                                  batch_size=batch_size, **kw)
+
+    def evaluate(self, input_ids, labels, token_type_ids=None,
+                 input_mask=None, batch_size: int = 32):
+        data = self._xy(input_ids, token_type_ids, input_mask, labels)
+        return self.estimator.evaluate(data, batch_size=batch_size)
+
+    def predict(self, input_ids, token_type_ids=None, input_mask=None,
+                batch_size: int = 32):
+        x = self._xy(input_ids, token_type_ids, input_mask)
+        # JaxEstimator.predict treats a tuple as multi-input features
+        return self.estimator.predict(x, batch_size=batch_size)
+
+    def save(self, path: str):
+        return self.estimator.save(path)
+
+    def load(self, path: str):
+        self.estimator.load(path)
+        return self
+
+
+class BERTClassifier(_BertTaskEstimator):
+    """Sequence classification on the pooled output
+    (ref tfpark/text/estimator BERTClassifier)."""
+
+    def __init__(self, num_classes: int, config: Optional[BertConfig] = None,
+                 seq_len: int = 128, optimizer="adam", metrics=None,
+                 model_dir=None, strategy="dp", seed: int = 0):
+        config = config or BertConfig()
+        super().__init__(
+            _ClassifierModule(config, num_classes),
+            "sparse_categorical_crossentropy_logits", optimizer,
+            metrics, config, seq_len, model_dir, strategy, seed)
+
+
+class BERTNER(_BertTaskEstimator):
+    """Token-level entity tagging on the sequence output
+    (ref tfpark/text/estimator BERTNER). Padded positions (input_mask 0)
+    are excluded from the loss via -1 labels."""
+
+    def __init__(self, num_entities: int, config: Optional[BertConfig] = None,
+                 seq_len: int = 128, optimizer="adam", metrics=None,
+                 model_dir=None, strategy="dp", seed: int = 0):
+        config = config or BertConfig()
+        super().__init__(
+            _NERModule(config, num_entities), _ner_loss, optimizer,
+            metrics, config, seq_len, model_dir, strategy, seed)
+
+    @staticmethod
+    def _masked(labels, input_mask):
+        if input_mask is None:
+            return labels
+        return np.where(np.asarray(input_mask) > 0,
+                        np.asarray(labels), -1)
+
+    def fit(self, input_ids, labels, token_type_ids=None, input_mask=None,
+            epochs: int = 1, batch_size: int = 32, **kw):
+        return super().fit(input_ids, self._masked(labels, input_mask),
+                           token_type_ids, input_mask,
+                           epochs=epochs, batch_size=batch_size, **kw)
+
+    def evaluate(self, input_ids, labels, token_type_ids=None,
+                 input_mask=None, batch_size: int = 32):
+        return super().evaluate(input_ids, self._masked(labels, input_mask),
+                                token_type_ids, input_mask,
+                                batch_size=batch_size)
+
+
+class BERTSQuAD(_BertTaskEstimator):
+    """Extractive QA start/end prediction
+    (ref tfpark/text/estimator BERTSQuAD)."""
+
+    def __init__(self, config: Optional[BertConfig] = None,
+                 seq_len: int = 128, optimizer="adam", metrics=None,
+                 model_dir=None, strategy="dp", seed: int = 0):
+        config = config or BertConfig()
+        super().__init__(
+            _SQuADModule(config), _squad_loss, optimizer,
+            metrics, config, seq_len, model_dir, strategy, seed)
