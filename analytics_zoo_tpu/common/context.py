@@ -80,8 +80,8 @@ class OrcaContextMeta(type):
     @train_data_store.setter
     def train_data_store(cls, value):
         value = value.upper()
-        assert value == "DRAM" or value.startswith("DISK_"), \
-            "train_data_store must be 'DRAM' or 'DISK_n'"
+        assert value == "DRAM" or value.startswith(("DISK_", "NATIVE_")), \
+            "train_data_store must be 'DRAM', 'DISK_n' or 'NATIVE_n'"
         cls._train_data_store = value
 
     @property

@@ -1,0 +1,174 @@
+"""ctypes binding for the native tiered blob store (data/native/zstore.cpp).
+
+Replaces the reference's JNI PMEM allocator + tiered FeatureSet natives
+(PersistentMemoryAllocator.java:19-44, NativeArray.scala:23-27,
+FeatureSet.scala DRAM/PMEM/DISK_n) — see zstore.cpp header. Python keeps
+only handles; bytes live in the native arena or its spill files.
+
+``NativeShardStore`` adapts the blob store to the shard-storage interface
+used by ``HostXShards`` (pickled shards as blobs, LRU DRAM window, spill
+to disk, prefetch-ahead on sequential access). Selected via the
+``NATIVE_n`` tier (keep ~1/n of bytes resident — the DISK_n contract,
+FeatureSet.scala:556 — but enforced by bytes, not shard count).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import subprocess
+import tempfile
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "native", "zstore.cpp")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "native", "build")
+_lib = None
+_lib_failed = False
+
+
+def load_native_lib():
+    """Compile (once) and dlopen libzstore. Returns None when no
+    toolchain — callers fall back to the pure-python tiers."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    so = os.path.join(_BUILD_DIR, "libzstore.so")
+    try:
+        if not os.path.exists(so) or \
+                os.path.getmtime(so) < os.path.getmtime(_SRC):
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                 "-o", so, _SRC],
+                check=True, capture_output=True, text=True, timeout=180)
+        lib = ctypes.CDLL(so)
+    except (OSError, subprocess.SubprocessError) as e:
+        import logging
+        logging.getLogger(__name__).warning(
+            "native store unavailable (%s); using python tiers",
+            getattr(e, "stderr", "") or e)
+        _lib_failed = True
+        return None
+    lib.zstore_create.restype = ctypes.c_void_p
+    lib.zstore_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.zstore_put.restype = ctypes.c_int64
+    lib.zstore_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_uint64]
+    lib.zstore_size.restype = ctypes.c_int64
+    lib.zstore_size.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.zstore_get.restype = ctypes.c_int64
+    lib.zstore_get.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                               ctypes.c_void_p, ctypes.c_uint64]
+    lib.zstore_prefetch.restype = None
+    lib.zstore_prefetch.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_int64),
+                                    ctypes.c_uint64]
+    for fn in ("zstore_resident_bytes", "zstore_count", "zstore_hits",
+               "zstore_misses"):
+        getattr(lib, fn).restype = ctypes.c_uint64
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    lib.zstore_destroy.restype = None
+    lib.zstore_destroy.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+class NativeBlobStore:
+    """Raw byte-blob store over the native arena."""
+
+    def __init__(self, capacity_bytes: int, directory: Optional[str] = None):
+        lib = load_native_lib()
+        if lib is None:
+            raise RuntimeError("native store library unavailable")
+        self._lib = lib
+        self._dir = directory or tempfile.mkdtemp(prefix="zstore_")
+        self._h = lib.zstore_create(self._dir.encode(),
+                                    int(capacity_bytes))
+        if not self._h:
+            raise RuntimeError("zstore_create failed")
+
+    def put(self, data: bytes) -> int:
+        blob_id = self._lib.zstore_put(self._h, data, len(data))
+        if blob_id < 0:
+            raise IOError("zstore_put failed (disk spill error?)")
+        return blob_id
+
+    def get(self, blob_id: int) -> bytes:
+        size = self._lib.zstore_size(self._h, blob_id)
+        if size < 0:
+            raise KeyError(f"unknown blob {blob_id}")
+        buf = ctypes.create_string_buffer(size)
+        got = self._lib.zstore_get(self._h, blob_id, buf, size)
+        if got != size:
+            raise IOError(f"zstore_get failed for blob {blob_id}")
+        return buf.raw
+
+    def prefetch(self, ids: Sequence[int]):
+        n = len(ids)
+        if n == 0:
+            return
+        arr = (ctypes.c_int64 * n)(*ids)
+        self._lib.zstore_prefetch(self._h, arr, n)
+
+    @property
+    def resident_bytes(self) -> int:
+        return self._lib.zstore_resident_bytes(self._h)
+
+    @property
+    def count(self) -> int:
+        return self._lib.zstore_count(self._h)
+
+    @property
+    def stats(self) -> dict:
+        return {"hits": self._lib.zstore_hits(self._h),
+                "misses": self._lib.zstore_misses(self._h),
+                "resident_bytes": self.resident_bytes,
+                "count": self.count}
+
+    def close(self):
+        if self._h:
+            self._lib.zstore_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # best-effort
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeShardStore:
+    """Shard-storage backend (same interface as data/shard.py _ShardStore):
+    pickled shards in the native arena, ~1/n of total bytes resident,
+    next-shard prefetch on sequential gets."""
+
+    def __init__(self, shards: List[Any], keep_fraction_denom: int = 2,
+                 prefetch_ahead: int = 2):
+        blobs = [pickle.dumps(s, protocol=pickle.HIGHEST_PROTOCOL)
+                 for s in shards]
+        total = sum(len(b) for b in blobs)
+        capacity = max(total // max(1, keep_fraction_denom), 1 << 20)
+        self._store = NativeBlobStore(capacity)
+        self._ids = [self._store.put(b) for b in blobs]
+        self._ahead = prefetch_ahead
+        self.tier = f"NATIVE_{keep_fraction_denom}"
+
+    def __len__(self):
+        return len(self._ids)
+
+    def get(self, i: int):
+        nxt = [self._ids[j] for j in range(i + 1, min(i + 1 + self._ahead,
+                                                      len(self._ids)))]
+        if nxt:
+            self._store.prefetch(nxt)
+        return pickle.loads(self._store.get(self._ids[i]))
+
+    def all(self):
+        return [self.get(i) for i in range(len(self))]
+
+    @property
+    def stats(self) -> dict:
+        return self._store.stats

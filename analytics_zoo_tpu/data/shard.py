@@ -107,6 +107,22 @@ class XShards:
         return HostXShards(shards)
 
 
+def _make_store(shards: List[Any], tier: str):
+    """Pick the storage backend for a tier. ``NATIVE_n`` = the C++ arena
+    (LRU DRAM window over spill files + prefetch thread,
+    data/native/zstore.cpp); falls back to the python ``DISK_n`` spill when
+    no toolchain is available."""
+    if tier.startswith("NATIVE_"):
+        try:
+            from analytics_zoo_tpu.data.native_store import NativeShardStore
+            return NativeShardStore(
+                list(shards),
+                keep_fraction_denom=max(1, int(tier.split("_", 1)[1])))
+        except (RuntimeError, ValueError):
+            tier = "DISK_" + tier.split("_", 1)[1]
+    return _ShardStore(list(shards), tier)
+
+
 class _ShardStore:
     """Shard storage backend: DRAM list, or disk spill keeping 1/n resident."""
 
@@ -150,7 +166,8 @@ class HostXShards(XShards):
         if tier is None:
             from analytics_zoo_tpu.common.context import OrcaContext
             tier = OrcaContext.train_data_store
-        self._store = _ShardStore(list(shards), tier if not transient else "DRAM")
+        self._store = _make_store(list(shards),
+                                  tier if not transient else "DRAM")
         self.tier = self._store.tier
 
     # -- core --

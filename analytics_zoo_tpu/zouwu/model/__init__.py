@@ -1,3 +1,7 @@
-from analytics_zoo_tpu.zouwu.model.nets import (  # noqa: F401
-    VanillaLSTMNet, Seq2SeqNet, TemporalConvNet, MTNetModule,
+from analytics_zoo_tpu.zouwu.model.forecast import (  # noqa: F401
+    Forecaster, LSTMForecaster, MTNetForecaster, Seq2SeqForecaster,
+    TCNForecaster,
+)
+from analytics_zoo_tpu.zouwu.model.stats_forecast import (  # noqa: F401
+    ARIMAForecaster, ProphetForecaster,
 )

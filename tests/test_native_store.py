@@ -1,0 +1,130 @@
+"""Native tiered blob store tests (analog of ref feature/pmem tests +
+FeatureSet DISK_n specs)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.data.native_store import (
+    NativeBlobStore, NativeShardStore, load_native_lib,
+)
+
+pytestmark = pytest.mark.skipif(load_native_lib() is None,
+                                reason="no native toolchain")
+
+
+class TestBlobStore:
+    def test_put_get_roundtrip(self):
+        store = NativeBlobStore(capacity_bytes=1 << 20)
+        try:
+            blobs = [bytes([i]) * (100 + i) for i in range(10)]
+            ids = [store.put(b) for b in blobs]
+            for i, b in zip(ids, blobs):
+                assert store.get(i) == b
+            assert store.count == 10
+        finally:
+            store.close()
+
+    def test_eviction_under_capacity_pressure(self):
+        # capacity fits ~3 of the 10 blobs: older ones spill, reads fault
+        # them back in and still return the right bytes
+        store = NativeBlobStore(capacity_bytes=3 * 10_000)
+        try:
+            blobs = [np.random.RandomState(i).bytes(10_000)
+                     for i in range(10)]
+            ids = [store.put(b) for b in blobs]
+            assert store.resident_bytes <= 3 * 10_000
+            for i, b in zip(ids, blobs):
+                assert store.get(i) == b
+            stats = store.stats
+            assert stats["misses"] > 0, "expected disk faults under pressure"
+            assert stats["hits"] + stats["misses"] == 10
+        finally:
+            store.close()
+
+    def test_prefetch_stages_blobs(self):
+        import time
+        store = NativeBlobStore(capacity_bytes=2 * 10_000)
+        try:
+            blobs = [np.random.RandomState(i).bytes(10_000)
+                     for i in range(6)]
+            ids = [store.put(b) for b in blobs]
+            store.prefetch(ids[:2])
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if store.get(ids[0]) == blobs[0]:
+                    break
+                time.sleep(0.01)
+            assert store.get(ids[1]) == blobs[1]
+        finally:
+            store.close()
+
+    def test_empty_blob(self):
+        store = NativeBlobStore(capacity_bytes=1000)
+        try:
+            i = store.put(b"")
+            assert store.get(i) == b""
+        finally:
+            store.close()
+
+    def test_unknown_blob_raises(self):
+        store = NativeBlobStore(capacity_bytes=1000)
+        try:
+            with pytest.raises(KeyError):
+                store.get(12345)
+        finally:
+            store.close()
+
+
+class TestNativeShardStore:
+    def test_shard_roundtrip_with_spill(self):
+        rng = np.random.RandomState(0)
+        shards = [{"x": rng.randn(100, 8).astype(np.float32),
+                   "y": rng.randint(0, 2, 100)} for _ in range(6)]
+        store = NativeShardStore(shards, keep_fraction_denom=3)
+        assert len(store) == 6
+        for i in range(6):
+            got = store.get(i)
+            np.testing.assert_array_equal(got["x"], shards[i]["x"])
+            np.testing.assert_array_equal(got["y"], shards[i]["y"])
+
+    def test_xshards_native_tier(self):
+        from analytics_zoo_tpu.data.shard import HostXShards
+        rng = np.random.RandomState(1)
+        records = [{"x": rng.randn(50, 4)} for _ in range(8)]
+        xs = HostXShards(records, tier="NATIVE_4")
+        assert xs.tier.startswith("NATIVE")
+        out = xs.transform_shard(lambda s: {"x": s["x"] * 2}).collect()
+        for rec, o in zip(records, out):
+            np.testing.assert_allclose(o["x"], rec["x"] * 2)
+
+    def test_context_tier_setting(self):
+        from analytics_zoo_tpu.common.context import OrcaContext
+        old = OrcaContext.train_data_store
+        try:
+            OrcaContext.train_data_store = "NATIVE_2"
+            assert OrcaContext.train_data_store == "NATIVE_2"
+            with pytest.raises(AssertionError):
+                OrcaContext.train_data_store = "PMEM"
+        finally:
+            OrcaContext.train_data_store = old
+
+    def test_training_from_native_tier(self, orca_ctx):
+        """End-to-end: Estimator.fit over a NATIVE-tier dataset."""
+        from analytics_zoo_tpu.keras.models import Sequential
+        from analytics_zoo_tpu.keras.layers import Dense
+        from analytics_zoo_tpu.common.context import OrcaContext
+
+        old = OrcaContext.train_data_store
+        try:
+            OrcaContext.train_data_store = "NATIVE_2"
+            rng = np.random.RandomState(0)
+            x = rng.randn(128, 4).astype(np.float32)
+            y = (x.sum(1) > 0).astype(np.int32)
+            m = Sequential()
+            m.add(Dense(8, input_shape=(4,), activation="relu"))
+            m.add(Dense(2, activation="softmax"))
+            m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+            h = m.fit(x, y, batch_size=32, nb_epoch=2)
+            assert all(np.isfinite(v) for v in h["loss"])
+        finally:
+            OrcaContext.train_data_store = old

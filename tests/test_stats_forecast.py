@@ -1,0 +1,113 @@
+"""ARIMA / Prophet-style forecaster tests (ref zouwu test_arima /
+test_prophet shapes on synthetic series)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.zouwu.model import ARIMAForecaster, ProphetForecaster
+
+
+def _ar_series(n=400, phi=0.7, seed=0):
+    rng = np.random.RandomState(seed)
+    y = np.zeros(n)
+    for i in range(1, n):
+        y[i] = phi * y[i - 1] + rng.randn() * 0.3
+    return y
+
+
+class TestARIMA:
+    def test_ar1_coefficient_recovered(self):
+        f = ARIMAForecaster(p=1, d=0, q=0)
+        f.fit(_ar_series())
+        phi_hat = f._coef[1]
+        assert abs(phi_hat - 0.7) < 0.12, phi_hat
+
+    def test_forecast_decays_to_mean(self):
+        y = _ar_series()
+        f = ARIMAForecaster(p=1, d=0, q=0).fit(y)
+        pred = f.predict(horizon=50)
+        assert pred.shape == (50,)
+        assert abs(pred[-1]) < abs(pred[0]) + 0.1  # AR(1) reverts to mean
+
+    def test_trend_with_differencing(self):
+        t = np.arange(300, dtype=float)
+        y = 2.0 * t + _ar_series(300, phi=0.3, seed=1)
+        f = ARIMAForecaster(p=1, d=1, q=1).fit(y)
+        pred = f.predict(horizon=10)
+        # slope ~2/step must carry into the forecast
+        assert pred[-1] > y[-1] + 10, (y[-1], pred[-1])
+        assert abs((pred[-1] - pred[0]) / 9 - 2.0) < 0.5
+
+    def test_double_differencing_quadratic(self):
+        """d=2 on y = t^2: second difference is constant 2, so the forecast
+        must continue the quadratic."""
+        t = np.arange(200, dtype=float)
+        y = t ** 2
+        f = ARIMAForecaster(p=1, d=2, q=0).fit(y)
+        pred = f.predict(horizon=5)
+        want = (np.arange(200, 205, dtype=float)) ** 2
+        rel = np.abs(pred - want) / want
+        assert rel.max() < 0.02, (pred, want)
+
+    def test_save_restore(self, tmp_path):
+        f = ARIMAForecaster(p=2, d=0, q=1).fit(_ar_series())
+        p1 = f.predict(5)
+        f.save(str(tmp_path))
+        g = ARIMAForecaster().restore(str(tmp_path))
+        np.testing.assert_allclose(g.predict(5), p1)
+
+    def test_too_short_raises(self):
+        with pytest.raises(ValueError, match="too short"):
+            ARIMAForecaster(p=2, d=0, q=2).fit(np.ones(8))
+
+    def test_bad_order_raises(self):
+        with pytest.raises(ValueError):
+            ARIMAForecaster(p=0, d=0, q=0)
+
+
+def _seasonal_df(n_days=120, seed=0):
+    rng = np.random.RandomState(seed)
+    ds = pd.date_range("2025-01-01", periods=n_days, freq="D")
+    t = np.arange(n_days, dtype=float)
+    y = 0.5 * t + 5 * np.sin(2 * np.pi * t / 7) + rng.randn(n_days) * 0.3
+    return pd.DataFrame({"ds": ds, "y": y})
+
+
+class TestProphet:
+    def test_learns_trend_and_weekly_cycle(self):
+        df = _seasonal_df()
+        f = ProphetForecaster(daily_seasonality=False).fit(df)
+        out = f.predict(horizon=14, freq="D")
+        assert list(out.columns) == ["ds", "yhat"]
+        t_future = np.arange(120, 134, dtype=float)
+        want = 0.5 * t_future + 5 * np.sin(2 * np.pi * t_future / 7)
+        err = np.abs(out["yhat"].to_numpy() - want).max()
+        assert err < 1.5, err
+
+    def test_evaluate_in_sample(self):
+        df = _seasonal_df()
+        f = ProphetForecaster(daily_seasonality=False).fit(df)
+        scores = f.evaluate(df, metrics=("mse", "mae"))
+        assert scores["mse"] < 0.5
+
+    def test_save_restore(self, tmp_path):
+        df = _seasonal_df()
+        f = ProphetForecaster(daily_seasonality=False).fit(df)
+        p1 = f.predict(7)["yhat"].to_numpy()
+        f.save(str(tmp_path))
+        g = ProphetForecaster().restore(str(tmp_path))
+        np.testing.assert_allclose(g.predict(7)["yhat"].to_numpy(), p1)
+
+    def test_monthly_frequency(self):
+        """Calendar frequencies must work (ref Prophet supports monthly)."""
+        df = _seasonal_df(200)
+        f = ProphetForecaster(daily_seasonality=False,
+                              weekly_seasonality=False).fit(df)
+        out = f.predict(horizon=3, freq="MS")
+        assert len(out) == 3
+        assert out["ds"].dt.day.tolist() == [1, 1, 1]
+
+    def test_predict_before_fit_raises(self):
+        with pytest.raises(RuntimeError):
+            ProphetForecaster().predict(3)

@@ -131,7 +131,7 @@ class TestEstimators:
         labels_masked[:, 6:] = -1
         l1 = np.asarray(_ner_loss(labels_masked, logits))
         garbage = labels.copy()
-        garbage[:, 6:] = -1  # same mask, different (ignored) garbage beneath
+        garbage[:, 6:] = -7  # different negative marker, same mask
         l2 = np.asarray(_ner_loss(garbage, logits))
         np.testing.assert_allclose(l1, l2)
         # and differs from the unmasked loss
