@@ -1,0 +1,175 @@
+"""Pipeline parallelism — GPipe schedule over the ``pipe`` mesh axis.
+
+NEW capability vs the reference (SURVEY.md §2.6: "TP / PP / SP / EP / CP —
+absent in reference"; its only parallelism is per-core data parallel,
+Topology.scala:1145-1550). The TPU idiom: identical pipeline stages hold
+their parameters stacked on a leading stage dimension that is sharded over
+the ``pipe`` axis; inside ``shard_map`` each device runs its stage and
+hands activations to the next device with ``lax.ppermute`` over ICI, while
+``lax.scan`` drives the microbatch schedule. Total ticks =
+n_micro + n_stages - 1 (the GPipe bubble); grads flow through ppermute, so
+the same ``jax.grad`` training path works unchanged.
+
+Heterogeneous prologue/epilogue (embedding, head) stay outside the
+pipelined region — they run data-parallel as usual.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import numpy as np
+
+from analytics_zoo_tpu.parallel import mesh as mesh_lib
+
+
+def stack_stage_params(params_list):
+    """Stack S per-stage pytrees (identical structure) along a new leading
+    stage axis — the layout ``gpipe`` expects (shard dim 0 over ``pipe``)."""
+    import jax
+    return jax.tree_util.tree_map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]), *params_list)
+
+
+def gpipe(stage_fn: Callable, stacked_params, x, *, mesh=None,
+          n_microbatches: int, axis: str = mesh_lib.PIPE_AXIS):
+    """Run ``x`` through S pipeline stages with the GPipe schedule.
+
+    - ``stage_fn(stage_params, activation) -> activation`` — one stage;
+      activations must keep one shape across stages.
+    - ``stacked_params``: pytree whose leaves have leading dim S
+      (``stack_stage_params``), sharded over ``axis``.
+    - ``x``: [batch, ...]; batch must divide into ``n_microbatches``.
+
+    Returns [batch, ...] outputs, replicated over the pipe axis. Jittable
+    and differentiable (use under ``jax.grad`` for training).
+    """
+    import inspect
+    import jax
+    import jax.numpy as jnp
+    try:
+        from jax import shard_map as _smap
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map as _smap
+    # jax >= 0.8 renamed/removed check_rep; psum over the pipe axis yields
+    # a replicated output either way
+    _kw = {}
+    sig = inspect.signature(_smap).parameters
+    if "check_rep" in sig:
+        _kw["check_rep"] = False
+    elif "check_vma" in sig:
+        _kw["check_vma"] = False
+    shard_map = partial(_smap, **_kw)
+    from jax.sharding import PartitionSpec as P
+
+    if mesh is None:
+        mesh = mesh_lib.get_default_mesh()
+    S = mesh_lib.mesh_axis_size(mesh, axis)
+    if S < 2:
+        raise ValueError(f"mesh has no usable {axis!r} axis: "
+                         f"{dict(zip(mesh.axis_names, mesh.devices.shape))}")
+    # split the batch over the data axis (when present) so each dp group
+    # pipelines only its own slice — P() here would all-gather the global
+    # batch and make every dp replica redundantly run all microbatches
+    dp = mesh_lib.mesh_axis_size(mesh, mesh_lib.DATA_AXIS)
+    batch_spec_axis = mesh_lib.DATA_AXIS if dp > 1 else None
+    b = x.shape[0]
+    M = int(n_microbatches)
+    if b % (M * max(dp, 1)):
+        raise ValueError(f"batch {b} not divisible by n_microbatches {M} "
+                         f"x dp {dp}")
+    mb = b // M // max(dp, 1)
+
+    first = jax.tree_util.tree_leaves(stacked_params)[0]
+    if first.shape[0] != S:
+        raise ValueError(
+            f"stacked params leading dim {first.shape[0]} != pipe size {S}")
+
+    params_spec = jax.tree_util.tree_map(
+        lambda _: P(axis), stacked_params)
+    x_spec = P(batch_spec_axis)
+
+    @partial(shard_map, mesh=mesh, in_specs=(params_spec, x_spec),
+             out_specs=x_spec)
+    def run(p_stage, x_all):
+        # p_stage leaves: [1, ...] (this device's stage) — drop the dim.
+        # x_all: this dp group's batch slice [b/dp, ...]
+        p_stage = jax.tree_util.tree_map(lambda a: a[0], p_stage)
+        idx = jax.lax.axis_index(axis)
+        micro = x_all.reshape((M, mb) + x_all.shape[1:])
+        out_buf = jnp.zeros((M, mb) + x_all.shape[1:], x_all.dtype)
+        carry0 = jnp.zeros((mb,) + x_all.shape[1:], x_all.dtype)
+
+        def tick(state, t):
+            carry, out_buf = state
+            # stage 0 ingests microbatch t (clamped; masked later)
+            feed = micro[jnp.minimum(t, M - 1)]
+            inp = jnp.where(idx == 0, feed, carry)
+            out = stage_fn(p_stage, inp)
+            # last stage writes its result for microbatch t-(S-1)
+            slot = jnp.clip(t - (S - 1), 0, M - 1)
+            valid = jnp.logical_and(idx == S - 1, t >= S - 1)
+            upd = jnp.where(valid, out, out_buf[slot])
+            out_buf = jax.lax.dynamic_update_index_in_dim(out_buf, upd,
+                                                          slot, 0)
+            # hand activations down the pipe: i -> i+1 (ring; stage 0
+            # ignores what it receives from S-1)
+            nxt = jax.lax.ppermute(
+                out, axis, [(i, (i + 1) % S) for i in range(S)])
+            return (nxt, out_buf), None
+
+        (_, out_buf), _ = jax.lax.scan(
+            tick, (carry0, out_buf), jnp.arange(M + S - 1))
+        # result lives on the last stage; replicate over the pipe axis
+        out_buf = jnp.where(idx == S - 1, out_buf, 0.0)
+        out_buf = jax.lax.psum(out_buf, axis)
+        return out_buf.reshape((x_all.shape[0],) + x_all.shape[1:])
+
+    return run(stacked_params, x)
+
+
+class PipelinedMLP:
+    """Convenience model: S identical Dense+activation stages pipelined
+    over the pipe axis; prologue/epilogue dense layers replicated.
+
+    Exposes ``init(rng, x)`` / ``apply(params, x)`` so it plugs into
+    ``Estimator.from_fn`` — pipeline-parallel training through the standard
+    engine."""
+
+    def __init__(self, hidden: int, out_dim: int, n_stages: int,
+                 n_microbatches: int = 4, mesh=None):
+        self.hidden, self.out_dim = hidden, out_dim
+        self.S, self.M = n_stages, n_microbatches
+        self.mesh = mesh
+
+    def init(self, rng, x):
+        import jax
+        k_in, k_stage, k_out = jax.random.split(rng, 3)
+        f_in = x.shape[-1]
+        scale = 1.0 / np.sqrt(self.hidden)
+        return {
+            "w_in": jax.random.normal(k_in, (f_in, self.hidden)) / np.sqrt(f_in),
+            "stages": {
+                "w": jax.random.normal(
+                    k_stage, (self.S, self.hidden, self.hidden)) * scale,
+                "b": np.zeros((self.S, self.hidden), np.float32),
+            },
+            "w_out": jax.random.normal(k_out, (self.hidden, self.out_dim))
+            * scale,
+        }
+
+    def apply(self, params, x):
+        import jax.numpy as jnp
+
+        def stage_fn(p, h):
+            return jnp.tanh(h @ p["w"] + p["b"])
+
+        h = x @ params["w_in"]
+        h = gpipe(stage_fn, params["stages"], h, mesh=self.mesh,
+                  n_microbatches=self.M)
+        return h @ params["w_out"]
+
+    def param_rules(self):
+        """Shard the stacked stage dim over ``pipe`` for the Estimator."""
+        return [(r"stages/(w|b)", (mesh_lib.PIPE_AXIS,))]
