@@ -180,8 +180,49 @@ class Estimator:
                             seed=seed)
 
     # reference-compatible spellings
-    from_keras = None   # bound below by keras package to accept zoo-keras models
-    from_graph = None
+    @staticmethod
+    def from_keras(*, keras_model, loss=None, optimizer=None,
+                   metrics=None, model_dir: Optional[str] = None,
+                   strategy=None, param_rules=None) -> "JaxEstimator":
+        """Estimator over a zoo-keras model
+        (ref pyzoo/zoo/orca/learn/tf/estimator.py:335 Estimator.from_keras).
+        Settings already on the model (a prior ``compile``, a prior
+        ``set_strategy``) are kept; explicit non-None arguments override."""
+        from analytics_zoo_tpu.keras.models import KerasNet
+        model = getattr(keras_model, "model", keras_model)  # ZooModel wrap
+        if not isinstance(model, KerasNet):
+            raise TypeError(
+                f"from_keras expects a zoo keras model, got "
+                f"{type(keras_model).__name__}; use from_flax for raw "
+                "flax modules")
+        compiled = model._compile_args or {}
+        if strategy is not None or param_rules is not None:
+            model.set_strategy(strategy or model._strategy,
+                               param_rules=param_rules)
+        model.compile(
+            optimizer=optimizer if optimizer is not None
+            else compiled.get("optimizer", "adam"),
+            loss=loss if loss is not None else compiled.get("loss", "mse"),
+            metrics=metrics if metrics is not None
+            else compiled.get("metrics"))
+        est = model._ensure_estimator(for_training=True)
+        if model_dir:
+            est.model_dir = model_dir
+        return est
+
+    @staticmethod
+    def from_graph(*, inputs, outputs, loss, optimizer="adam",
+                   metrics=None, model_dir: Optional[str] = None,
+                   strategy="dp", param_rules=None) -> "JaxEstimator":
+        """Estimator over a symbolic layer graph — Input()/layer Nodes
+        (ref orca/learn/tf/estimator.py:291 Estimator.from_graph, which
+        takes TF1 graph tensors; here the graph is the zoo keras graph)."""
+        from analytics_zoo_tpu.keras.models import Model
+        model = Model(inputs, outputs)
+        return Estimator.from_keras(
+            keras_model=model, loss=loss, optimizer=optimizer,
+            metrics=metrics, model_dir=model_dir, strategy=strategy,
+            param_rules=param_rules)
 
     @staticmethod
     def latest_checkpoint(model_dir: str):

@@ -1,0 +1,78 @@
+"""Estimator.from_keras / from_graph factory tests
+(ref pyzoo/test/zoo/orca/learn/test_estimator_*)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.learn.estimator import Estimator
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 4).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+    return x, y
+
+
+class TestFromKeras:
+    def test_fit_predict(self, orca_ctx):
+        from analytics_zoo_tpu.keras.models import Sequential
+        from analytics_zoo_tpu.keras.layers import Dense
+
+        m = Sequential()
+        m.add(Dense(8, input_shape=(4,), activation="relu"))
+        m.add(Dense(2, activation="softmax"))
+        x, y = _data()
+        est = Estimator.from_keras(
+            keras_model=m, loss="sparse_categorical_crossentropy",
+            optimizer="adam")
+        h = est.fit((x, y), epochs=5, batch_size=16)
+        assert h["loss"][-1] < h["loss"][0]
+        assert np.asarray(est.predict(x, batch_size=16)).shape == (64, 2)
+
+    def test_compiled_defaults_are_used(self, orca_ctx):
+        from analytics_zoo_tpu.keras.models import Sequential
+        from analytics_zoo_tpu.keras.layers import Dense
+        from analytics_zoo_tpu.learn.optimizers import Optimizer
+
+        m = Sequential()
+        m.add(Dense(2, input_shape=(4,), activation="softmax"))
+        m.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")
+        est = Estimator.from_keras(keras_model=m)
+        # the compiled optimizer wins over the factory default
+        assert type(est.optimizer) is type(Optimizer.get("sgd"))
+        x, y = _data()
+        est.fit((x, y), epochs=1, batch_size=16)
+
+    def test_prior_strategy_is_kept(self, orca_ctx):
+        from analytics_zoo_tpu.keras.models import Sequential
+        from analytics_zoo_tpu.keras.layers import Dense
+
+        m = Sequential()
+        m.add(Dense(4, input_shape=(4,), activation="relu"))
+        m.add(Dense(2, activation="softmax"))
+        m.set_strategy("dp2,tp4",
+                       param_rules=[(r"kernel", (None, "model"))])
+        est = Estimator.from_keras(
+            keras_model=m, loss="sparse_categorical_crossentropy")
+        assert str(est.strategy) == "dp2,tp4"
+        assert est.strategy.param_rules
+
+    def test_rejects_non_keras(self):
+        with pytest.raises(TypeError, match="zoo keras"):
+            Estimator.from_keras(keras_model=object(), loss="mse")
+
+
+class TestFromGraph:
+    def test_symbolic_graph_trains(self, orca_ctx):
+        from analytics_zoo_tpu.keras.engine import Input
+        from analytics_zoo_tpu.keras.layers import Dense
+
+        inp = Input(shape=(4,))
+        out = Dense(2, activation="softmax")(Dense(8, activation="relu")(inp))
+        x, y = _data()
+        est = Estimator.from_graph(
+            inputs=inp, outputs=out,
+            loss="sparse_categorical_crossentropy")
+        h = est.fit((x, y), epochs=5, batch_size=16)
+        assert h["loss"][-1] < h["loss"][0]

@@ -1,0 +1,29 @@
+"""Smoke-run the examples (the reference runs its example scripts in CI,
+pyzoo/zoo/examples/run-example-test*.sh — same idea)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+# distributed_training sets its own virtual-device env; the others inherit
+# the test env (CPU platform via conftest env vars)
+ALL = ["recommendation_ncf.py", "anomaly_detection.py",
+       "autots_forecast.py", "cluster_serving.py", "torch_migration.py",
+       "distributed_training.py"]
+
+
+@pytest.mark.parametrize("script", ALL)
+def test_example_runs(script):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script)],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert proc.returncode == 0, (
+        f"{script} failed:\nstdout:\n{proc.stdout[-2000:]}\n"
+        f"stderr:\n{proc.stderr[-2000:]}")
