@@ -171,18 +171,7 @@ class ShardedDataset:
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        # Fixed-shape constraint (ref tf_dataset.py:117: batch_size must be
-        # divisible by the total core count): the per-host batch must divide
-        # over the mesh's batch axes.
-        divisor = 1
-        for ax in strategy.batch_axes():
-            divisor *= dict(zip(mesh.axis_names, mesh.devices.shape)).get(ax, 1)
-        per_host = batch_size // max(1, jax.process_count())
-        if divisor and per_host % divisor:
-            raise ValueError(
-                f"batch_size {batch_size} (per-host {per_host}) must be "
-                f"divisible by the mesh batch-axis size {divisor} "
-                f"(axes {strategy.batch_axes()})")
+        self._check_batch_divisible(mesh, strategy, batch_size)
 
         from analytics_zoo_tpu.parallel.mesh import place_on_mesh
 
@@ -199,6 +188,71 @@ class ShardedDataset:
         prev = None
         for b in it:
             cur = place(b)  # async transfer starts immediately
+            if prev is not None:
+                yield prev
+            prev = cur
+        if prev is not None:
+            yield prev
+
+    @staticmethod
+    def _check_batch_divisible(mesh, strategy, batch_size: int):
+        """Fixed-shape constraint (ref tf_dataset.py:117: batch_size must
+        be divisible by the total core count): the per-host batch must
+        divide over the mesh's batch axes."""
+        import jax
+        divisor = 1
+        for ax in strategy.batch_axes():
+            divisor *= dict(zip(mesh.axis_names,
+                                mesh.devices.shape)).get(ax, 1)
+        per_host = batch_size // max(1, jax.process_count())
+        if divisor and per_host % divisor:
+            raise ValueError(
+                f"batch_size {batch_size} (per-host {per_host}) must be "
+                f"divisible by the mesh batch-axis size {divisor} "
+                f"(axes {strategy.batch_axes()})")
+
+    def device_scan_iterator(self, mesh, strategy, batch_size: int,
+                             steps_per_loop: int, shuffle: bool = False,
+                             seed: int = 0, epoch: int = 0):
+        """Group ``steps_per_loop`` full batches into ONE stacked transfer
+        ``[K, batch, ...]`` for the estimator's fused ``lax.scan`` train
+        loop (leading scan dim unsharded; batch dim sharded as usual).
+        Yields ``(x_stack, y_stack, k)``; the tail group has k <
+        steps_per_loop. Remainder rows that don't fill a batch are dropped
+        (drop_remainder semantics)."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from analytics_zoo_tpu.parallel.mesh import place_on_mesh
+
+        self._check_batch_divisible(mesh, strategy, batch_size)
+
+        def scan_spec(a):
+            base = strategy.batch_spec(np.ndim(a) - 1)
+            return P(None, *base)
+
+        def place(group):
+            xs, ys = zip(*group)
+            stack = lambda trees: jax.tree_util.tree_map(  # noqa: E731
+                lambda *leaves: np.stack(leaves), *trees)
+            x = place_on_mesh(stack(xs), mesh, scan_spec)
+            y = place_on_mesh(stack(ys), mesh, scan_spec) \
+                if ys[0] is not None else None
+            return x, y, len(group)
+
+        group = []
+        prev = None
+        for x, y, _ in self.iter_batches(batch_size, shuffle, seed, epoch,
+                                         drop_remainder=True):
+            group.append((x, y))
+            if len(group) == steps_per_loop:
+                cur = place(group)
+                group = []
+                if prev is not None:
+                    yield prev
+                prev = cur
+        if group:
+            cur = place(group)
             if prev is not None:
                 yield prev
             prev = cur

@@ -88,9 +88,15 @@ class KerasNet:
 
     # -- reference API --
     def set_strategy(self, strategy: str, param_rules=None):
-        """TPU extension: parallelism for this model ("dp", "dp2,tp4"...)."""
+        """TPU extension: parallelism for this model ("dp", "dp2,tp4"...).
+
+        ``param_rules=None`` keeps any previously set rules. Existing
+        parameters (loaded weights, training progress) survive the change —
+        the rebuilt estimator re-shards them under the new layout."""
         self._strategy = strategy
-        self._param_rules = param_rules
+        if param_rules is not None:
+            self._param_rules = param_rules
+        self._stash_adapter()
         self._estimator = None
         return self
 
@@ -100,10 +106,24 @@ class KerasNet:
         the existing parameters."""
         self._compile_args = dict(optimizer=optimizer, loss=loss,
                                   metrics=metrics)
-        if self._estimator is not None:
-            self._reuse_adapter = self._estimator.adapter
+        self._stash_adapter()
         self._estimator = None
         return self
+
+    def _stash_adapter(self):
+        """Keep current weights across an estimator rebuild. The latest
+        parameters live in the estimator STATE (the adapter's originals may
+        be donated/deleted buffers after the first train step), so sync
+        them back host-side before handing the adapter over."""
+        est = self._estimator
+        if est is None:
+            return
+        if est._state is not None:
+            import jax
+            est.adapter.params = jax.device_get(est._state["params"])
+            est.adapter.model_state = jax.device_get(
+                est._state["model_state"])
+        self._reuse_adapter = est.adapter
 
     def set_tensorboard(self, log_dir: str, app_name: str):
         self._ensure_estimator().set_tensorboard(log_dir, app_name)

@@ -196,13 +196,17 @@ class Estimator:
                 f"{type(keras_model).__name__}; use from_flax for raw "
                 "flax modules")
         compiled = model._compile_args or {}
+        if loss is None and compiled.get("loss") is None:
+            raise ValueError(
+                "no loss: pass loss=... or compile the model first (every "
+                "other training entry point errors here too)")
         if strategy is not None or param_rules is not None:
             model.set_strategy(strategy or model._strategy,
                                param_rules=param_rules)
         model.compile(
             optimizer=optimizer if optimizer is not None
             else compiled.get("optimizer", "adam"),
-            loss=loss if loss is not None else compiled.get("loss", "mse"),
+            loss=loss if loss is not None else compiled["loss"],
             metrics=metrics if metrics is not None
             else compiled.get("metrics"))
         est = model._ensure_estimator(for_training=True)
@@ -433,6 +437,20 @@ class JaxEstimator:
 
         self._train_step = jax.jit(step_fn, donate_argnums=0)
 
+        def scan_fn(state, batches):
+            # K steps in ONE dispatch: for small models per-step launch
+            # overhead dominates, and scan amortizes it (the analog of the
+            # reference keeping its hot loop inside the JVM task,
+            # Topology.scala:1262 optimizeModels)
+            def body(s, xy):
+                s2, logs = step_fn(s, xy[0], xy[1])
+                return s2, logs["loss"]
+
+            state, losses = jax.lax.scan(body, state, batches)
+            return state, losses
+
+        self._train_scan = jax.jit(scan_fn, donate_argnums=0)
+
     def _build_eval_step(self):
         import jax
         import jax.numpy as jnp
@@ -475,10 +493,16 @@ class JaxEstimator:
             validation_data=None,
             checkpoint_trigger: Optional[Trigger] = None,
             summary_interval: int = 20,
-            shuffle: bool = True) -> Dict[str, List[float]]:
+            shuffle: bool = True,
+            steps_per_loop: int = 1) -> Dict[str, List[float]]:
         """(ref orca/learn/tf/estimator.py fit:486; batch_size is the GLOBAL
         batch — the reference required batch_size % num_workers == 0, here it
-        must divide the data-axis size of the mesh)."""
+        must divide the data-axis size of the mesh).
+
+        ``steps_per_loop > 1`` fuses that many optimizer steps into one
+        compiled ``lax.scan`` dispatch — a large win for small models where
+        per-step launch overhead dominates. Checkpoint triggers are then
+        evaluated once per loop, not per step."""
         ds = self._coerce(to_sharded_dataset(data, feature_cols, label_cols))
         val_ds = (self._coerce(to_sharded_dataset(validation_data, feature_cols,
                                                   label_cols))
@@ -497,7 +521,8 @@ class JaxEstimator:
             try:
                 epoch_loss = self._run_epoch(
                     ds, mesh, batch_size, shuffle, summary_interval,
-                    train_writer, checkpoint_trigger)
+                    train_writer, checkpoint_trigger,
+                    steps_per_loop=steps_per_loop)
             except Exception:
                 # elastic retry-from-snapshot (ref Topology.scala:1255-1337)
                 retries += 1
@@ -540,45 +565,71 @@ class JaxEstimator:
         return int(np.asarray(self._state["step"]))
 
     def _run_epoch(self, ds, mesh, batch_size, shuffle, summary_interval,
-                   writer, checkpoint_trigger) -> float:
+                   writer, checkpoint_trigger, steps_per_loop: int = 1
+                   ) -> float:
         import jax
         losses: List[Any] = []
         pending: List[Any] = []
+        pending_steps = 0
         t_epoch = time.time()
         samples = 0
-        it = ds.device_iterator(mesh, self.strategy, batch_size,
-                                shuffle=shuffle, seed=self.seed,
-                                epoch=self._epoch, drop_remainder=True)
         t_window = time.time()
 
         def flush_window():
             # one host sync per window: fetch the buffered device scalars
-            nonlocal pending, t_window
+            nonlocal pending, pending_steps, t_window
             if not pending:
                 return
-            vals = [float(v) for v in jax.device_get(pending)]
+            vals = list(np.concatenate(
+                [np.atleast_1d(np.asarray(v)) for v in jax.device_get(pending)]
+            ).astype(float))
             losses.extend(vals)
             step = self._py_step
             writer.add_scalar("Loss", vals[-1], step)
             dt = time.time() - t_window
             writer.add_scalar("Throughput",
-                              len(pending) * batch_size / max(dt, 1e-9), step)
+                              pending_steps * batch_size / max(dt, 1e-9),
+                              step)
             t_window = time.time()
             pending = []
+            pending_steps = 0
 
-        for x, y, _ in it:
-            self._state, logs = self._train_step(self._state, x, y)
-            self._py_step += 1
-            pending.append(logs["loss"])
-            samples += batch_size
-            if len(pending) >= summary_interval:
+        def after_steps(n_steps):
+            nonlocal pending_steps, samples
+            start = self._py_step
+            self._py_step += n_steps
+            pending_steps += n_steps
+            samples += n_steps * batch_size
+            if pending_steps >= summary_interval:
                 flush_window()
             # iteration-granular checkpointing, e.g. SeveralIteration(n)
-            # (ref Topology.scala checkpointTrigger evaluated per iteration)
-            if checkpoint_trigger and self.model_dir and checkpoint_trigger(
-                    self._epoch, self._py_step, losses[-1] if losses else None):
-                flush_window()
-                self._save_snapshot()
+            # (ref Topology.scala checkpointTrigger evaluated per iteration).
+            # With steps_per_loop > 1 every intermediate step is tested so
+            # SeveralIteration(n) keeps its cadence (at most one snapshot
+            # per loop; it reflects the loop-end state).
+            if checkpoint_trigger and self.model_dir:
+                last = losses[-1] if losses else None
+                if any(checkpoint_trigger(self._epoch, s, last)
+                       for s in range(start + 1, self._py_step + 1)):
+                    flush_window()
+                    self._save_snapshot()
+
+        if steps_per_loop > 1:
+            for x, y, k in ds.device_scan_iterator(
+                    mesh, self.strategy, batch_size, steps_per_loop,
+                    shuffle=shuffle, seed=self.seed, epoch=self._epoch):
+                self._state, loop_losses = self._train_scan(self._state,
+                                                            (x, y))
+                pending.append(loop_losses)
+                after_steps(k)
+        else:
+            it = ds.device_iterator(mesh, self.strategy, batch_size,
+                                    shuffle=shuffle, seed=self.seed,
+                                    epoch=self._epoch, drop_remainder=True)
+            for x, y, _ in it:
+                self._state, logs = self._train_step(self._state, x, y)
+                pending.append(logs["loss"])
+                after_steps(1)
         flush_window()
         dt = time.time() - t_epoch
         logger.info("epoch %d: %d samples in %.2fs (%.0f samples/s)",

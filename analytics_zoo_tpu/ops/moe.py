@@ -1,0 +1,120 @@
+"""Mixture-of-Experts with expert parallelism.
+
+NEW capability vs the reference (SURVEY.md §2.6: EP absent). GShard/Switch
+style: top-k softmax gating with a fixed capacity per expert, dispatch and
+combine as one-hot einsum contractions, experts as weight tensors stacked
+on a leading E dim. Sharding the E dim over the ``expert`` mesh axis makes
+XLA emit the token all-to-alls over ICI — no hand-written routing layer
+(the design the scaling-book recipe prescribes: annotate, let XLA insert
+collectives).
+
+``MoEModule`` is a flax module usable anywhere (e.g. as a transformer FFN
+replacement); ``ep_param_rules()`` gives the Estimator partition rules.
+Auxiliary load-balancing loss (Switch §2.2 style) is returned via the
+module's ``aux_loss`` attribute collection.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.parallel import mesh as mesh_lib
+
+
+def top_k_gating(logits: jnp.ndarray, k: int, capacity: int
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """logits: [N, E] → (dispatch [N, E, C] one-hot, combine [N, E, C]
+    weights, aux load-balance loss). Tokens beyond an expert's capacity C
+    are dropped (their combine weight is 0) — the standard fixed-shape
+    trade that keeps everything jittable."""
+    N, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # Switch aux loss: E * sum_e (fraction of tokens routed to e *
+    # mean gate prob of e)
+    top1 = jnp.argmax(probs, axis=-1)
+    frac_tokens = jnp.mean(jax.nn.one_hot(top1, E), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+
+    dispatch = jnp.zeros((N, E, capacity), logits.dtype)
+    combine = jnp.zeros((N, E, capacity), logits.dtype)
+    residual_probs = probs
+    filled = jnp.zeros((E,), logits.dtype)  # slots used by earlier passes
+    for _ in range(k):
+        choice = jnp.argmax(residual_probs, axis=-1)            # [N]
+        gate = jnp.take_along_axis(residual_probs, choice[:, None],
+                                   axis=-1)[:, 0]               # [N]
+        onehot = jax.nn.one_hot(choice, E, dtype=logits.dtype)  # [N, E]
+        # position within the expert's queue, offset by slots already
+        # consumed in earlier passes (otherwise 1st- and 2nd-choice tokens
+        # of the same expert would share a slot and their features sum)
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0 + filled[None, :]) * onehot
+        in_cap = (pos < capacity) & (onehot > 0)
+        pos_idx = jnp.clip(pos.astype(jnp.int32), 0, capacity - 1)
+        slot = jax.nn.one_hot(pos_idx, capacity, dtype=logits.dtype)
+        contrib = jnp.where(in_cap[..., None], slot, 0.0)       # [N, E, C]
+        dispatch = dispatch + contrib
+        combine = combine + contrib * gate[:, None, None]
+        filled = filled + jnp.sum(onehot * in_cap, axis=0)
+        residual_probs = residual_probs * (1.0 - onehot)
+    return dispatch, combine, aux
+
+
+class MoEModule(nn.Module):
+    """Expert-parallel FFN block: ``y = combine @ FFN_e(dispatch @ x)``.
+
+    Input [..., d_model] → output [..., d_model]. Expert weights have
+    leading dim ``n_experts``; shard it over the ``expert`` axis
+    (``ep_param_rules``) for expert parallelism.
+    """
+
+    n_experts: int
+    d_model: int
+    d_hidden: int
+    k: int = 2
+    capacity_factor: float = 1.25
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        orig_shape = x.shape
+        tokens = x.reshape(-1, self.d_model)                    # [N, d]
+        N = tokens.shape[0]
+        capacity = max(1, int(self.capacity_factor * N *
+                              self.k / self.n_experts))
+
+        gate_w = self.param(
+            "gate", nn.initializers.lecun_normal(),
+            (self.d_model, self.n_experts))
+        dispatch, combine, aux = top_k_gating(
+            tokens @ gate_w, self.k, capacity)
+        self.sow("aux_loss", "load_balance", aux)
+
+        w1 = self.param("w1", nn.initializers.lecun_normal(),
+                        (self.n_experts, self.d_model, self.d_hidden))
+        b1 = self.param("b1", nn.initializers.zeros,
+                        (self.n_experts, self.d_hidden))
+        w2 = self.param("w2", nn.initializers.lecun_normal(),
+                        (self.n_experts, self.d_hidden, self.d_model))
+        b2 = self.param("b2", nn.initializers.zeros,
+                        (self.n_experts, self.d_model))
+
+        # all-to-all happens here when E is sharded over 'expert'
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch, tokens)
+        h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", expert_in, w1)
+                        + b1[:, None, :])
+        expert_out = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+        out = jnp.einsum("nec,ecd->nd", combine, expert_out)
+        return out.reshape(orig_shape)
+
+
+def ep_param_rules() -> list:
+    """Partition rules sharding expert-stacked weights over ``expert``."""
+    ax = mesh_lib.EXPERT_AXIS
+    return [
+        (r"/(w1|b1|w2|b2)$", (ax,)),
+    ]

@@ -103,10 +103,15 @@ class ShardingStrategy:
         """PartitionSpec for one parameter. A rule whose sharded dims don't
         divide by the mesh axis size is dropped for that parameter, which
         then gets the default layout (fsdp sharding when the fsdp axis is
-        active, else replication) — e.g. a 5-class output head under tp2."""
+        active, else replication) — e.g. a 5-class output head under tp2.
+        Rules referencing axes absent from the mesh are inapplicable and
+        skipped (so stale tp/ep rules survive a strategy downgrade to a
+        plain dp mesh instead of crashing)."""
         from jax.sharding import PartitionSpec as P
         for pattern, spec in self.param_rules:
             if re.search(pattern, path):
+                if not self._axes_in_mesh(spec, mesh):
+                    continue
                 if self._divisible(spec, shape, mesh):
                     return P(*spec)
                 break
@@ -120,6 +125,17 @@ class ShardingStrategy:
                     spec[i] = mesh_lib.FSDP_AXIS
                     return P(*spec)
         return P()
+
+    @staticmethod
+    def _axes_in_mesh(spec, mesh) -> bool:
+        names = set(mesh.axis_names)
+        for axes in spec:
+            if axes is None:
+                continue
+            for ax in (axes if isinstance(axes, tuple) else (axes,)):
+                if ax not in names:
+                    return False
+        return True
 
     @staticmethod
     def _divisible(spec, shape, mesh) -> bool:

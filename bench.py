@@ -26,12 +26,13 @@ import time
 USERS, ITEMS, CLASSES = 6040, 3706, 5
 BATCH = 8000            # ref notebook batch_size=8000
 N_ROWS = 400_000
-WARMUP_STEPS = 5
-MEASURE_STEPS = 30
+WARMUP_STEPS = 10
+MEASURE_STEPS = 40
+STEPS_PER_LOOP = 10     # optimizer steps fused into one scan dispatch
 
 # Measured on this host via `python bench.py --cpu-baseline` (single-core
-# JAX CPU backend, same train step, 2026-07-29): 1,256,454 samples/s.
-CPU_BASELINE_SPS = float(os.environ.get("BENCH_BASELINE_SPS", 1_256_454.0))
+# JAX CPU backend, same fused train loop, 2026-07-29): 1,120,094 samples/s.
+CPU_BASELINE_SPS = float(os.environ.get("BENCH_BASELINE_SPS", 1_120_094.0))
 
 
 def build():
@@ -64,25 +65,29 @@ def measure() -> float:
     mesh = est._ensure_mesh()
     est._build_train_step()
 
-    def batches():
+    # fused multi-step loop: one dispatch per STEPS_PER_LOOP optimizer
+    # steps (estimator fit(steps_per_loop=...) path)
+    def loops():
         while True:
-            for b in ds.device_iterator(mesh, est.strategy, BATCH,
-                                        shuffle=False):
-                yield b
+            for b in ds.device_scan_iterator(mesh, est.strategy, BATCH,
+                                             STEPS_PER_LOOP, shuffle=False):
+                if b[2] == STEPS_PER_LOOP:   # fixed shape only
+                    yield b
 
-    it = batches()
-    for _ in range(WARMUP_STEPS):
+    it = loops()
+    for _ in range(max(1, WARMUP_STEPS // STEPS_PER_LOOP)):
         bx, by, _ = next(it)
-        est._state, logs = est._train_step(est._state, bx, by)
-    jax.block_until_ready(logs["loss"])
+        est._state, losses = est._train_scan(est._state, (bx, by))
+    jax.block_until_ready(losses)
 
+    n_loops = max(1, MEASURE_STEPS // STEPS_PER_LOOP)
     t0 = time.perf_counter()
-    for _ in range(MEASURE_STEPS):
+    for _ in range(n_loops):
         bx, by, _ = next(it)
-        est._state, logs = est._train_step(est._state, bx, by)
-    jax.block_until_ready(logs["loss"])
+        est._state, losses = est._train_scan(est._state, (bx, by))
+    jax.block_until_ready(losses)
     dt = time.perf_counter() - t0
-    return MEASURE_STEPS * BATCH / dt
+    return n_loops * STEPS_PER_LOOP * BATCH / dt
 
 
 def main():

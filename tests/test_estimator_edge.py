@@ -60,3 +60,45 @@ def test_multihost_requires_coordinator():
     from analytics_zoo_tpu import init_orca_context
     with pytest.raises(ValueError, match="coordinator_address"):
         init_orca_context(cluster_mode="multihost")
+
+
+class TestStepsPerLoop:
+    def _fit(self, steps_per_loop, seed=0):
+        import numpy as np
+        from analytics_zoo_tpu.learn.estimator import Estimator
+        import flax.linen as nn
+
+        class M(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(2)(nn.tanh(nn.Dense(8)(x)))
+
+        rng = np.random.RandomState(seed)
+        x = rng.randn(96, 4).astype(np.float32)
+        y = (x.sum(1) > 0).astype(np.int32)
+        est = Estimator.from_flax(
+            model=M(), loss="sparse_categorical_crossentropy_logits",
+            optimizer="sgd", sample_input=x[:2], seed=seed)
+        h = est.fit((x, y), epochs=2, batch_size=16, shuffle=False,
+                    steps_per_loop=steps_per_loop)
+        return est, h
+
+    def test_fused_loop_matches_per_step(self):
+        import numpy as np
+        import jax
+        est1, h1 = self._fit(1)
+        est4, h4 = self._fit(4)
+        # identical data order + sgd → identical parameters and losses
+        np.testing.assert_allclose(h1["loss"], h4["loss"], rtol=1e-5)
+        p1 = jax.device_get(est1._state["params"])
+        p4 = jax.device_get(est4._state["params"])
+        for l1, l4 in zip(jax.tree_util.tree_leaves(p1),
+                          jax.tree_util.tree_leaves(p4)):
+            np.testing.assert_allclose(l1, l4, rtol=1e-5, atol=1e-6)
+        assert est1._py_step == est4._py_step == 12
+
+    def test_tail_group_smaller_than_loop(self):
+        # 6 steps/epoch with steps_per_loop=4 → groups of 4 and 2
+        est, h = self._fit(4)
+        import numpy as np
+        assert np.isfinite(h["loss"]).all()

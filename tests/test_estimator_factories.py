@@ -76,3 +76,43 @@ class TestFromGraph:
             loss="sparse_categorical_crossentropy")
         h = est.fit((x, y), epochs=5, batch_size=16)
         assert h["loss"][-1] < h["loss"][0]
+
+
+class TestStrategyPreservesWeights:
+    def test_set_strategy_keeps_params(self, orca_ctx):
+        import numpy as np
+        from analytics_zoo_tpu.keras.models import Sequential
+        from analytics_zoo_tpu.keras.layers import Dense
+
+        m = Sequential()
+        m.add(Dense(8, input_shape=(4,), activation="relu"))
+        m.add(Dense(2, activation="softmax"))
+        m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+        x, y = _data()
+        m.fit(x, y, batch_size=16, nb_epoch=2)
+        before = np.asarray(m.predict(x, distributed=False))
+        # re-strategize through the factory: weights must survive
+        est = Estimator.from_keras(
+            keras_model=m, loss="sparse_categorical_crossentropy",
+            strategy="dp,tp2",
+            param_rules=[(r"kernel", (None, "model"))])
+        after = np.asarray(est.predict(x, batch_size=16))
+        np.testing.assert_allclose(after, before, atol=1e-5)
+
+    def test_strategy_only_keeps_rules(self, orca_ctx):
+        from analytics_zoo_tpu.keras.models import Sequential
+        from analytics_zoo_tpu.keras.layers import Dense
+
+        m = Sequential()
+        m.add(Dense(2, input_shape=(4,), activation="softmax"))
+        m.set_strategy("dp", param_rules=[(r"kernel", (None, "model"))])
+        m.set_strategy("dp2,tp2")  # no rules given → keep the old ones
+        assert m._param_rules
+
+    def test_missing_loss_raises(self, orca_ctx):
+        from analytics_zoo_tpu.keras.models import Sequential
+        from analytics_zoo_tpu.keras.layers import Dense
+        m = Sequential()
+        m.add(Dense(2, input_shape=(4,), activation="softmax"))
+        with pytest.raises(ValueError, match="no loss"):
+            Estimator.from_keras(keras_model=m)
