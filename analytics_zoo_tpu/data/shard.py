@@ -118,7 +118,9 @@ def _make_store(shards: List[Any], tier: str):
             return NativeShardStore(
                 list(shards),
                 keep_fraction_denom=max(1, int(tier.split("_", 1)[1])))
-        except (RuntimeError, ValueError):
+        except (RuntimeError, ValueError, OSError):
+            # OSError covers NativeShardStore's IOError on spill failure —
+            # degrade to the python spill instead of crashing
             tier = "DISK_" + tier.split("_", 1)[1]
     return _ShardStore(list(shards), tier)
 

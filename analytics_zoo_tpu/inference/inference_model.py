@@ -58,13 +58,22 @@ class InferenceModel:
         (ref doLoadBigDL, InferenceModel.scala:96)."""
         from analytics_zoo_tpu.keras.models import KerasNet
 
+        import jax
+        import jax.numpy as jnp
+
         net = model.model if hasattr(model, "model") and isinstance(
             getattr(model, "model"), KerasNet) else model
         est = net.estimator
         est._init_state()
         adapter = est.adapter
-        state = {"params": est._state["params"],
-                 "model_state": est._state["model_state"]}
+        # Deep-copy onto fresh device buffers: the estimator's train step
+        # donates its state (donate_argnums=0), so aliasing est._state here
+        # would leave this model pointing at invalidated TPU buffers after a
+        # subsequent est.fit().
+        state = jax.tree_util.tree_map(
+            jnp.array,
+            {"params": est._state["params"],
+             "model_state": est._state["model_state"]})
 
         def apply_fn(state, *xs):
             out, _ = adapter.apply(state["params"], state["model_state"],

@@ -65,7 +65,12 @@ class FlaxModelAdapter:
             variables = self._init(rng, sample_input)
             variables = dict(variables)
             params = variables.pop("params")
-            model_state = {k: v for k, v in variables.items()}
+            # "aux_loss" is a per-step sown output (e.g. MoE load-balance
+            # loss), not persistent state — it is consumed by the train step
+            # and must not ride model_state across steps (sow appends, so
+            # carrying it would grow the collection every iteration)
+            model_state = {k: v for k, v in variables.items()
+                           if k != "aux_loss"}
         self.params = params
         self.model_state = model_state or {}
 
@@ -87,11 +92,14 @@ class FlaxModelAdapter:
         if self._takes_train:
             kwargs["train"] = train
         rngs = {"dropout": rng} if rng is not None else None
-        if train and model_state:
+        if train:
+            # "aux_loss" mutable lets sown per-step losses (MoE load
+            # balancing) surface; the train step pops it off the returned
+            # collections before they become the next model_state
             out, mut = self.module.apply(
                 variables, *args, rngs=rngs,
-                mutable=list(model_state.keys()), **kwargs)
-            return out, mut
+                mutable=list(model_state.keys()) + ["aux_loss"], **kwargs)
+            return out, dict(mut)
         out = self.module.apply(variables, *args, rngs=rngs, **kwargs)
         return out, model_state
 
@@ -106,17 +114,21 @@ class FnModelAdapter:
     estimator's model_state — frozen (no grads, no optimizer updates), which
     is how translated BatchNorm running statistics stay fixed."""
 
-    def __init__(self, apply_fn, params, n_inputs: int, buffers=None):
+    def __init__(self, apply_fn, params, n_inputs: int, buffers=None,
+                 supports_train: bool = False):
         self._fn = apply_fn
         self._variables_style = buffers is not None
+        self._supports_train = supports_train
         self.params = params
         self.model_state = buffers or {}
         self.n_inputs = n_inputs
 
     def apply(self, params, model_state, x, train: bool, rng):
         if self._variables_style:
+            kwargs = ({"train": train, "rng": rng}
+                      if self._supports_train else {})
             out = self._fn({"params": params, "buffers": model_state},
-                           *_as_args(x))
+                           *_as_args(x), **kwargs)
         else:
             out = self._fn(params, *_as_args(x))
         return out, model_state
@@ -129,6 +141,7 @@ class Estimator:
     def from_flax(*, model, loss, optimizer="adam", metrics=None,
                   sample_input, model_dir: Optional[str] = None,
                   strategy="dp", param_rules=None, seed: int = 0,
+                  aux_loss_weight: float = 0.01,
                   backend: str = "tpu") -> "JaxEstimator":
         """Build an estimator from a flax.linen module.
 
@@ -143,7 +156,7 @@ class Estimator:
         return JaxEstimator(adapter, loss=loss, optimizer=optimizer,
                             metrics=metrics, model_dir=model_dir,
                             strategy=strategy, param_rules=param_rules,
-                            seed=seed)
+                            seed=seed, aux_loss_weight=aux_loss_weight)
 
     @staticmethod
     def from_torch(*, model, loss, optimizer="adam", metrics=None,
@@ -161,7 +174,8 @@ class Estimator:
         apply_fn, variables = torch_to_jax(model)
         adapter = FnModelAdapter(apply_fn, variables["params"],
                                  len(_as_args(sample_input)),
-                                 buffers=variables["buffers"])
+                                 buffers=variables["buffers"],
+                                 supports_train=True)
         return JaxEstimator(adapter, loss=loss, optimizer=optimizer,
                             metrics=metrics, model_dir=model_dir,
                             strategy=strategy, param_rules=param_rules,
@@ -240,7 +254,8 @@ class JaxEstimator:
 
     def __init__(self, adapter: FlaxModelAdapter, loss, optimizer,
                  metrics=None, model_dir: Optional[str] = None,
-                 strategy="dp", param_rules=None, seed: int = 0):
+                 strategy="dp", param_rules=None, seed: int = 0,
+                 aux_loss_weight: float = 0.01):
         import jax
 
         self.adapter = adapter
@@ -250,6 +265,9 @@ class JaxEstimator:
         self.model_dir = model_dir
         self.strategy = ShardingStrategy.parse(strategy, param_rules=param_rules)
         self.seed = seed
+        # weight on sown "aux_loss" values (MoE load balancing; Switch
+        # Transformer uses 0.01) — added to the data loss in the train step
+        self.aux_loss_weight = float(aux_loss_weight)
         self.failure_retry_times = 5  # ref Topology.scala:1256 bigdl.failure.retryTimes
 
         self._grad_clip = None  # ("norm", v) | ("const", min, max)
@@ -414,6 +432,7 @@ class JaxEstimator:
         self._init_state()
         tx = self._tx()
         adapter, loss_fn, base_rng = self.adapter, self.loss_fn, self._base_rng
+        aux_weight = self.aux_loss_weight
 
         def step_fn(state, x, y):
             rng = jax.random.fold_in(base_rng, state["step"])
@@ -422,7 +441,18 @@ class JaxEstimator:
                 preds, new_mut = adapter.apply(params, state["model_state"],
                                                x, True, rng)
                 per = loss_fn(y, preds)
-                return per.mean(), new_mut
+                loss = per.mean()
+                # consume sown per-step losses (MoE load balance): they add
+                # to the objective and are stripped so model_state keeps its
+                # across-step structure
+                if isinstance(new_mut, dict) and "aux_loss" in new_mut:
+                    new_mut = dict(new_mut)
+                    aux = new_mut.pop("aux_loss")
+                    aux_terms = [jnp.sum(jnp.asarray(leaf))
+                                 for leaf in jax.tree_util.tree_leaves(aux)]
+                    if aux_terms:
+                        loss = loss + aux_weight * sum(aux_terms)
+                return loss, new_mut
 
             (loss_val, new_mut), grads = jax.value_and_grad(
                 compute_loss, has_aux=True)(state["params"])

@@ -99,17 +99,27 @@ class _ModuleRule:
             return p, {}, lambda pr, x: _conv_general(
                 x, pr["kernel"], pr.get("bias"), stride, padding, dims)
         if isinstance(mod, (tnn.BatchNorm1d, tnn.BatchNorm2d)):
-            # inference-mode normalization with frozen running statistics
-            # (fine-tuning keeps them fixed, like torch eval-mode finetune)
+            # train-mode forward normalizes by BATCH statistics (matching
+            # torch .train() semantics for loss/gradients); eval uses the
+            # translated running statistics, which stay frozen — there is no
+            # running-stat update on the jax side (warned in torch_to_jax)
             p = {"scale": _np(mod.weight), "bias": _np(mod.bias)}
             buf = {"mean": _np(mod.running_mean), "var": _np(mod.running_var)}
             eps = mod.eps
 
             def bn(pr, x):
                 shape = (1, -1) + (1,) * (x.ndim - 2)
-                inv = jax.lax.rsqrt(pr["var"].reshape(shape) + eps)
-                return (x - pr["mean"].reshape(shape)) * inv \
-                    * pr["scale"].reshape(shape) + pr["bias"].reshape(shape)
+                if pr.get("__train__", False):
+                    axes = (0,) + tuple(range(2, x.ndim))
+                    mean = x.mean(axes).reshape(shape)
+                    var = ((x - mean) ** 2).mean(axes).reshape(shape)
+                else:
+                    mean = pr["mean"].reshape(shape)
+                    var = pr["var"].reshape(shape)
+                inv = jax.lax.rsqrt(var + eps)
+                return (x - mean) * inv * pr["scale"].reshape(shape) \
+                    + pr["bias"].reshape(shape)
+            bn._needs_ctx = True
             return p, buf, bn
         if isinstance(mod, tnn.LayerNorm):
             p = {"scale": _np(mod.weight), "bias": _np(mod.bias)}
@@ -124,8 +134,28 @@ class _ModuleRule:
         if isinstance(mod, tnn.Embedding):
             p = {"embedding": _np(mod.weight)}
             return p, {}, lambda pr, x: pr["embedding"][x.astype(jnp.int32)]
-        if isinstance(mod, (tnn.Dropout, tnn.Identity)):
-            return {}, {}, lambda pr, x: x  # inference/translated mode
+        if isinstance(mod, tnn.Identity):
+            return {}, {}, lambda pr, x: x
+        if isinstance(mod, tnn.Dropout):
+            rate = float(mod.p)
+            if rate <= 0.0:
+                return {}, {}, lambda pr, x: x
+
+            def do(pr, x):
+                # real inverted dropout in train mode; identity at eval.
+                # __train__ is a static python bool, __rng__ a traced key
+                # injected per-instance by apply_fn.
+                if not pr.get("__train__", False):
+                    return x
+                if pr.get("__rng__") is None:
+                    raise ValueError(
+                        "train-mode dropout needs an rng; pass rng= to "
+                        "apply_fn (Estimator.from_torch does this)")
+                keep = 1.0 - rate
+                mask = jax.random.bernoulli(pr["__rng__"], keep, x.shape)
+                return jnp.where(mask, x / keep, jnp.zeros_like(x))
+            do._needs_ctx = True
+            return {}, {}, do
         if isinstance(mod, tnn.Flatten):
             start = mod.start_dim
             return {}, {}, lambda pr, x: x.reshape(x.shape[:start] + (-1,))
@@ -177,9 +207,11 @@ class _ModuleRule:
 def torch_to_jax(module) -> Tuple[Callable, Dict[str, Any]]:
     """Translate ``module`` (torch.nn.Module) →
     ``(apply_fn, {"params": ..., "buffers": ...})`` where
-    ``apply_fn(variables, *inputs)`` is a pure jax function. ``params`` are
-    the trainable leaves; ``buffers`` (BN running stats, plain-tensor
-    attributes) are frozen state. Uses torch.fx symbolic tracing, so
+    ``apply_fn(variables, *inputs, train=False, rng=None)`` is a pure jax
+    function. ``params`` are the trainable leaves; ``buffers`` (BN running
+    stats, plain-tensor attributes) are frozen state. With ``train=True``
+    dropout applies for real (inverted, needs ``rng``) and BatchNorm
+    normalizes by batch statistics. Uses torch.fx symbolic tracing, so
     data-dependent Python control flow in the module is rejected by fx
     itself — the same restriction XLA imposes. All torch-side tensors are
     copied out during translation; nothing retains the torch module."""
@@ -196,9 +228,17 @@ def torch_to_jax(module) -> Tuple[Callable, Dict[str, Any]]:
     params: Dict[str, Any] = {}
     buffers: Dict[str, Any] = {}
     fns: Dict[str, Callable] = {}
+    # graph NODE name -> rng index: keyed per call site, not per module, so
+    # a Dropout instance reused at two places in forward() draws two
+    # independent masks (matching torch's fresh randomness per call)
+    ctx_nodes: Dict[str, int] = {}
+    has_bn = False
     for node in graph_module.graph.nodes:
         if node.op == "call_module":
-            p, buf, fn = _ModuleRule.translate(modules[node.target])
+            mod = modules[node.target]
+            has_bn = has_bn or isinstance(
+                mod, (torch.nn.BatchNorm1d, torch.nn.BatchNorm2d))
+            p, buf, fn = _ModuleRule.translate(mod)
             # dots, not slashes: estimator param paths join dict keys with
             # "/" so a slash inside one key would split the path
             key = node.target
@@ -206,6 +246,8 @@ def torch_to_jax(module) -> Tuple[Callable, Dict[str, Any]]:
                 params[key] = p
             if buf:
                 buffers[key] = buf
+            if getattr(fn, "_needs_ctx", False):
+                ctx_nodes[node.name] = len(ctx_nodes)
             fns[node.name] = (key, fn)
         elif node.op == "get_attr":
             # nn.Parameter used directly in forward → trainable; any other
@@ -295,7 +337,15 @@ def torch_to_jax(module) -> Tuple[Callable, Dict[str, Any]]:
                 f"torch method .{target}() has no TPU translation")
     del graph_module, modules
 
-    def apply_fn(variables, *inputs):
+    if has_bn:
+        import logging
+        logging.getLogger(__name__).warning(
+            "translated BatchNorm: train-mode forward uses batch statistics "
+            "(torch .train() semantics) but running statistics stay frozen "
+            "at their translated values — eval-mode normalization will not "
+            "track training-data drift")
+
+    def apply_fn(variables, *inputs, train=False, rng=None):
         prms = dict(variables.get("params", {}))
         for k, v in variables.get("buffers", {}).items():
             if k in prms and isinstance(prms[k], dict):
@@ -322,8 +372,13 @@ def torch_to_jax(module) -> Tuple[Callable, Dict[str, Any]]:
                 env[name] = jnp.asarray(prms[key])
             elif op == "call_module":
                 key, fn = fns[name]
-                env[name] = fn(prms.get(key, {}),
-                               *[lookup(a) for a in args])
+                pr = prms.get(key, {})
+                if name in ctx_nodes:
+                    pr = dict(pr) if isinstance(pr, dict) else {}
+                    pr["__train__"] = bool(train)
+                    pr["__rng__"] = None if rng is None else \
+                        jax.random.fold_in(rng, ctx_nodes[name])
+                env[name] = fn(pr, *[lookup(a) for a in args])
             elif op == "call_function":
                 env[name] = _FN_MAP[target](
                     *[lookup(a) for a in args],

@@ -119,6 +119,18 @@ class BrokerClient:
             out.append((int(i), payload))
         return out
 
+    def xclaim(self, stream: str, group: str, consumer: str,
+               min_idle_ms: int, count: int) -> List[Tuple[int, str]]:
+        """Re-deliver pending entries idle >= min_idle_ms (dead-consumer
+        recovery; Redis XAUTOCLAIM analog)."""
+        lines = self._cmd("XCLAIM", stream, group, consumer,
+                          str(min_idle_ms), str(count))
+        out = []
+        for ln in lines:
+            i, payload = ln.split(" ", 1)
+            out.append((int(i), payload))
+        return out
+
     def xack(self, stream: str, group: str, entry_id: int) -> int:
         return self._cmd("XACK", stream, group, str(entry_id))
 
@@ -166,7 +178,8 @@ class _PyState:
             name, {"entries": [], "next_id": 1, "groups": {}})
 
     def group(self, st, name):
-        return st["groups"].setdefault(name, {"cursor": 0, "pending": set()})
+        # pending: entry id -> last delivery time (ms), for XCLAIM idle checks
+        return st["groups"].setdefault(name, {"cursor": 0, "pending": {}})
 
 
 class _PyHandler(socketserver.StreamRequestHandler):
@@ -209,12 +222,13 @@ class _PyHandler(socketserver.StreamRequestHandler):
                     st = state.stream(stream)
                     gr = state.group(st, group)
                     got = []
+                    now_ms = int(time.time() * 1000)
                     for eid, payload in st["entries"]:
                         if eid <= gr["cursor"]:
                             continue
                         got.append((eid, payload))
                         gr["cursor"] = eid
-                        gr["pending"].add(eid)
+                        gr["pending"][eid] = now_ms
                         if len(got) >= count:
                             break
                     return got
@@ -235,8 +249,8 @@ class _PyHandler(socketserver.StreamRequestHandler):
                 with state.lock:
                     st = state.stream(p[1])
                     gr = state.group(st, p[2])
-                    n = 1 if int(p[3]) in gr["pending"] else 0
-                    gr["pending"].discard(int(p[3]))
+                    n = 1 if gr["pending"].pop(int(p[3]), None) is not None \
+                        else 0
                     # GC entries delivered+acked by every group (see
                     # zbroker.cpp XACK)
                     if st["groups"]:
@@ -253,6 +267,27 @@ class _PyHandler(socketserver.StreamRequestHandler):
                         if drop:
                             st["entries"] = entries[drop:]
                 w.write(f":{n}\n".encode())
+            elif cmd == "XCLAIM" and len(p) >= 6:
+                # XCLAIM <stream> <group> <consumer> <min_idle_ms> <count>:
+                # re-deliver pending entries idle >= min_idle_ms (the
+                # recovery path for entries a dead consumer never acked —
+                # Redis XAUTOCLAIM analog). Claiming refreshes idle time.
+                min_idle, cnt = int(p[4]), int(p[5])
+                with state.lock:
+                    st = state.stream(p[1])
+                    gr = state.group(st, p[2])
+                    now_ms = int(time.time() * 1000)
+                    ids = sorted(eid for eid, ts in gr["pending"].items()
+                                 if now_ms - ts >= min_idle)[:cnt]
+                    payloads = dict(st["entries"])
+                    got = []
+                    for eid in ids:
+                        if eid in payloads:
+                            gr["pending"][eid] = now_ms
+                            got.append((eid, payloads[eid]))
+                out = [f"*{len(got)}\n"]
+                out += [f"{eid} {payload}\n" for eid, payload in got]
+                w.write("".join(out).encode())
             elif cmd == "XPENDING" and len(p) >= 3:
                 with state.lock:
                     gr = state.group(state.stream(p[1]), p[2])

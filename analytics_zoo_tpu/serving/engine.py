@@ -66,7 +66,8 @@ class ClusterServing:
                  group: str = "serving", consumer: str = "c0",
                  input_cols: Optional[List[str]] = None,
                  cipher: schema.Cipher = None,
-                 postprocess=None, block_ms: int = 50):
+                 postprocess=None, block_ms: int = 50,
+                 claim_min_idle_ms: int = 30000):
         self.model = model
         self.batch_size = int(batch_size)
         self.broker_port = broker_port
@@ -76,6 +77,11 @@ class ClusterServing:
         self.cipher = cipher
         self.postprocess = postprocess
         self.block_ms = block_ms
+        self.claim_min_idle_ms = int(claim_min_idle_ms)
+        # claim at most ~1/s — recovery is a rare path, the hot read loop
+        # must not pay a broker round-trip per poll
+        self._claim_interval_s = max(0.5, self.claim_min_idle_ms / 2000.0)
+        self._last_claim = 0.0
         self.timer = StageTimer()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -84,8 +90,20 @@ class ClusterServing:
     # --------------------------------------------------------------- loop
     def _serve_once(self, client: BrokerClient) -> int:
         t0 = time.time()
-        entries = client.xreadgroup(self.group, self.consumer, self.stream,
-                                    self.batch_size, self.block_ms)
+        # recover entries a dead/crashed consumer never acked (ref: the
+        # Redis-streams recovery path the reference LACKS an analog of —
+        # XPENDING counts them but they were lost forever; here XCLAIM
+        # re-delivers once they have been idle claim_min_idle_ms).
+        # Rate-limited: recovery polling must not tax the hot read loop.
+        entries = []
+        if time.time() - self._last_claim >= self._claim_interval_s:
+            self._last_claim = time.time()
+            entries = client.xclaim(self.stream, self.group, self.consumer,
+                                    self.claim_min_idle_ms, self.batch_size)
+        if not entries:
+            entries = client.xreadgroup(self.group, self.consumer,
+                                        self.stream, self.batch_size,
+                                        self.block_ms)
         if not entries:
             return 0
         self.timer.record("dequeue", time.time() - t0)

@@ -13,6 +13,8 @@
 //   XREADGROUP <group> <consumer> <stream> <count> <block_ms>
 //                                               -> *<n> then n lines "<id> <b64>"
 //   XACK <stream> <group> <id>                  -> :<n-acked>
+//   XCLAIM <stream> <group> <consumer> <min_idle_ms> <count>
+//                                               -> *<n> then n lines "<id> <b64>"
 //   XPENDING <stream> <group>                   -> :<n-pending>
 //   HSET <key> <field> <b64>                    -> +OK
 //   HGET <key> <field>                          -> $<b64> | $-1
@@ -35,6 +37,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <chrono>
 #include <condition_variable>
 #include <cstdio>
 #include <cstring>
@@ -55,8 +58,16 @@ struct Entry {
 
 struct Group {
   long long cursor = 0;                 // last delivered id
-  std::set<long long> pending;          // delivered, not yet acked
+  // delivered-not-acked: id -> last delivery time (ms since epoch), so
+  // XCLAIM can re-deliver entries whose consumer died (idle too long)
+  std::map<long long, long long> pending;
 };
+
+long long NowMs() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
 
 struct Stream {
   std::vector<Entry> entries;
@@ -152,11 +163,12 @@ void HandleConn(int fd) {
         auto deliver = [&]() {
           Stream& st = g_streams[stream];
           Group& gr = st.groups[group];
+          long long now_ms = NowMs();
           for (const Entry& e : st.entries) {
             if (e.id <= gr.cursor) continue;
             got.push_back(e);
             gr.cursor = e.id;
-            gr.pending.insert(e.id);
+            gr.pending[e.id] = now_ms;
             if (static_cast<int>(got.size()) >= count) break;
           }
           return !got.empty();
@@ -185,7 +197,7 @@ void HandleConn(int fd) {
           for (auto& kv : st.groups) {
             long long bound = kv.second.cursor;
             if (!kv.second.pending.empty())
-              bound = std::min(bound, *kv.second.pending.begin() - 1);
+              bound = std::min(bound, kv.second.pending.begin()->first - 1);
             low = std::min(low, bound);
           }
           size_t drop = 0;
@@ -196,6 +208,39 @@ void HandleConn(int fd) {
         }
       }
       SendAll(fd, ":" + std::to_string(n) + "\n");
+    } else if (cmd == "XCLAIM" && p.size() >= 6) {
+      // XCLAIM <stream> <group> <consumer> <min_idle_ms> <count>:
+      // re-deliver pending entries idle >= min_idle_ms (recovery of
+      // entries whose consumer died before XACK — Redis XAUTOCLAIM
+      // analog). Claiming refreshes the idle clock.
+      long long min_idle = atoll(p[4].c_str());
+      int count = atoi(p[5].c_str());
+      std::vector<Entry> got;
+      {
+        std::lock_guard<std::mutex> lk(g_mu);
+        Stream& st = g_streams[p[1]];
+        Group& gr = st.groups[p[2]];
+        long long now_ms = NowMs();
+        if (!gr.pending.empty()) {
+          // one id->payload index per call, not an O(entries) scan per
+          // pending id (the engine polls XCLAIM; backlog must stay cheap)
+          std::map<long long, const Entry*> index;
+          for (const Entry& e : st.entries) index[e.id] = &e;
+          for (auto& kv : gr.pending) {
+            if (static_cast<int>(got.size()) >= count) break;
+            if (now_ms - kv.second < min_idle) continue;
+            auto it = index.find(kv.first);
+            if (it != index.end()) {
+              got.push_back(*it->second);
+              kv.second = now_ms;
+            }
+          }
+        }
+      }
+      std::ostringstream os;
+      os << "*" << got.size() << "\n";
+      for (const Entry& e : got) os << e.id << " " << e.payload << "\n";
+      SendAll(fd, os.str());
     } else if (cmd == "XPENDING" && p.size() >= 3) {
       std::lock_guard<std::mutex> lk(g_mu);
       Group& gr = g_streams[p[1]].groups[p[2]];

@@ -125,6 +125,56 @@ def test_checkpoint_resume(orca_ctx, tmp_path):
         np.testing.assert_allclose(a, b, rtol=1e-6)
 
 
+def test_retry_from_snapshot_on_injected_failure(orca_ctx, tmp_path):
+    """Fault injection for the elastic retry loop (ref Topology.scala:
+    1255-1337): a failing train step must trigger reload of the latest
+    snapshot and training must complete from there."""
+    from analytics_zoo_tpu.learn.estimator import Estimator
+    x, y = _reg_data()
+    mdir = str(tmp_path / "ck")
+    est = Estimator.from_flax(model=MLP(), loss="mse", sample_input=x[:2],
+                              model_dir=mdir)
+    est.fit((x, y), epochs=1, batch_size=32)  # EveryEpoch snapshot exists
+    step_at_ckpt = est._py_step
+
+    real_step = est._train_step
+    calls = {"failures": 0}
+
+    def bomb(state, bx, by):
+        if calls["failures"] == 0:
+            calls["failures"] += 1
+            raise RuntimeError("injected chip failure")
+        return real_step(state, bx, by)
+
+    est._train_step = bomb
+    h = est.fit((x, y), epochs=2, batch_size=32)
+    assert calls["failures"] == 1
+    assert len(h["loss"]) == 2 and all(np.isfinite(h["loss"]))
+    # resumed from the snapshot, then ran 2 full epochs
+    assert est._py_step == step_at_ckpt + 2 * (len(x) // 32)
+    assert est._epoch == 3
+
+
+def test_retry_gives_up_after_budget(orca_ctx, tmp_path):
+    from analytics_zoo_tpu.learn.estimator import Estimator
+    x, y = _reg_data()
+    mdir = str(tmp_path / "ck")
+    est = Estimator.from_flax(model=MLP(), loss="mse", sample_input=x[:2],
+                              model_dir=mdir)
+    est.fit((x, y), epochs=1, batch_size=32)
+    est.failure_retry_times = 2
+    calls = {"failures": 0}
+
+    def always_bomb(state, bx, by):
+        calls["failures"] += 1
+        raise RuntimeError("persistent failure")
+
+    est._train_step = always_bomb
+    with pytest.raises(RuntimeError, match="persistent failure"):
+        est.fit((x, y), epochs=1, batch_size=32)
+    assert calls["failures"] == est.failure_retry_times + 1
+
+
 def test_gradient_clipping(orca_ctx):
     from analytics_zoo_tpu.learn.estimator import Estimator
     x, y = _reg_data(n=64)

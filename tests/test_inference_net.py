@@ -127,6 +127,67 @@ class TestTorchTranslation:
         trained = jax.device_get(est._state["params"]["attr.w"])
         assert np.abs(trained).max() > 0, "direct nn.Parameter never trained"
 
+    def test_dropout_is_real_in_train_mode(self, orca_ctx):
+        """Regression: Dropout used to silently translate to identity in
+        training too."""
+        import jax
+        torch.manual_seed(7)
+        m = tnn.Sequential(tnn.Linear(4, 32), tnn.Dropout(0.5),
+                           tnn.Linear(32, 2))
+        apply_fn, variables = torch_to_jax(m)
+        x = np.random.RandomState(7).randn(16, 4).astype(np.float32)
+        # eval mode: identity, matches torch eval forward
+        want = m.eval()(torch.from_numpy(x)).detach().numpy()
+        np.testing.assert_allclose(
+            np.asarray(apply_fn(variables, x)), want, atol=1e-5)
+        # train mode without rng is an explicit error, not silent identity
+        with pytest.raises(ValueError, match="dropout needs an rng"):
+            apply_fn(variables, x, train=True)
+        # train mode drops: two rngs give different outputs, both != eval
+        r1 = np.asarray(apply_fn(variables, x, train=True,
+                                 rng=jax.random.PRNGKey(0)))
+        r2 = np.asarray(apply_fn(variables, x, train=True,
+                                 rng=jax.random.PRNGKey(1)))
+        assert not np.allclose(r1, r2)
+        assert not np.allclose(r1, want)
+
+    def test_dropout_trains_through_estimator(self, orca_ctx):
+        from analytics_zoo_tpu.learn.estimator import Estimator
+        torch.manual_seed(8)
+        m = tnn.Sequential(tnn.Linear(4, 16), tnn.ReLU(), tnn.Dropout(0.3),
+                           tnn.Linear(16, 2))
+        rng = np.random.RandomState(8)
+        x = rng.randn(64, 4).astype(np.float32)
+        y = (x.sum(1) > 0).astype(np.int32)
+        est = Estimator.from_torch(
+            model=m, loss="sparse_categorical_crossentropy_logits",
+            optimizer="adam", sample_input=x[:2])
+        h1 = est.fit((x, y), epochs=1, batch_size=16)
+        h8 = est.fit((x, y), epochs=8, batch_size=16)
+        assert h8["loss"][-1] < h1["loss"][0]
+
+    def test_bn_train_mode_uses_batch_stats(self, orca_ctx):
+        """Train-mode BN must normalize by batch statistics (torch .train()
+        semantics), not the frozen running stats."""
+        torch.manual_seed(9)
+        m = tnn.Sequential(tnn.Linear(4, 8), tnn.BatchNorm1d(8))
+        # make running stats very different from any batch's stats
+        with torch.no_grad():
+            m[1].running_mean.fill_(5.0)
+            m[1].running_var.fill_(25.0)
+        m.eval()
+        apply_fn, variables = torch_to_jax(m)
+        x = np.random.RandomState(9).randn(32, 4).astype(np.float32)
+        want_train = m.train()(torch.from_numpy(x)).detach().numpy()
+        got_train = np.asarray(apply_fn(variables, x, train=True))
+        np.testing.assert_allclose(got_train, want_train, atol=1e-4)
+        # eval still uses the translated (frozen) running stats
+        want_eval_mean = 5.0
+        got_eval = np.asarray(apply_fn(variables, x))
+        assert not np.allclose(got_eval, got_train)
+        assert np.allclose(np.asarray(variables["buffers"]["1"]["mean"]),
+                           want_eval_mean)
+
     def test_estimator_from_torch_trains(self, orca_ctx):
         from analytics_zoo_tpu.learn.estimator import Estimator
         torch.manual_seed(3)
@@ -189,6 +250,32 @@ class TestInferenceModel:
         np.testing.assert_allclose(got2, want, atol=1e-5)
         cls = im.predict_classes(x)
         assert cls.shape == (10,) and cls.max() < 3
+
+    def test_load_zoo_snapshots_params(self, orca_ctx):
+        """Regression: load_zoo used to alias the estimator's live state;
+        the train step donates that state, so a later fit() invalidated the
+        inference model's buffers on TPU. The copy must be a distinct buffer
+        and predictions must not change when the source model trains on."""
+        import jax
+        from analytics_zoo_tpu.models import TextClassifier
+        m = TextClassifier(class_num=2, vocab_size=30, token_length=8,
+                           sequence_length=12, encoder="cnn",
+                           encoder_output_dim=16)
+        rng = np.random.RandomState(4)
+        x = rng.randint(1, 31, (16, 12)).astype(np.float32)
+        y = rng.randint(0, 2, 16)
+        m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+        m.fit(x, y, batch_size=8, nb_epoch=1)
+        im = InferenceModel().load_zoo(m)
+        est = m.model.estimator
+        # distinct buffers, not aliases of the (donatable) train state
+        im_leaves = jax.tree_util.tree_leaves(im._params["params"])
+        est_leaves = jax.tree_util.tree_leaves(est._state["params"])
+        assert all(a is not b for a, b in zip(im_leaves, est_leaves))
+        before = im.predict(x)
+        m.fit(x, y, batch_size=8, nb_epoch=2)  # donates + replaces est state
+        after = im.predict(x)
+        np.testing.assert_allclose(after, before, atol=1e-6)
 
     def test_load_torch(self):
         m = _mlp()

@@ -62,6 +62,76 @@ class TestMoEModule:
         assert np.abs(np.asarray(g["gate"])).max() > 0
         assert np.abs(np.asarray(g["w1"])).max() > 0
 
+    def test_aux_loss_consumed_by_train_step(self, orca_ctx):
+        """Regression: the sown load-balance loss used to be dropped — MoE
+        trained with zero balancing. The reported loss must include the
+        weighted aux term, and model_state must not accumulate it."""
+        import flax.linen as nn
+        from analytics_zoo_tpu.learn.estimator import Estimator
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x, train: bool = False):
+                h = MoEModule(n_experts=4, d_model=8, d_hidden=16,
+                              name="moe")(x, train=train)
+                return nn.Dense(2)(h)
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(64, 8).astype(np.float32)
+        y = (x.sum(1) > 0).astype(np.int32)
+
+        def run(aux_w):
+            est = Estimator.from_flax(
+                model=Net(), loss="sparse_categorical_crossentropy_logits",
+                optimizer="sgd", sample_input=x[:2], seed=0,
+                aux_loss_weight=aux_w)
+            h = est.fit((x, y), epochs=2, batch_size=32, shuffle=False)
+            return est, h
+
+        est0, h0 = run(0.0)
+        est1, h1 = run(1.0)
+        # aux term is positive → the optimized objective differs
+        assert h1["loss"][0] > h0["loss"][0]
+        # aux_loss never leaks into persistent state (sow would grow it
+        # every step otherwise)
+        assert "aux_loss" not in est1._state["model_state"]
+        assert "aux_loss" not in est1.adapter.model_state
+        # with weight, gate gradients include the balance signal → gate
+        # params diverge from the aux-free run
+        g0 = np.asarray(est0._state["params"]["moe"]["gate"])
+        g1 = np.asarray(est1._state["params"]["moe"]["gate"])
+        assert not np.allclose(g0, g1)
+
+    def test_ep_train_step_emits_all_to_all(self, orca_ctx):
+        """The expert-sharded einsums must lower to cross-device collectives
+        (all-to-all resharding tokens batch→expert layout) over the mesh."""
+        import flax.linen as nn
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from analytics_zoo_tpu.learn.estimator import Estimator
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x, train: bool = False):
+                h = MoEModule(n_experts=8, d_model=8, d_hidden=16,
+                              name="moe")(x, train=train)
+                return nn.Dense(2)(h)
+
+        rng = np.random.RandomState(2)
+        x = rng.randn(32, 8).astype(np.float32)
+        y = (x.sum(1) > 0).astype(np.int32)
+        est = Estimator.from_flax(
+            model=Net(), loss="sparse_categorical_crossentropy_logits",
+            optimizer="adam", sample_input=x[:2],
+            strategy="dp2,ep4", param_rules=ep_param_rules())
+        est._build_train_step()
+        mesh = est._ensure_mesh()
+        xs = jax.device_put(x, NamedSharding(mesh, P("data")))
+        ys = jax.device_put(y, NamedSharding(mesh, P("data")))
+        hlo = est._train_step.lower(est._state, xs, ys).compile().as_text()
+        assert ("all-to-all" in hlo) or ("all-gather" in hlo), \
+            "no cross-device collective for the expert dimension"
+
     def test_expert_parallel_training(self, orca_ctx):
         """End-to-end ep training: expert weights sharded over 'expert'."""
         import flax.linen as nn

@@ -191,6 +191,41 @@ class TestResilience:
             in_q.enqueue("after", x=np.ones(4, np.float32))
             assert out_q.query("after", timeout=20.0) is not None
 
+    def test_xclaim_redelivers_dead_consumer_pending(self, broker):
+        """Entries delivered to a consumer that dies before XACK must be
+        claimable by another consumer (regression: they used to be lost
+        forever while XPENDING still counted them)."""
+        c = broker.client()
+        for _ in range(3):
+            c.xadd("s", "ZA==")
+        got = c.xreadgroup("g", "c0", "s", 3)   # c0 takes them... and dies
+        assert len(got) == 3
+        assert c.xpending("s", "g") == 3
+        assert c.xreadgroup("g", "c1", "s", 3) == []  # cursor is past them
+        # not yet idle long enough → nothing claimable
+        assert c.xclaim("s", "g", "c1", 60000, 10) == []
+        claimed = c.xclaim("s", "g", "c1", 0, 10)
+        assert [e[0] for e in claimed] == [e[0] for e in got]
+        assert claimed[0][1] == "ZA=="
+        for eid, _ in claimed:
+            c.xack("s", "g", eid)
+        assert c.xpending("s", "g") == 0
+        assert c.xlen("s") == 0  # fully acked → GC'd
+
+    def test_engine_recovers_orphaned_pending(self, broker):
+        """A record delivered to a crashed consumer is re-processed by a
+        restarted engine via XCLAIM."""
+        im, _ = _make_model()
+        in_q = InputQueue(port=broker.port)
+        in_q.enqueue("orphan", x=np.zeros(4, np.float32))
+        ghost = broker.client().xreadgroup("serving", "dead",
+                                           "serving_stream", 10)
+        assert len(ghost) == 1  # delivered to "dead", never acked
+        with ClusterServing(im, broker.port, batch_size=2,
+                            claim_min_idle_ms=0).start():
+            out_q = OutputQueue(port=broker.port)
+            assert out_q.query("orphan", timeout=20.0) is not None
+
     def test_broker_gc_trims_acked_entries(self, broker):
         c = broker.client()
         for i in range(10):
