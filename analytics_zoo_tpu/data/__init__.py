@@ -1,2 +1,4 @@
 from analytics_zoo_tpu.data.shard import XShards, HostXShards, SharedValue  # noqa: F401
-from analytics_zoo_tpu.data.dataset import ShardedDataset  # noqa: F401
+from analytics_zoo_tpu.data.dataset import (  # noqa: F401
+    ShardedDataset, StreamingShardedDataset,
+)

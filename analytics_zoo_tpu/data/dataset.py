@@ -40,6 +40,35 @@ def _tree_len(data):
     return len(jax.tree_util.tree_leaves(data)[0])
 
 
+def _shards_to_xy(data, feature_cols=None, label_cols=None):
+    """A list of shards → one (x, y) pytree pair. Shards are Orca-style
+    ``{"x":..., "y":...}`` numpy dicts or pandas DataFrames (then
+    feature/label column names select and stack columns)."""
+    first = data[0]
+    if isinstance(first, dict) and "x" in first:
+        x = _tree_concat([d["x"] for d in data])
+        y = _tree_concat([d["y"] for d in data]) \
+            if "y" in first and first["y"] is not None else None
+        return x, y
+    import pandas as pd
+    assert isinstance(first, pd.DataFrame), \
+        f"unsupported shard type {type(first)}"
+    assert feature_cols, "feature_cols required for DataFrame shards"
+    big = pd.concat(data, ignore_index=True)
+
+    def cols_to_tree(cols):
+        if isinstance(cols, str):
+            cols = [cols]
+        arrs = [np.asarray(np.stack(big[c].to_numpy())
+                           if big[c].dtype == object else big[c].to_numpy())
+                for c in cols]
+        return arrs[0] if len(arrs) == 1 else tuple(arrs)
+
+    x = cols_to_tree(feature_cols)
+    y = cols_to_tree(label_cols) if label_cols else None
+    return x, y
+
+
 class ShardedDataset:
     """Host-resident columnar dataset with deterministic sharded batching.
 
@@ -65,30 +94,13 @@ class ShardedDataset:
         """From XShards of ``{"x":..., "y":...}`` numpy dicts (the Orca
         convention, ref pyzoo/zoo/orca/learn/utils.py) or of pandas
         DataFrames + feature/label column names (ref
-        orca/learn/tf/estimator.py:373-426 to_dataset)."""
+        orca/learn/tf/estimator.py:373-426 to_dataset). Materializes all
+        shards — use ``StreamingShardedDataset`` (picked automatically by
+        ``to_sharded_dataset`` for non-DRAM tiers) to keep the tier's
+        residency bound during training."""
         data = shards.collect()
         assert data, "empty XShards"
-        first = data[0]
-        if isinstance(first, dict) and "x" in first:
-            x = _tree_concat([d["x"] for d in data])
-            y = _tree_concat([d["y"] for d in data]) if "y" in first and first["y"] is not None else None
-            return cls(x, y)
-        # pandas path
-        import pandas as pd
-        assert isinstance(first, pd.DataFrame), f"unsupported shard type {type(first)}"
-        assert feature_cols, "feature_cols required for DataFrame shards"
-        big = pd.concat(data, ignore_index=True)
-
-        def cols_to_tree(cols):
-            if isinstance(cols, str):
-                cols = [cols]
-            arrs = [np.asarray(np.stack(big[c].to_numpy())
-                               if big[c].dtype == object else big[c].to_numpy())
-                    for c in cols]
-            return arrs[0] if len(arrs) == 1 else tuple(arrs)
-
-        x = cols_to_tree(feature_cols)
-        y = cols_to_tree(label_cols) if label_cols else None
+        x, y = _shards_to_xy(data, feature_cols, label_cols)
         return cls(x, y)
 
     # ---- transforms ----
@@ -260,6 +272,125 @@ class ShardedDataset:
             yield prev
 
 
+class StreamingShardedDataset(ShardedDataset):
+    """Out-of-core minibatch feed over a tiered shard store — the training
+    analog of the reference's ``DiskFeatureSet`` (FeatureSet.scala:556:
+    train directly from a cache keeping 1/n of the data resident).
+
+    Where ``from_xshards`` collects every shard (un-bounding the DISK_n /
+    NATIVE_n residency window the instant training starts), this streams:
+    shards are gathered window-by-window from the store, each window is
+    shuffled and cut into fixed-shape batches, leftover rows carry into the
+    next window so every batch stays full, and the next window loads on a
+    background thread while the current one feeds the device (on top of the
+    native store's own shard prefetch). Peak host residency ≈ one window +
+    one carry, never the whole dataset (tracked in ``peak_window_rows``).
+    """
+
+    def __init__(self, shards: XShards, feature_cols=None, label_cols=None,
+                 window_shards: Optional[int] = None):
+        self._xshards = shards
+        self._fc, self._lc = feature_cols, label_cols
+        # one sequential pass for per-shard row counts (the store's
+        # prefetcher makes this a streaming scan, not a materialization)
+        self._lens = []
+        for s in shards._iter_shards():
+            x, _ = _shards_to_xy([s], feature_cols, label_cols)
+            self._lens.append(_tree_len(x))
+        self.n = sum(self._lens)
+        self.x = None  # rows never materialize on this object
+        self.y = None
+        if window_shards is None:
+            tier = getattr(shards, "tier", "DRAM")
+            denom = max(1, int(tier.split("_", 1)[1])) if "_" in tier else 1
+            window_shards = max(1, math.ceil(shards.num_partitions() / denom))
+        self.window_shards = int(window_shards)
+        self.peak_window_rows = 0
+
+    # materialize only for the explicit whole-dataset transforms
+    def _materialize(self) -> ShardedDataset:
+        x, y = _shards_to_xy(self._xshards.collect(), self._fc, self._lc)
+        return ShardedDataset(x, y)
+
+    def map(self, fn: Callable) -> ShardedDataset:
+        return self._materialize().map(fn)
+
+    def take(self, n: int) -> ShardedDataset:
+        return self._materialize().take(n)
+
+    def split(self, fraction: float, seed: int = 0):
+        return self._materialize().split(fraction, seed)
+
+    def iter_batches(self, batch_size: int, shuffle: bool = False,
+                     seed: int = 0, epoch: int = 0,
+                     drop_remainder: bool = True
+                     ) -> Iterator[Tuple[Any, Any, Optional[np.ndarray]]]:
+        import jax
+        from concurrent.futures import ThreadPoolExecutor
+
+        per_host = batch_size
+        if jax.process_count() > 1:
+            assert batch_size % jax.process_count() == 0, \
+                "global batch must divide over processes"
+            per_host = batch_size // jax.process_count()
+        if per_host > self.n and drop_remainder:
+            raise ValueError(f"batch_size {per_host} > dataset size {self.n} "
+                             "(with drop_remainder=True no batch can be "
+                             "formed)")
+
+        n_shards = self._xshards.num_partitions()
+        rng = np.random.default_rng((seed * 100003 + epoch) & 0x7FFFFFFF)
+        shard_order = rng.permutation(n_shards) if shuffle \
+            else np.arange(n_shards)
+        windows = [shard_order[i:i + self.window_shards]
+                   for i in range(0, n_shards, self.window_shards)]
+        store = self._xshards._store
+
+        def load_window(ids):
+            data = [store.get(int(i)) for i in ids]
+            return _shards_to_xy(data, self._fc, self._lc)
+
+        def concat(a, b):
+            return jax.tree_util.tree_map(
+                lambda u, v: np.concatenate([u, v]), a, b)
+
+        carry_x = carry_y = None
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            pending = pool.submit(load_window, windows[0])
+            for wi in range(len(windows)):
+                x, y = pending.result()
+                if wi + 1 < len(windows):
+                    pending = pool.submit(load_window, windows[wi + 1])
+                if carry_x is not None:
+                    x = concat(carry_x, x)
+                    y = concat(carry_y, y) if y is not None else None
+                rows = _tree_len(x)
+                self.peak_window_rows = max(self.peak_window_rows, rows)
+                order = rng.permutation(rows) if shuffle else np.arange(rows)
+                full = rows // per_host
+                for b in range(full):
+                    idx = order[b * per_host:(b + 1) * per_host]
+                    yield (_tree_take(x, idx),
+                           _tree_take(y, idx) if y is not None else None,
+                           None)
+                rem = rows - full * per_host
+                if rem:
+                    idx = order[full * per_host:]
+                    carry_x = _tree_take(x, idx)
+                    carry_y = _tree_take(y, idx) if y is not None else None
+                else:
+                    carry_x = carry_y = None
+        if carry_x is not None and not drop_remainder:
+            rem = _tree_len(carry_x)
+            pad = np.concatenate([np.arange(rem),
+                                  np.zeros(per_host - rem, np.int64)])
+            mask = np.zeros(per_host, np.float32)
+            mask[:rem] = 1.0
+            yield (_tree_take(carry_x, pad),
+                   _tree_take(carry_y, pad) if carry_y is not None else None,
+                   mask)
+
+
 def to_sharded_dataset(data, feature_cols=None, label_cols=None,
                        validation=None) -> ShardedDataset:
     """Coerce the Orca Estimator's accepted inputs — XShards, (x, y) ndarray
@@ -268,6 +399,10 @@ def to_sharded_dataset(data, feature_cols=None, label_cols=None,
     if isinstance(data, ShardedDataset):
         return data
     if isinstance(data, XShards):
+        # non-DRAM tiers stream so training keeps the store's residency
+        # bound (ref DiskFeatureSet trains from the 1/n window directly)
+        if getattr(data, "tier", "DRAM") != "DRAM":
+            return StreamingShardedDataset(data, feature_cols, label_cols)
         return ShardedDataset.from_xshards(data, feature_cols, label_cols)
     try:
         import pandas as pd
