@@ -1,20 +1,19 @@
 #!/usr/bin/env python
-"""Benchmark: NCF end-to-end training throughput (samples/sec/chip).
+"""Benchmarks: the three BASELINE.md north-star configs on one chip.
 
-The reference's flagship workload (BASELINE.md: apps/recommendation-ncf —
-zoo-Keras NeuralCF on MovieLens ml-1m, batch_size=8000, ref
-``apps/recommendation-ncf/ncf-explicit-feedback.ipynb`` + ``NeuralCF.scala``).
-Here the same architecture trains through the TPU-native Estimator engine.
+1. NCF end-to-end training throughput, samples/sec (the reference's
+   flagship workload: apps/recommendation-ncf — zoo-Keras NeuralCF on
+   MovieLens ml-1m, batch_size=8000, ref
+   ``apps/recommendation-ncf/ncf-explicit-feedback.ipynb`` + ``NeuralCF.scala``).
+2. BERT-base fine-tune MFU (Estimator.fit over text/bert.py, bf16 compute):
+   model FLOPs from XLA's own cost analysis ÷ step time ÷ chip peak.
+3. Zouwu TCN training steps/sec (ref zouwu/model/tcn.py:91 TemporalConvNet).
 
-Prints ONE JSON line:
-  {"metric": "ncf_train_samples_per_sec", "value": N, "unit": "samples/s",
-   "vs_baseline": R}
-
-``vs_baseline`` is the ratio to the same script's measured single-host CPU
-throughput (the reference ran on CPU executors; its repo publishes no
-absolute numbers — BASELINE.json published: {}). The CPU anchor below was
-measured on this host with JAX_PLATFORMS=cpu (single core, same code path).
-Override with env BENCH_BASELINE_SPS or re-measure with --cpu-baseline.
+Prints ONE JSON line; the headline metric stays NCF samples/s with
+``vs_baseline`` = ratio to this script's measured single-core CPU anchor
+(the reference ran on CPU executors; its repo publishes no absolute
+numbers — BASELINE.json published: {}). Override via BENCH_BASELINE_SPS or
+re-measure with --cpu-baseline. BERT/TCN ride as extra fields.
 """
 
 import json
@@ -34,8 +33,28 @@ STEPS_PER_LOOP = 10     # optimizer steps fused into one scan dispatch
 # JAX CPU backend, same fused train loop, 2026-07-29): 1,120,094 samples/s.
 CPU_BASELINE_SPS = float(os.environ.get("BENCH_BASELINE_SPS", 1_120_094.0))
 
+# peak dense-matmul FLOP/s per chip (bf16), keyed by device_kind;
+# override with BENCH_PEAK_FLOPS
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
 
-def build():
+
+def _device_peak_flops():
+    import jax
+    if os.environ.get("BENCH_PEAK_FLOPS"):
+        return float(os.environ["BENCH_PEAK_FLOPS"])
+    kind = jax.devices()[0].device_kind
+    return PEAK_FLOPS.get(kind)
+
+
+def build_ncf():
     import numpy as np
     from analytics_zoo_tpu import init_orca_context
     from analytics_zoo_tpu.learn.optimizers import Adam
@@ -55,10 +74,9 @@ def build():
     return ncf, x, y
 
 
-def measure() -> float:
+def measure_ncf() -> float:
     import jax
-    import numpy as np
-    ncf, x, y = build()
+    ncf, x, y = build_ncf()
     est = ncf.model._ensure_estimator(for_training=True)
     from analytics_zoo_tpu.data.dataset import ShardedDataset
     ds = ShardedDataset.from_ndarrays(x, y)
@@ -90,21 +108,117 @@ def measure() -> float:
     return n_loops * STEPS_PER_LOOP * BATCH / dt
 
 
+def _step_flops(train_step, state, x, y):
+    """XLA's own FLOP count for one compiled optimizer step."""
+    try:
+        compiled = train_step.lower(state, x, y).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return float(ca.get("flops", 0.0)) or None
+    except Exception:
+        return None
+
+
+def _measure_step_time(est, x, y, warmup=3, iters=10):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = est._ensure_mesh()
+    est._build_train_step()
+    xs = jax.device_put(x, NamedSharding(mesh, P(*(["data"] + [None] * (x.ndim - 1)))))
+    ys = jax.device_put(y, NamedSharding(mesh, P("data")))
+    state = est._state
+    for _ in range(warmup):
+        state, logs = est._train_step(state, xs, ys)
+    jax.block_until_ready(logs["loss"])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, logs = est._train_step(state, xs, ys)
+    jax.block_until_ready(logs["loss"])
+    dt = (time.perf_counter() - t0) / iters
+    est._state = state
+    flops = _step_flops(est._train_step, state, xs, ys)
+    return dt, flops
+
+
+def measure_bert():
+    """BERT-base fine-tune: step time, achieved FLOP/s, MFU."""
+    import jax.numpy as jnp
+    import numpy as np
+    import flax.linen as nn
+    from analytics_zoo_tpu.learn.estimator import Estimator
+    from analytics_zoo_tpu.text.bert import BertConfig, BertModule
+
+    SEQ, B = 128, 32
+    cfg = BertConfig(dtype=jnp.bfloat16)
+
+    class Classifier(nn.Module):
+        @nn.compact
+        def __call__(self, ids, train: bool = False):
+            _, pooled = BertModule(cfg, name="bert")(ids, train=train)
+            return nn.Dense(2)(pooled)
+
+    rng = np.random.default_rng(1)
+    x = rng.integers(0, cfg.vocab, (B, SEQ)).astype(np.int32)
+    y = rng.integers(0, 2, B).astype(np.int32)
+    est = Estimator.from_flax(
+        model=Classifier(), loss="sparse_categorical_crossentropy_logits",
+        optimizer="adam", sample_input=x[:2])
+    dt, flops = _measure_step_time(est, x, y)
+    achieved = (flops / dt) if flops else None
+    peak = _device_peak_flops()
+    mfu = (achieved / peak) if (achieved and peak) else None
+    return {"bert_step_ms": round(dt * 1e3, 2),
+            "bert_step_tflops": round(flops / 1e12, 3) if flops else None,
+            "bert_achieved_tflops_per_s":
+                round(achieved / 1e12, 2) if achieved else None,
+            "bert_base_mfu": round(mfu, 4) if mfu else None}
+
+
+def measure_tcn():
+    """Zouwu TCN (ref tcn.py:91): training steps/sec on rolling windows."""
+    import numpy as np
+    from analytics_zoo_tpu.learn.estimator import Estimator
+    from analytics_zoo_tpu.zouwu.model.nets import TemporalConvNet
+
+    B, LOOKBACK, FEATS = 256, 96, 8
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((B, LOOKBACK, FEATS)).astype(np.float32)
+    y = rng.standard_normal((B, 1)).astype(np.float32)
+    est = Estimator.from_flax(
+        model=TemporalConvNet(future_seq_len=1,
+                              num_channels=(32, 32, 32), kernel_size=7),
+        loss="mse", optimizer="adam", sample_input=x[:2])
+    dt, _ = _measure_step_time(est, x, y, warmup=3, iters=20)
+    return {"tcn_steps_per_sec": round(1.0 / dt, 1),
+            "tcn_samples_per_sec": round(B / dt, 1)}
+
+
 def main():
     if "--cpu-baseline" in sys.argv:
         os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "")
         import jax
         jax.config.update("jax_platforms", "cpu")
-        sps = measure()
+        sps = measure_ncf()
         print(f"# CPU baseline: {sps:,.0f} samples/s")
         return
-    sps = measure()
-    print(json.dumps({
+    import jax
+    out = {
         "metric": "ncf_train_samples_per_sec",
-        "value": round(sps, 1),
+        "value": 0.0,
         "unit": "samples/s",
-        "vs_baseline": round(sps / CPU_BASELINE_SPS, 3),
-    }))
+        "vs_baseline": 0.0,
+        "device": jax.devices()[0].device_kind,
+    }
+    sps = measure_ncf()
+    out["value"] = round(sps, 1)
+    out["vs_baseline"] = round(sps / CPU_BASELINE_SPS, 3)
+    for part in (measure_bert, measure_tcn):
+        try:
+            out.update(part())
+        except Exception as e:  # a secondary bench must not kill the line
+            out[part.__name__ + "_error"] = repr(e)[:200]
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
