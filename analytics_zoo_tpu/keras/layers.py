@@ -755,3 +755,943 @@ class BERT(KerasLayer):
         mask = args[2] if len(args) > 2 else None
         seq, pooled = module(ids, seg, mask, train=train)
         return pooled if self.output == "pooled" else seq
+
+
+# ---------------- elementwise math (ref keras/layers/torch.py + core.py) ----
+
+def _triple(v):
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+class _Elementwise(KerasLayer):
+    """Param-free elementwise layer base; subclasses set ``fn``."""
+
+    def apply(self, module, args, train):
+        return self.fn(args[0])
+
+    def _infer_shape(self, in_shapes):
+        return in_shapes[0]
+
+
+class _ModuleLayer(KerasLayer):
+    """Base for shape-preserving layers whose work lives in a flax
+    submodule; subclasses implement ``make_module`` only and set
+    ``takes_train = True`` when the module wants the train flag (noise /
+    randomized layers)."""
+
+    takes_train = False
+
+    def apply(self, module, args, train):
+        if self.takes_train:
+            return module(*args, train=train)
+        return module(*args)
+
+    def _infer_shape(self, in_shapes):
+        return in_shapes[0]
+
+
+class Identity(_Elementwise):
+    fn = staticmethod(lambda x: x)
+
+
+class Exp(_Elementwise):
+    fn = staticmethod(jnp.exp)
+
+
+class Log(_Elementwise):
+    fn = staticmethod(jnp.log)
+
+
+class Sqrt(_Elementwise):
+    fn = staticmethod(jnp.sqrt)
+
+
+class Square(_Elementwise):
+    fn = staticmethod(jnp.square)
+
+
+class Negative(_Elementwise):
+    fn = staticmethod(jnp.negative)
+
+
+class AddConstant(_Elementwise):
+    """(ref torch.py AddConstant)"""
+
+    def __init__(self, constant_scalar: float, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.fn = lambda x: x + constant_scalar
+
+
+class MulConstant(_Elementwise):
+    def __init__(self, constant_scalar: float, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.fn = lambda x: x * constant_scalar
+
+
+class Power(_Elementwise):
+    """out = (shift + scale * x) ** power (ref torch.py Power)."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0,
+                 input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.fn = lambda x: jnp.power(shift + scale * x, power)
+
+
+class HardTanh(_Elementwise):
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.fn = lambda x: jnp.clip(x, min_value, max_value)
+
+
+class HardShrink(_Elementwise):
+    def __init__(self, value: float = 0.5, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.fn = lambda x: jnp.where(jnp.abs(x) > value, x, 0.0)
+
+
+class SoftShrink(_Elementwise):
+    def __init__(self, value: float = 0.5, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.fn = lambda x: jnp.where(
+            x > value, x - value, jnp.where(x < -value, x + value, 0.0))
+
+
+class Threshold(_Elementwise):
+    """x if x > th else v (ref torch.py Threshold)."""
+
+    def __init__(self, th: float = 1e-6, v: float = 0.0, input_shape=None,
+                 name=None):
+        super().__init__(name, input_shape)
+        self.fn = lambda x: jnp.where(x > th, x, v)
+
+
+class BinaryThreshold(_Elementwise):
+    def __init__(self, value: float = 1e-6, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.fn = lambda x: (x > value).astype(jnp.float32)
+
+
+class Max(KerasLayer):
+    """Max over one dim; dim counts the batch as 0 like Select/Narrow here
+    (ref torch.py Max)."""
+
+    def __init__(self, dim: int, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.dim = dim
+
+    def apply(self, module, args, train):
+        return jnp.max(args[0], axis=self.dim)
+
+
+class SelectTable(KerasLayer):
+    """Pick the index-th tensor from a multi-input call
+    (ref torch.py SelectTable)."""
+
+    def __init__(self, index: int, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.index = index
+
+    def apply(self, module, args, train):
+        return args[self.index]
+
+
+# ---------------- learnable scale/shift (ref torch.py CAdd/CMul/Scale) ----
+
+class CAdd(_ModuleLayer):
+    """Learnable broadcast bias of shape ``size`` (batch dim excluded)."""
+
+    def __init__(self, size: Sequence[int], init="zero", input_shape=None,
+                 name=None):
+        super().__init__(name, input_shape)
+        self.size = tuple(size)
+        self.init = get_init(init)
+
+    def make_module(self):
+        size, init = self.size, self.init
+
+        class _CAdd(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                b = self.param("bias", init, size)
+                return x + b
+
+        return _CAdd(name=self.name)
+
+
+
+class CMul(_ModuleLayer):
+    """Learnable broadcast scale of shape ``size``."""
+
+    def __init__(self, size: Sequence[int], init="one", input_shape=None,
+                 name=None):
+        super().__init__(name, input_shape)
+        self.size = tuple(size)
+        self.init = get_init(init)
+
+    def make_module(self):
+        size, init = self.size, self.init
+
+        class _CMul(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                w = self.param("weight", init, size)
+                return x * w
+
+        return _CMul(name=self.name)
+
+
+
+class Scale(_ModuleLayer):
+    """y = weight * x + bias, both learnable of shape ``size``
+    (ref torch.py Scale = CMul ∘ CAdd)."""
+
+    def __init__(self, size: Sequence[int], input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.size = tuple(size)
+
+    def make_module(self):
+        size = self.size
+
+        class _Scale(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                w = self.param("weight", nn.initializers.ones, size)
+                b = self.param("bias", nn.initializers.zeros, size)
+                return x * w + b
+
+        return _Scale(name=self.name)
+
+
+
+class Mul(_ModuleLayer):
+    """Single learnable scalar multiplier (ref torch.py Mul)."""
+
+    def make_module(self):
+        class _Mul(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                w = self.param("weight", nn.initializers.ones, ())
+                return x * w
+
+        return _Mul(name=self.name)
+
+
+
+# ---------------- advanced activations (ref advanced_activations.py) ----
+
+class LeakyReLU(_Elementwise):
+    def __init__(self, alpha: float = 0.3, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.fn = lambda x: jnp.where(x >= 0, x, alpha * x)
+
+
+class ELU(_Elementwise):
+    def __init__(self, alpha: float = 1.0, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.fn = lambda x: jnp.where(x >= 0, x, alpha * (jnp.exp(x) - 1.0))
+
+
+class ThresholdedReLU(_Elementwise):
+    def __init__(self, theta: float = 1.0, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.fn = lambda x: jnp.where(x > theta, x, 0.0)
+
+
+class PReLU(_ModuleLayer):
+    """Learnable per-channel slope for x<0, init 0.25
+    (ref advanced_activations.py PReLU / torch nn.PReLU)."""
+
+    def __init__(self, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+
+    def make_module(self):
+        class _PReLU(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                a = self.param("alpha",
+                               nn.initializers.constant(0.25),
+                               (x.shape[-1],))
+                return jnp.where(x >= 0, x, a * x)
+
+        return _PReLU(name=self.name)
+
+
+
+class SReLU(_ModuleLayer):
+    """S-shaped ReLU with 4 learnable per-channel params
+    (ref advanced_activations.py SReLU): y = t_r + a_r (x - t_r) for
+    x >= t_r; x in between; t_l + a_l (x - t_l) for x <= t_l."""
+
+    def __init__(self, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+
+    def make_module(self):
+        class _SReLU(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                c = (x.shape[-1],)
+                t_l = self.param("t_left", nn.initializers.zeros, c)
+                a_l = self.param("a_left", nn.initializers.zeros, c)
+                t_r = self.param("t_right", nn.initializers.ones, c)
+                a_r = self.param("a_right", nn.initializers.ones, c)
+                y = jnp.where(x >= t_r, t_r + a_r * (x - t_r), x)
+                return jnp.where(x <= t_l, t_l + a_l * (x - t_l), y)
+
+        return _SReLU(name=self.name)
+
+
+
+class RReLU(_ModuleLayer):
+    """Randomized leaky ReLU (ref torch.py RReLU): train draws the negative
+    slope uniformly in [lower, upper]; eval uses the mean slope."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3,
+                 input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.lower, self.upper = lower, upper
+
+    def make_module(self):
+        lower, upper = self.lower, self.upper
+
+        class _RReLU(nn.Module):
+            @nn.compact
+            def __call__(self, x, train: bool = False):
+                if train:
+                    u = jax.random.uniform(self.make_rng("dropout"),
+                                           x.shape, x.dtype, lower, upper)
+                else:
+                    u = (lower + upper) / 2.0
+                return jnp.where(x >= 0, x, u * x)
+
+        return _RReLU(name=self.name)
+
+    takes_train = True
+
+
+# ---------------- noise layers (ref noise.py) ----
+
+class GaussianNoise(_ModuleLayer):
+    """Additive N(0, sigma) noise, train only (ref noise.py GaussianNoise)."""
+
+    def __init__(self, sigma: float, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.sigma = sigma
+
+    def make_module(self):
+        sigma = self.sigma
+
+        class _GN(nn.Module):
+            @nn.compact
+            def __call__(self, x, train: bool = False):
+                if not train or sigma <= 0:
+                    return x
+                eps = jax.random.normal(self.make_rng("dropout"),
+                                        x.shape, x.dtype)
+                return x + sigma * eps
+
+        return _GN(name=self.name)
+
+    takes_train = True
+
+
+class GaussianDropout(_ModuleLayer):
+    """Multiplicative N(1, sqrt(p/(1-p))) noise, train only
+    (ref noise.py GaussianDropout)."""
+
+    def __init__(self, p: float, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        assert 0 <= p < 1, "GaussianDropout needs 0 <= p < 1"
+        self.p = p
+
+    def make_module(self):
+        std = float(np.sqrt(self.p / (1.0 - self.p))) if self.p > 0 else 0.0
+
+        class _GD(nn.Module):
+            @nn.compact
+            def __call__(self, x, train: bool = False):
+                if not train or std == 0.0:
+                    return x
+                eps = jax.random.normal(self.make_rng("dropout"),
+                                        x.shape, x.dtype)
+                return x * (1.0 + std * eps)
+
+        return _GD(name=self.name)
+
+    takes_train = True
+
+
+class _SpatialDropout(_ModuleLayer):
+    """Drop whole feature maps (channels-last; ref core.py
+    SpatialDropout1D/2D/3D)."""
+
+    spatial_dims = 1
+
+    def __init__(self, p: float = 0.5, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.p = p
+
+    def make_module(self):
+        p, nd = self.p, self.spatial_dims
+
+        class _SD(nn.Module):
+            @nn.compact
+            def __call__(self, x, train: bool = False):
+                if not train or p <= 0:
+                    return x
+                shape = (x.shape[0],) + (1,) * nd + (x.shape[-1],)
+                keep = jax.random.bernoulli(self.make_rng("dropout"),
+                                            1.0 - p, shape)
+                return jnp.where(keep, x / (1.0 - p), 0.0)
+
+        return _SD(name=self.name)
+
+    takes_train = True
+
+
+class SpatialDropout1D(_SpatialDropout):
+    spatial_dims = 1
+
+
+class SpatialDropout2D(_SpatialDropout):
+    spatial_dims = 2
+
+
+class SpatialDropout3D(_SpatialDropout):
+    spatial_dims = 3
+
+
+class GaussianSampler(_ModuleLayer):
+    """VAE reparameterized sampling: call on [mean, log_var] nodes →
+    mean + exp(log_var / 2) * eps (ref torch.py GaussianSampler; used by the
+    reference's VAE apps)."""
+
+    def make_module(self):
+        class _GS(nn.Module):
+            @nn.compact
+            def __call__(self, mean, log_var, train: bool = False):
+                if not train:
+                    # deterministic at eval: return the mean (predict /
+                    # evaluate pass no rng — standard VAE inference)
+                    return mean
+                eps = jax.random.normal(self.make_rng("dropout"),
+                                        mean.shape, mean.dtype)
+                return mean + jnp.exp(log_var / 2.0) * eps
+
+        return _GS(name=self.name)
+
+    takes_train = True
+
+
+# ---------------- convolution extensions (ref convolutional.py) ----
+
+class Conv3D(KerasLayer):
+    """(ref Convolution3D) input [batch, d1, d2, d3, channels]."""
+
+    def __init__(self, nb_filter: int, kernel_dim1: int, kernel_dim2: int,
+                 kernel_dim3: int, activation=None, border_mode="valid",
+                 subsample=(1, 1, 1), init="glorot_uniform", bias: bool = True,
+                 input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.nb_filter = nb_filter
+        self.kernel = (kernel_dim1, kernel_dim2, kernel_dim3)
+        self.activation = get_activation(activation)
+        self.padding = border_mode.upper()
+        self.strides = _triple(subsample)
+        self.init = get_init(init)
+        self.bias = bias
+
+    def make_module(self):
+        return nn.Conv(self.nb_filter, self.kernel, strides=self.strides,
+                       padding=self.padding, use_bias=self.bias,
+                       kernel_init=self.init, name=self.name)
+
+    def apply(self, module, args, train):
+        return self.activation(module(args[0]))
+
+
+Convolution3D = Conv3D
+
+
+class AtrousConvolution1D(Conv1D):
+    """Dilated conv1d (ref AtrousConvolution1D; dilation via XLA's native
+    dilated-window convolution, no im2col)."""
+
+    def __init__(self, nb_filter: int, filter_length: int,
+                 atrous_rate: int = 1, activation=None, border_mode="valid",
+                 subsample_length: int = 1, init="glorot_uniform",
+                 bias: bool = True, input_shape=None, name=None):
+        super().__init__(nb_filter, filter_length, activation=activation,
+                         border_mode=border_mode,
+                         subsample_length=subsample_length, init=init,
+                         bias=bias, dilation_rate=atrous_rate,
+                         input_shape=input_shape, name=name)
+
+
+class AtrousConvolution2D(KerasLayer):
+    """(ref AtrousConvolution2D)"""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 atrous_rate=(1, 1), activation=None, border_mode="valid",
+                 subsample=(1, 1), init="glorot_uniform", bias: bool = True,
+                 input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.nb_filter, self.kernel = nb_filter, (nb_row, nb_col)
+        self.rate = _pair(atrous_rate)
+        self.activation = get_activation(activation)
+        self.padding = border_mode.upper()
+        self.strides = _pair(subsample)
+        self.init = get_init(init)
+        self.bias = bias
+
+    def make_module(self):
+        return nn.Conv(self.nb_filter, self.kernel, strides=self.strides,
+                       padding=self.padding, kernel_dilation=self.rate,
+                       use_bias=self.bias, kernel_init=self.init,
+                       name=self.name)
+
+    def apply(self, module, args, train):
+        return self.activation(module(args[0]))
+
+
+class Deconvolution2D(KerasLayer):
+    """Transposed conv (ref Deconvolution2D; the output_shape argument of
+    keras-1 is unnecessary — XLA infers it from stride/padding)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, border_mode="valid", subsample=(1, 1),
+                 init="glorot_uniform", bias: bool = True, input_shape=None,
+                 name=None):
+        super().__init__(name, input_shape)
+        self.nb_filter, self.kernel = nb_filter, (nb_row, nb_col)
+        self.activation = get_activation(activation)
+        self.padding = border_mode.upper()
+        self.strides = _pair(subsample)
+        self.init = get_init(init)
+        self.bias = bias
+
+    def make_module(self):
+        return nn.ConvTranspose(self.nb_filter, self.kernel,
+                                strides=self.strides, padding=self.padding,
+                                use_bias=self.bias, kernel_init=self.init,
+                                name=self.name)
+
+    def apply(self, module, args, train):
+        return self.activation(module(args[0]))
+
+
+class ShareConvolution2D(Conv2D):
+    """(ref ShareConvolution2D — BigDL's memory-shared conv variant; the
+    math is identical to Conv2D and XLA owns buffer reuse on TPU)."""
+
+
+class LocallyConnected1D(KerasLayer):
+    """Conv1D with UNSHARED weights per position (ref local.py:26):
+    patches [b, L', k·c] ⊗ kernel [L', k·c, f] via einsum — one batched
+    matmul on the MXU instead of per-position loops."""
+
+    def __init__(self, nb_filter: int, filter_length: int, activation=None,
+                 subsample_length: int = 1, bias: bool = True,
+                 input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.nb_filter, self.k = nb_filter, filter_length
+        self.activation = get_activation(activation)
+        self.stride = subsample_length
+        self.bias = bias
+
+    def make_module(self):
+        f, k, stride, use_bias = (self.nb_filter, self.k, self.stride,
+                                  self.bias)
+
+        class _LC1D(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                b, L, c = x.shape
+                out_len = (L - k) // stride + 1
+                idx = (np.arange(out_len)[:, None] * stride
+                       + np.arange(k)[None, :])          # [L', k]
+                patches = x[:, idx, :].reshape(b, out_len, k * c)
+                w = self.param("kernel", nn.initializers.glorot_uniform(),
+                               (out_len, k * c, f))
+                y = jnp.einsum("blk,lkf->blf", patches, w)
+                if use_bias:
+                    y = y + self.param("bias", nn.initializers.zeros,
+                                       (out_len, f))
+                return y
+
+        return _LC1D(name=self.name)
+
+    def apply(self, module, args, train):
+        return self.activation(module(args[0]))
+
+
+class LocallyConnected2D(KerasLayer):
+    """Conv2D with unshared weights (ref local.py:74)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, subsample=(1, 1), bias: bool = True,
+                 input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.nb_filter = nb_filter
+        self.kernel = (nb_row, nb_col)
+        self.activation = get_activation(activation)
+        self.strides = _pair(subsample)
+        self.bias = bias
+
+    def make_module(self):
+        f, (kh, kw), (sh, sw), use_bias = (self.nb_filter, self.kernel,
+                                           self.strides, self.bias)
+
+        class _LC2D(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                b, H, W, c = x.shape
+                oh = (H - kh) // sh + 1
+                ow = (W - kw) // sw + 1
+                ih = (np.arange(oh)[:, None] * sh + np.arange(kh)[None, :])
+                iw = (np.arange(ow)[:, None] * sw + np.arange(kw)[None, :])
+                # [b, oh, kh, W, c] → [b, oh, kh, ow, kw, c]
+                p = x[:, ih.reshape(-1), :, :].reshape(b, oh, kh, W, c)
+                p = p[:, :, :, iw.reshape(-1), :].reshape(
+                    b, oh, kh, ow, kw, c)
+                patches = p.transpose(0, 1, 3, 2, 4, 5).reshape(
+                    b, oh, ow, kh * kw * c)
+                w = self.param("kernel", nn.initializers.glorot_uniform(),
+                               (oh, ow, kh * kw * c, f))
+                y = jnp.einsum("bhwk,hwkf->bhwf", patches, w)
+                if use_bias:
+                    y = y + self.param("bias", nn.initializers.zeros,
+                                       (oh, ow, f))
+                return y
+
+        return _LC2D(name=self.name)
+
+    def apply(self, module, args, train):
+        return self.activation(module(args[0]))
+
+
+class ConvLSTM2D(KerasLayer):
+    """Convolutional LSTM over [b, t, h, w, c]
+    (ref convolutional_recurrent.py:26 ConvLSTM2D; lowers to lax.scan over
+    a flax ConvLSTMCell — gate convs fuse on the MXU)."""
+
+    def __init__(self, nb_filter: int, nb_kernel: int,
+                 return_sequences: bool = False, go_backwards: bool = False,
+                 border_mode: str = "same", input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.nb_filter, self.nb_kernel = nb_filter, nb_kernel
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+        if border_mode != "same":
+            raise ValueError("ConvLSTM2D supports border_mode='same' only "
+                             "(matching the reference's implementation)")
+        self._kdims = 2
+
+    def make_module(self):
+        cell = nn.ConvLSTMCell(features=self.nb_filter,
+                               kernel_size=(self.nb_kernel,) * self._kdims)
+        return nn.RNN(cell, reverse=self.go_backwards, name=self.name)
+
+    def apply(self, module, args, train):
+        out = module(args[0])
+        return out if self.return_sequences else out[:, -1]
+
+
+class ConvLSTM3D(ConvLSTM2D):
+    """(ref ConvLSTM3D) input [b, t, d1, d2, d3, c]."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._kdims = 3
+
+
+class LRN2D(KerasLayer):
+    """Cross-channel local response normalization (channels-last; ref
+    convolutional.py LRN2D / AlexNet LRN)."""
+
+    def __init__(self, alpha: float = 1e-4, k: float = 1.0,
+                 beta: float = 0.75, n: int = 5, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.alpha, self.k, self.beta, self.n = alpha, k, beta, n
+
+    def apply(self, module, args, train):
+        # caffe/torch convention (BigDL SpatialCrossMapLRN): alpha is
+        # divided by the window size
+        x = args[0]
+        sq = jnp.square(x)
+        half = self.n // 2
+        pad = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
+        win = sum(pad[..., i:i + x.shape[-1]] for i in range(self.n))
+        return x / jnp.power(self.k + (self.alpha / self.n) * win, self.beta)
+
+    def _infer_shape(self, in_shapes):
+        return in_shapes[0]
+
+
+class WithinChannelLRN2D(KerasLayer):
+    """Spatial (within-channel) LRN (ref WithinChannelLRN2D)."""
+
+    def __init__(self, size: int = 5, alpha: float = 1e-4,
+                 beta: float = 0.75, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.size, self.alpha, self.beta = size, alpha, beta
+
+    def apply(self, module, args, train):
+        x = args[0]
+        sq = jnp.square(x)
+        mean = nn.avg_pool(sq, (self.size, self.size), (1, 1), "SAME")
+        return x / jnp.power(1.0 + self.alpha * mean, self.beta)
+
+    def _infer_shape(self, in_shapes):
+        return in_shapes[0]
+
+
+class ResizeBilinear(KerasLayer):
+    """(ref convolutional.py ResizeBilinear; jax.image.resize on TPU).
+    ``align_corners=False`` is half-pixel-center interpolation (TF2/torch
+    default); ``True`` maps corner pixels exactly onto corners."""
+
+    def __init__(self, output_height: int, output_width: int,
+                 align_corners: bool = False, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.oh, self.ow = output_height, output_width
+        self.align_corners = align_corners
+
+    def apply(self, module, args, train):
+        x = args[0]
+        if not self.align_corners:
+            return jax.image.resize(
+                x, (x.shape[0], self.oh, self.ow, x.shape[-1]), "bilinear")
+        # align_corners: in = out * (in_len-1)/(out_len-1); separable lerp
+        ih, iw = x.shape[1], x.shape[2]
+
+        def lerp(arr, axis, out_len, in_len):
+            if out_len == 1 or in_len == 1:
+                idx = jnp.zeros((out_len,), jnp.int32)
+                return jnp.take(arr, idx, axis=axis)
+            pos = jnp.linspace(0.0, in_len - 1.0, out_len)
+            lo = jnp.floor(pos).astype(jnp.int32)
+            hi = jnp.minimum(lo + 1, in_len - 1)
+            w = (pos - lo).astype(arr.dtype)
+            shape = [1] * arr.ndim
+            shape[axis] = out_len
+            w = w.reshape(shape)
+            return jnp.take(arr, lo, axis=axis) * (1 - w) + \
+                jnp.take(arr, hi, axis=axis) * w
+
+        return lerp(lerp(x, 1, self.oh, ih), 2, self.ow, iw)
+
+
+# ---------------- 3D pooling / padding / cropping / upsampling ----
+
+class MaxPooling3D(_Pool):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, border_mode="valid",
+                 input_shape=None, name=None):
+        super().__init__(_triple(pool_size), _triple(strides or pool_size),
+                         border_mode, input_shape=input_shape, name=name)
+
+    def apply(self, module, args, train):
+        return nn.max_pool(args[0], self.pool_size, self.strides, self.padding)
+
+
+class AveragePooling3D(MaxPooling3D):
+    def apply(self, module, args, train):
+        return nn.avg_pool(args[0], self.pool_size, self.strides, self.padding)
+
+
+class GlobalMaxPooling3D(KerasLayer):
+    def apply(self, module, args, train):
+        return jnp.max(args[0], axis=(1, 2, 3))
+
+
+class GlobalAveragePooling3D(KerasLayer):
+    def apply(self, module, args, train):
+        return jnp.mean(args[0], axis=(1, 2, 3))
+
+
+class ZeroPadding3D(KerasLayer):
+    def __init__(self, padding=(1, 1, 1), input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.padding = _triple(padding)
+
+    def apply(self, module, args, train):
+        p = self.padding
+        return jnp.pad(args[0], ((0, 0), (p[0], p[0]), (p[1], p[1]),
+                                 (p[2], p[2]), (0, 0)))
+
+
+def _crop_pair(c):
+    return (c, c) if isinstance(c, int) else tuple(c)
+
+
+class Cropping1D(KerasLayer):
+    def __init__(self, cropping=(1, 1), input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.cropping = _crop_pair(cropping)
+
+    def apply(self, module, args, train):
+        a, b = self.cropping
+        x = args[0]
+        return x[:, a:x.shape[1] - b, :]
+
+
+class Cropping2D(KerasLayer):
+    def __init__(self, cropping=((0, 0), (0, 0)), input_shape=None,
+                 name=None):
+        super().__init__(name, input_shape)
+        self.cropping = tuple(_crop_pair(c) for c in cropping)
+
+    def apply(self, module, args, train):
+        (t, b), (l, r) = self.cropping
+        x = args[0]
+        return x[:, t:x.shape[1] - b, l:x.shape[2] - r, :]
+
+
+class Cropping3D(KerasLayer):
+    def __init__(self, cropping=((1, 1), (1, 1), (1, 1)), input_shape=None,
+                 name=None):
+        super().__init__(name, input_shape)
+        self.cropping = tuple(_crop_pair(c) for c in cropping)
+
+    def apply(self, module, args, train):
+        (a1, b1), (a2, b2), (a3, b3) = self.cropping
+        x = args[0]
+        return x[:, a1:x.shape[1] - b1, a2:x.shape[2] - b2,
+                 a3:x.shape[3] - b3, :]
+
+
+class UpSampling1D(KerasLayer):
+    def __init__(self, length: int = 2, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.length = length
+
+    def apply(self, module, args, train):
+        return jnp.repeat(args[0], self.length, axis=1)
+
+
+class UpSampling3D(KerasLayer):
+    def __init__(self, size=(2, 2, 2), input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.size = _triple(size)
+
+    def apply(self, module, args, train):
+        x = args[0]
+        for ax, s in enumerate(self.size):
+            x = jnp.repeat(x, s, axis=ax + 1)
+        return x
+
+
+# ---------------- dense variants (ref core.py Highway/MaxoutDense...) ----
+
+class Highway(_ModuleLayer):
+    """y = T·H(x) + (1-T)·x with T = σ(W_T x), H = act(W_H x)
+    (ref core.py Highway)."""
+
+    def __init__(self, activation="tanh", bias: bool = True,
+                 input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.activation = get_activation(activation)
+        self.bias = bias
+
+    def make_module(self):
+        act, use_bias = self.activation, self.bias
+
+        class _Highway(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                d = x.shape[-1]
+                t = nn.sigmoid(nn.Dense(d, use_bias=use_bias,
+                                        name="transform")(x))
+                h = act(nn.Dense(d, use_bias=use_bias, name="h")(x))
+                return t * h + (1.0 - t) * x
+
+        return _Highway(name=self.name)
+
+
+
+class MaxoutDense(KerasLayer):
+    """Dense to nb_feature parallel outputs, max over them
+    (ref core.py MaxoutDense)."""
+
+    def __init__(self, output_dim: int, nb_feature: int = 4,
+                 bias: bool = True, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.output_dim, self.nb_feature = output_dim, nb_feature
+        self.bias = bias
+
+    def make_module(self):
+        od, k, use_bias = self.output_dim, self.nb_feature, self.bias
+
+        class _Maxout(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                y = nn.Dense(od * k, use_bias=use_bias)(x)
+                return y.reshape(y.shape[:-1] + (k, od)).max(-2)
+
+        return _Maxout(name=self.name)
+
+    def apply(self, module, args, train):
+        return module(args[0])
+
+    def _infer_shape(self, in_shapes):
+        s = in_shapes[0]
+        return (s[:-1] + (self.output_dim,)) if s else None
+
+
+class SparseDense(Dense):
+    """(ref core.py SparseDense — BigDL's sparse-input Dense; on TPU sparse
+    inputs densify, XLA has no sparse MXU path, so the math is Dense)."""
+
+
+class SparseEmbedding(Embedding):
+    """(ref embeddings.py SparseEmbedding; dense gather on TPU)."""
+
+
+class WordEmbedding(KerasLayer):
+    """Pretrained word-embedding lookup, optionally frozen
+    (ref zoo/.../keras/layers/WordEmbedding.scala:49: loads GloVe vectors,
+    trainable=false by default). ``weights``: [vocab, dim] ndarray. Frozen
+    weights are a closure constant (no param → no gradient, no optimizer
+    state); trainable ones become a normal Embed table."""
+
+    def __init__(self, weights: np.ndarray, trainable: bool = False,
+                 zero_based_id: bool = True, input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.weights = np.asarray(weights, np.float32)
+        self.trainable = trainable
+        self.zero_based_id = zero_based_id
+
+    @classmethod
+    def from_glove(cls, path: str, word_index: dict, dim: int,
+                   trainable: bool = False, **kw) -> "WordEmbedding":
+        """Build from a GloVe text file + {word: 1-based index} vocabulary
+        (ref WordEmbedding.scala companion loader). Row 0 is the zero pad
+        vector and word k's vector sits at row k, so ids look up DIRECTLY
+        (the textset.py load_glove convention) — no 1-based shift."""
+        table = np.zeros((max(word_index.values()) + 1, dim), np.float32)
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                parts = line.rstrip().split(" ")
+                if parts[0] in word_index and len(parts) == dim + 1:
+                    table[word_index[parts[0]]] = np.asarray(parts[1:],
+                                                             np.float32)
+        return cls(table, trainable=trainable, zero_based_id=True, **kw)
+
+    def make_module(self):
+        if not self.trainable:
+            return None
+        vocab, dim = self.weights.shape
+        init = lambda *a: jnp.asarray(self.weights)  # noqa: E731
+        return nn.Embed(vocab, dim, embedding_init=init, name=self.name)
+
+    def apply(self, module, args, train):
+        ids = args[0].astype(jnp.int32)
+        if not self.zero_based_id:
+            ids = jnp.maximum(ids - 1, 0)
+        if module is not None:
+            return module(ids)
+        return jnp.asarray(self.weights)[ids]
+
+    def _infer_shape(self, in_shapes):
+        s = in_shapes[0]
+        return tuple(s) + (self.weights.shape[1],) if s is not None else None
