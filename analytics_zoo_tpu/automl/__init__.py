@@ -11,7 +11,9 @@ self-contained.
 from analytics_zoo_tpu.automl import hp
 from analytics_zoo_tpu.automl.auto_estimator import AutoEstimator
 from analytics_zoo_tpu.automl.metrics import Evaluator
+from analytics_zoo_tpu.automl.population import PopulationSearchEngine
 from analytics_zoo_tpu.automl.search import (
+    BayesSearcher,
     LocalSearchEngine,
     SearchEngine,
     Trial,
@@ -23,5 +25,7 @@ __all__ = [
     "Evaluator",
     "SearchEngine",
     "LocalSearchEngine",
+    "PopulationSearchEngine",
+    "BayesSearcher",
     "Trial",
 ]

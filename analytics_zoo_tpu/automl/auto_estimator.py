@@ -52,17 +52,26 @@ class AutoEstimator:
     def fit(self, data, validation_data=None, search_space: dict = None,
             n_sampling: int = 1, epochs: int = 1, metric: str = "mse",
             mode: Optional[str] = None, scheduler: Optional[str] = None,
-            batch_size: Optional[int] = None) -> "AutoEstimator":
+            batch_size: Optional[int] = None,
+            search_alg: Optional[str] = None,
+            n_parallel=None) -> "AutoEstimator":
         """``data``: ``(x, y)`` numpy pair (the reference also accepts
-        XShards/DataFrames — use ``.to_numpy()`` paths upstream)."""
+        XShards/DataFrames — use ``.to_numpy()`` paths upstream).
+
+        ``search_alg="bayes"`` → sequential model-based proposals (ref
+        tune skopt/bayesopt); ``scheduler="hyperband"`` → successive
+        halving; ``n_parallel=N|"auto"`` → trials packed over mesh
+        devices."""
         if search_space is None:
             raise ValueError("search_space is required")
         self._best_trial = None
         self._best_model = None
+        if n_parallel is not None:
+            self.engine.n_parallel = n_parallel
         self.engine.compile(data, search_space, n_sampling=n_sampling,
                             epochs=epochs, validation_data=validation_data,
                             metric=metric, mode=mode, scheduler=scheduler,
-                            batch_size=batch_size)
+                            batch_size=batch_size, search_alg=search_alg)
         self.engine.run()
         self._best_trial = self.engine.get_best_trial()
         return self

@@ -1,0 +1,182 @@
+"""Population search — K hyperparameter trials fused into ONE computation.
+
+The TPU-first replacement for Ray Tune's actor-per-trial model
+(SURVEY.md §7.6 "vmap/pjit-aware trial packing instead of Ray Tune"; ref
+pyzoo/zoo/automl/search/ray_tune_search_engine.py:36 runs each trial as a
+separate Ray actor). When every trial shares the model architecture and
+only *optimizer* hyperparameters (learning rate, weight decay) and init
+seeds differ, the whole population trains as one ``vmap``-ped jitted
+program: params and optimizer states carry a leading population axis,
+per-member learning rates ride inside ``optax.inject_hyperparams`` state,
+and one dispatch advances every trial one step. On TPU the population
+batches onto the MXU; even on one host this amortizes compilation and
+dispatch K× (a serial sweep pays them per trial).
+
+Scope: hyperparameters that change *traced values*, not program structure
+— ``lr`` (required), ``weight_decay``, ``seed``. Structural axes (layer
+sizes) belong in ``LocalSearchEngine``, which can split a mixed space by
+structure and delegate each group here.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.automl import hp
+from analytics_zoo_tpu.automl.metrics import Evaluator
+from analytics_zoo_tpu.automl.search import SearchEngine, Trial
+
+logger = logging.getLogger(__name__)
+
+VECTOR_KEYS = ("lr", "weight_decay", "seed")
+
+
+class PopulationSearchEngine(SearchEngine):
+    """vmapped trial packing over optimizer hyperparameters."""
+
+    def __init__(self, model_creator: Callable[[dict], object],
+                 loss: str = "mse",
+                 logs_dir: str = "/tmp/analytics_zoo_tpu_automl",
+                 name: str = "population", seed: int = 0):
+        self.model_creator = model_creator
+        self.loss_name = loss
+        self.logs_dir = os.path.join(logs_dir, name)
+        self.seed = seed
+        self.trials: List[Trial] = []
+        self._compiled = False
+        self._member_params = None
+        self._module = None
+
+    def compile(self, data, search_space: dict, n_sampling: int = 4,
+                epochs: int = 1, validation_data=None, metric: str = "mse",
+                mode: Optional[str] = None, batch_size: int = 32, **_):
+        bad = [k for k, v in search_space.items()
+               if isinstance(v, hp.Sampler) and k not in VECTOR_KEYS]
+        if bad:
+            raise ValueError(
+                f"PopulationSearchEngine vectorizes {VECTOR_KEYS} only; "
+                f"structural axes {bad} need LocalSearchEngine")
+        if not isinstance(search_space.get("lr"), hp.Sampler) and \
+                "lr" not in search_space:
+            raise ValueError("search_space must define 'lr'")
+        self.data = data
+        self.validation_data = validation_data
+        self.epochs = int(epochs)
+        self.metric = metric
+        self.mode = mode or Evaluator.get_metric_mode(metric)
+        self.batch_size = int(batch_size)
+        rng = np.random.default_rng(self.seed)
+        configs = [hp.sample_config(search_space, rng)
+                   for _ in range(int(n_sampling))]
+        for i, c in enumerate(configs):
+            c.setdefault("seed", i)
+        self.trials = [Trial(i, c) for i, c in enumerate(configs)]
+        self._compiled = True
+        return self
+
+    def run(self) -> List[Trial]:
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from analytics_zoo_tpu.learn import losses as loss_lib
+
+        if not self._compiled:
+            raise RuntimeError("compile() before run()")
+        os.makedirs(self.logs_dir, exist_ok=True)
+        t0 = time.time()
+
+        x, y = self.data
+        x = np.asarray(x)
+        y = np.asarray(y)
+        vx, vy = (self.validation_data
+                  if self.validation_data is not None else (x, y))
+        K = len(self.trials)
+        lrs = jnp.asarray([float(t.config["lr"]) for t in self.trials])
+        wds = jnp.asarray([float(t.config.get("weight_decay", 0.0))
+                           for t in self.trials])
+        seeds = jnp.asarray([int(t.config["seed"]) for t in self.trials])
+        module = self.model_creator(self.trials[0].config)
+        self._module = module
+        loss_fn = loss_lib.get(self.loss_name)
+
+        # lr/wd live in InjectHyperparamsState → they are per-member TRACED
+        # state the single update function reads back out, so one jitted
+        # program serves the whole population
+        tx = optax.inject_hyperparams(optax.adamw)(
+            learning_rate=0.0, weight_decay=0.0)
+
+        def init_member(seed, lr, wd):
+            params = module.init(jax.random.PRNGKey(seed), x[:1])
+            opt = tx.init(params)
+            opt = opt._replace(hyperparams={"learning_rate": lr,
+                                            "weight_decay": wd})
+            return params, opt
+
+        params, opts = jax.vmap(init_member)(seeds, lrs, wds)
+
+        def member_step(params, opt, bx, by):
+            def compute(p):
+                return loss_fn(by, module.apply(p, bx)).mean()
+
+            loss_val, grads = jax.value_and_grad(compute)(params)
+            updates, new_opt = tx.update(grads, opt, params)
+            return optax.apply_updates(params, updates), new_opt, loss_val
+
+        @jax.jit
+        def epoch_step(params, opts, batches_x, batches_y):
+            def body(carry, b):
+                p, o = carry
+                bx, by = b
+                p, o, losses = jax.vmap(member_step,
+                                        in_axes=(0, 0, None, None))(p, o,
+                                                                    bx, by)
+                return (p, o), losses
+
+            (params, opts), losses = jax.lax.scan(
+                body, (params, opts), (batches_x, batches_y))
+            return params, opts, losses
+
+        v_predict = jax.jit(jax.vmap(module.apply, in_axes=(0, None)))
+
+        n = len(x)
+        bs = min(self.batch_size, n)
+        steps = max(1, n // bs)
+        host_rng = np.random.default_rng(self.seed)
+        for t in self.trials:
+            t.status = "running"
+        for _ in range(self.epochs):
+            order = host_rng.permutation(n)[:steps * bs].reshape(steps, bs)
+            params, opts, _ = epoch_step(params, opts, x[order], y[order])
+            preds = np.asarray(v_predict(params, vx))
+            for k, t in enumerate(self.trials):
+                value = float(Evaluator.evaluate(self.metric, vy, preds[k]))
+                t.metric_history.append(value)
+                better = t.best_metric is None or (
+                    value < t.best_metric if self.mode == "min"
+                    else value > t.best_metric)
+                if better:
+                    t.best_metric = value
+        wall = time.time() - t0
+        self._member_params = jax.device_get(params)
+        for t in self.trials:
+            t.status = "done"
+            t.wall_s = wall  # one fused computation: shared wall clock
+        return self.trials
+
+    def get_best_trial(self) -> Trial:
+        done = [t for t in self.trials if t.best_metric is not None]
+        if not done:
+            raise RuntimeError("no successful trials")
+        key = (lambda t: t.best_metric)
+        return min(done, key=key) if self.mode == "min" else max(done, key=key)
+
+    def get_best_params(self):
+        """Final params pytree of the best member (leading axis sliced)."""
+        import jax
+        best = self.get_best_trial().trial_id
+        return jax.tree_util.tree_map(lambda a: a[best], self._member_params)

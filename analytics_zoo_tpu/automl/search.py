@@ -79,13 +79,132 @@ class MedianStopper:
         return value > med if self.mode == "min" else value < med
 
 
+class BayesSearcher:
+    """Sequential model-based sampler — the TPE idea behind the reference's
+    skopt/bayesopt search algs (ref ray_tune_search_engine.py:36-172):
+    split observed configs into a good quantile and the rest, sample
+    candidates from a Parzen mixture over the good ones and keep the
+    candidate maximizing the good/bad density ratio."""
+
+    def __init__(self, space: dict, mode: str, seed: int = 0,
+                 n_startup: int = 6, n_candidates: int = 24,
+                 gamma: float = 0.3):
+        self.space = space
+        self.mode = mode
+        self.rng = np.random.default_rng(seed)
+        self.n_startup = n_startup
+        self.n_candidates = n_candidates
+        self.gamma = gamma
+        self._obs: List[tuple] = []
+
+    def observe(self, config: dict, value: Optional[float]):
+        if value is not None and np.isfinite(value):
+            self._obs.append((config, float(value)))
+
+    # -- per-key helpers ----------------------------------------------
+    def _transform(self, key, v):
+        s = self.space[key]
+        return np.log(float(v)) if isinstance(s, hp.LogUniform) else float(v)
+
+    def _untransform(self, key, t):
+        s = self.space[key]
+        v = float(np.exp(t)) if isinstance(s, hp.LogUniform) else float(t)
+        if isinstance(s, (hp.QUniform, hp.QLogUniform)):
+            v = hp._snap_to_q(v, s.q, s.lower, s.upper)
+        if isinstance(s, hp.QRandInt):
+            v = int(hp._snap_to_q(round(v), s.q, s.lower, s.upper - 1))
+        elif isinstance(s, hp.RandInt):
+            v = int(np.clip(round(v), s.lower, s.upper - 1))
+        elif hasattr(s, "lower"):
+            v = float(np.clip(v, s.lower, s.upper))
+        return v
+
+    def _numeric_keys(self):
+        return [k for k, s in self.space.items()
+                if isinstance(s, (hp.Uniform, hp.LogUniform, hp.RandInt))
+                and not isinstance(s, hp.GridSearch)]
+
+    def _categorical_keys(self):
+        return [k for k, s in self.space.items()
+                if isinstance(s, (hp.Choice, hp.GridSearch))]
+
+    def _mixture_logpdf(self, key, obs_configs, t):
+        centers = np.array([self._transform(key, c[key])
+                            for c in obs_configs])
+        bw = max(float(np.std(centers)), 1e-3 * (abs(float(
+            np.mean(centers))) + 1.0))
+        z = (t - centers) / bw
+        return float(np.log(np.mean(np.exp(-0.5 * z * z) + 1e-12)) -
+                     np.log(bw))
+
+    def _cat_logp(self, key, obs_configs, v):
+        s = self.space[key]
+        cats = s.categories if isinstance(s, hp.Choice) else s.grid
+        counts = {c: 1.0 for c in map(repr, cats)}  # Laplace smoothing
+        for c in obs_configs:
+            counts[repr(c[key])] = counts.get(repr(c[key]), 1.0) + 1.0
+        total = sum(counts.values())
+        return float(np.log(counts.get(repr(v), 1.0) / total))
+
+    # -- API ----------------------------------------------------------
+    def suggest(self) -> dict:
+        if len(self._obs) < self.n_startup:
+            return hp.sample_config(self.space, self.rng)
+        vals = np.array([v for _, v in self._obs])
+        order = np.argsort(vals if self.mode == "min" else -vals)
+        n_good = max(2, int(np.ceil(self.gamma * len(order))))
+        good = [self._obs[i][0] for i in order[:n_good]]
+        bad = [self._obs[i][0] for i in order[n_good:]] or good
+
+        def sample_candidate():
+            cfg = hp.sample_config(self.space, self.rng)
+            for k in self._numeric_keys():
+                centers = [self._transform(k, c[k]) for c in good]
+                center = centers[int(self.rng.integers(len(centers)))]
+                bw = max(float(np.std(centers)), 1e-3 * (abs(center) + 1.0))
+                cfg[k] = self._untransform(k, self.rng.normal(center, bw))
+            for k in self._categorical_keys():
+                pick = good[int(self.rng.integers(len(good)))][k]
+                if self.rng.random() < 0.8:
+                    cfg[k] = pick
+            return cfg
+
+        def score(cfg):
+            s = 0.0
+            for k in self._numeric_keys():
+                t = self._transform(k, cfg[k])
+                s += self._mixture_logpdf(k, good, t) \
+                    - self._mixture_logpdf(k, bad, t)
+            for k in self._categorical_keys():
+                s += self._cat_logp(k, good, cfg[k]) \
+                    - self._cat_logp(k, bad, cfg[k])
+            return s
+
+        cands = [sample_candidate() for _ in range(self.n_candidates)]
+        return cands[int(np.argmax([score(c) for c in cands]))]
+
+
 class LocalSearchEngine(SearchEngine):
-    """Grid × random sampling over a config space, trial loop with
-    per-epoch reward reporting, best-trial checkpointing."""
+    """Trial scheduling on the host driving the TPU mesh.
+
+    vs the reference's RayTuneSearchEngine (ray_tune_search_engine.py:36):
+    - sampling: grid × random, or sequential bayes (``search_alg="bayes"``,
+      the skopt/bayesopt analog);
+    - schedulers: median stopping or successive halving
+      (``scheduler="hyperband"``), matching tune's AsyncHyperBand idea;
+    - packing: ``n_parallel>1`` (or ``"auto"``) round-robins trials over
+      ``jax.devices()`` with per-thread default devices — each mesh device
+      trains a different trial concurrently;
+    - fault isolation: a raising trial records status="error" and the
+      search continues (ref tune trial fault tolerance).
+
+    For homogeneous-architecture spaces see ``PopulationSearchEngine``
+    (automl/population.py): K trials fused into ONE jitted computation.
+    """
 
     def __init__(self, model_builder: ModelBuilder,
                  logs_dir: str = "/tmp/analytics_zoo_tpu_automl",
-                 name: str = "exp", seed: int = 0, n_parallel: int = 1):
+                 name: str = "exp", seed: int = 0, n_parallel=1):
         self.builder = model_builder
         self.logs_dir = os.path.join(logs_dir, name)
         self.name = name
@@ -97,10 +216,13 @@ class LocalSearchEngine(SearchEngine):
     def compile(self, data, search_space: dict, n_sampling: int = 1,
                 epochs: int = 1, validation_data=None, metric: str = "mse",
                 mode: Optional[str] = None, scheduler: Optional[str] = None,
-                batch_size: Optional[int] = None):
+                batch_size: Optional[int] = None,
+                search_alg: Optional[str] = None):
         """Materialize the trial list: the grid axes cross-product, each
         point sampled ``n_sampling`` times (ref RayTuneSearchEngine.compile
-        ray_tune_search_engine.py:61)."""
+        ray_tune_search_engine.py:61). With ``search_alg="bayes"`` configs
+        are proposed sequentially by the BayesSearcher instead
+        (``n_sampling`` = total trial count)."""
         self.data = data
         self.validation_data = validation_data
         self.epochs = int(epochs)
@@ -108,41 +230,52 @@ class LocalSearchEngine(SearchEngine):
         self.mode = mode or Evaluator.get_metric_mode(metric)
         self.scheduler = scheduler
         self.batch_size = batch_size
-        rng = np.random.default_rng(self.seed)
-        configs = [hp.sample_config(search_space, rng, gp)
-                   for gp in hp.grid_points(search_space)
-                   for _ in range(n_sampling)]
-        self.trials = [Trial(i, c) for i, c in enumerate(configs)]
+        self.search_space = search_space
+        self.search_alg = search_alg
+        if search_alg in ("bayes", "tpe", "skopt", "bayesopt"):
+            self.trials = [Trial(i, {}) for i in range(int(n_sampling))]
+        else:
+            rng = np.random.default_rng(self.seed)
+            configs = [hp.sample_config(search_space, rng, gp)
+                       for gp in hp.grid_points(search_space)
+                       for _ in range(n_sampling)]
+            self.trials = [Trial(i, c) for i, c in enumerate(configs)]
         self._compiled = True
         return self
+
+    def _improved(self, v, best):
+        return v < best if self.mode == "min" else v > best
+
+    def _advance(self, trial: Trial, model, n_epochs: int,
+                 stopper: Optional[MedianStopper] = None) -> bool:
+        """Train ``n_epochs`` more epochs; returns False when the stopper
+        fired. Checkpoints track the best epoch so get_best_model()
+        restores the weights the reported metric came from."""
+        ckpt = os.path.join(self.logs_dir, f"trial_{trial.trial_id}")
+        for _ in range(n_epochs):
+            epoch = len(trial.metric_history)
+            value = float(model.fit_eval(
+                self.data, validation_data=self.validation_data,
+                epochs=1, metric=self.metric, batch_size=self.batch_size))
+            trial.metric_history.append(value)
+            if trial.best_metric is None or self._improved(value,
+                                                           trial.best_metric):
+                trial.best_metric = value
+                model.save(ckpt)
+                trial.checkpoint = ckpt
+            if stopper:
+                stopper.report(epoch, value)
+                if stopper.should_stop(epoch, value):
+                    return False
+        return True
 
     def _run_trial(self, trial: Trial, stopper: Optional[MedianStopper]):
         t0 = time.time()
         trial.status = "running"
         try:
             model = self.builder.build(trial.config)
-            improved = (lambda v, best: v < best) if self.mode == "min" \
-                else (lambda v, best: v > best)
-            ckpt = os.path.join(self.logs_dir, f"trial_{trial.trial_id}")
-            for epoch in range(self.epochs):
-                value = float(model.fit_eval(
-                    self.data, validation_data=self.validation_data,
-                    epochs=1, metric=self.metric, batch_size=self.batch_size))
-                trial.metric_history.append(value)
-                # checkpoint tracks the best epoch so get_best_model()
-                # restores the weights the reported metric came from
-                if trial.best_metric is None or improved(value,
-                                                        trial.best_metric):
-                    trial.best_metric = value
-                    model.save(ckpt)
-                    trial.checkpoint = ckpt
-                if stopper:
-                    stopper.report(epoch, value)
-                    if stopper.should_stop(epoch, value):
-                        trial.status = "stopped"
-                        break
-            if trial.status != "stopped":
-                trial.status = "done"
+            survived = self._advance(trial, model, self.epochs, stopper)
+            trial.status = "done" if survived else "stopped"
         except Exception as e:  # trial failure is data, not crash
             trial.status = "error"
             trial.error = f"{type(e).__name__}: {e}"
@@ -150,16 +283,103 @@ class LocalSearchEngine(SearchEngine):
         trial.wall_s = time.time() - t0
         return trial
 
+    def _run_halving(self, eta: int = 3):
+        """Successive halving (tune AsyncHyperBand analog): rungs at epoch
+        budgets 1, eta, eta², ...; the worst (1 - 1/eta) of the survivors
+        stop at each rung."""
+        import math as _math
+        rungs, r = [], 1
+        while r < self.epochs:
+            rungs.append(r)
+            r *= eta
+        rungs.append(self.epochs)
+
+        alive = list(self.trials)
+        models = {}
+        t0 = {t.trial_id: time.time() for t in alive}
+        for t in alive:
+            t.status = "running"
+            try:
+                models[t.trial_id] = self.builder.build(t.config)
+            except Exception as e:
+                t.status = "error"
+                t.error = f"{type(e).__name__}: {e}"
+        alive = [t for t in alive if t.status == "running"]
+        for target in rungs:
+            for t in alive:
+                try:
+                    self._advance(t, models[t.trial_id],
+                                  target - len(t.metric_history))
+                except Exception as e:
+                    t.status = "error"
+                    t.error = f"{type(e).__name__}: {e}"
+                    t.wall_s = time.time() - t0[t.trial_id]
+            alive = [t for t in alive if t.status == "running"]
+            if target < self.epochs and len(alive) > 1:
+                k = max(1, int(_math.ceil(len(alive) / eta)))
+                ranked = sorted(alive, key=lambda t: t.best_metric,
+                                reverse=(self.mode == "max"))
+                for t in ranked[k:]:
+                    t.status = "stopped"
+                    t.wall_s = time.time() - t0[t.trial_id]
+                alive = ranked[:k]
+        for t in alive:
+            t.status = "done"
+            t.wall_s = time.time() - t0[t.trial_id]
+
     def run(self) -> List[Trial]:
         if not self._compiled:
             raise RuntimeError("compile() before run()")
         os.makedirs(self.logs_dir, exist_ok=True)
+
+        if self.search_alg in ("bayes", "tpe", "skopt", "bayesopt"):
+            # sequential by construction: each proposal conditions on every
+            # previous observation — n_parallel does not apply; median
+            # stopping still does
+            if self.n_parallel not in (1, None):
+                logger.warning("search_alg='bayes' is sequential; "
+                               "n_parallel=%r ignored", self.n_parallel)
+            if self.scheduler in ("hyperband", "asha", "successive_halving"):
+                logger.warning("scheduler=%r is not supported with bayes "
+                               "search; using median stopping", self.scheduler)
+            stopper = (MedianStopper(self.mode) if self.scheduler else None)
+            searcher = BayesSearcher(self.search_space, self.mode,
+                                     seed=self.seed)
+            for t in self.trials:
+                t.config = searcher.suggest()
+                self._run_trial(t, stopper)
+                searcher.observe(t.config, t.best_metric)
+            self._write_summary()
+            return self.trials
+
+        if self.scheduler in ("hyperband", "asha", "successive_halving"):
+            if self.n_parallel not in (1, None):
+                logger.warning("successive halving runs rungs serially; "
+                               "n_parallel=%r ignored", self.n_parallel)
+            self._run_halving()
+            self._write_summary()
+            return self.trials
+
         stopper = (MedianStopper(self.mode)
                    if self.scheduler in ("median", "median_stopping") else None)
-        if self.n_parallel > 1:
-            with ThreadPoolExecutor(max_workers=self.n_parallel) as pool:
-                list(pool.map(lambda t: self._run_trial(t, stopper),
-                              self.trials))
+        n_par = self.n_parallel
+        if n_par in ("auto", 0):
+            import jax
+            n_par = len(jax.devices())
+        if n_par > 1:
+            # pack trials over mesh devices: worker i pins its trial's
+            # computations to device i mod ndev (SURVEY §7.6: trial packing
+            # instead of Ray Tune actors)
+            import jax
+            devices = jax.devices()
+
+            def worker(args):
+                i, t = args
+                with jax.default_device(devices[i % len(devices)]):
+                    return self._run_trial(t, stopper)
+
+            with ThreadPoolExecutor(max_workers=int(n_par)) as pool:
+                list(pool.map(worker, enumerate(self.trials)))
         else:
             for t in self.trials:
                 self._run_trial(t, stopper)

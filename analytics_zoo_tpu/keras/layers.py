@@ -61,7 +61,10 @@ def get_init(init: str):
         "he_uniform": nn.initializers.he_uniform(),
         "lecun_normal": nn.initializers.lecun_normal(),
         "normal": nn.initializers.normal(0.05),
-        "uniform": nn.initializers.uniform(0.05),
+        # keras-1 'uniform' is SYMMETRIC U(-0.05, 0.05); flax's
+        # initializers.uniform(s) is [0, s) — use an explicit symmetric draw
+        "uniform": (lambda key, shape, dtype=jnp.float32:
+                    jax.random.uniform(key, shape, dtype, -0.05, 0.05)),
         "zero": nn.initializers.zeros, "zeros": nn.initializers.zeros,
         "one": nn.initializers.ones, "ones": nn.initializers.ones,
     }
@@ -85,7 +88,6 @@ class Dense(KerasLayer):
         self.activation = get_activation(activation)
         self.init = get_init(init)
         self.bias = bias
-        self.input_shape = input_shape
 
     def make_module(self):
         return nn.Dense(self.output_dim, use_bias=self.bias,
@@ -464,7 +466,7 @@ class GlobalAveragePooling2D(KerasLayer):
 class ZeroPadding1D(KerasLayer):
     def __init__(self, padding: int = 1, input_shape=None, name=None):
         super().__init__(name, input_shape)
-        self.padding = _pair(padding) if not isinstance(padding, int) else (padding, padding)
+        self.padding = _pair(padding)
 
     def apply(self, module, args, train):
         return jnp.pad(args[0], ((0, 0), self.padding, (0, 0)))

@@ -110,6 +110,167 @@ class TestSearchEngine:
             eng.get_best_trial()
 
 
+class _AnalyticBuilder:
+    """Fake builder: fit_eval returns a known function of the config and
+    epoch — lets scheduler/searcher logic be tested deterministically and
+    fast (no training)."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def build(self, config):
+        builder = self
+
+        class _M:
+            def __init__(self):
+                self.epoch = 0
+
+            def fit_eval(self, data, validation_data=None, epochs=1,
+                         metric="mse", batch_size=None):
+                self.epoch += epochs
+                return builder.fn(dict(config), self.epoch)
+
+            def save(self, path):
+                pass
+
+        return _M()
+
+
+class TestBayesSearch:
+    def test_bayes_concentrates_near_optimum(self, tmp_path, orca_ctx):
+        """After the startup phase, TPE-style proposals must beat pure
+        random sampling on a sharp 1-d objective."""
+        target = 3e-3
+
+        def objective(cfg, epoch):
+            return abs(np.log10(cfg["lr"]) - np.log10(target))
+
+        space = {"lr": hp.loguniform(1e-5, 1e-1)}
+        eng = LocalSearchEngine(_AnalyticBuilder(objective),
+                                logs_dir=str(tmp_path), name="bayes", seed=7)
+        eng.compile((None, None), space, n_sampling=30, epochs=1,
+                    metric="mse", mode="min", search_alg="bayes")
+        trials = eng.run()
+        assert len(trials) == 30 and all(t.status == "done" for t in trials)
+        late = [t.best_metric for t in trials[15:]]
+        eng2 = LocalSearchEngine(_AnalyticBuilder(objective),
+                                 logs_dir=str(tmp_path), name="rand", seed=7)
+        eng2.compile((None, None), space, n_sampling=30, epochs=1,
+                     metric="mse", mode="min")
+        rand = [t.best_metric for t in eng2.run()]
+        # bayes late-phase proposals average closer to the optimum than
+        # random draws (log distance, optimum within a 4-decade space)
+        assert np.mean(late) < np.mean(rand)
+        assert eng.get_best_trial().best_metric < 0.3
+
+    def test_bayes_survives_poisoned_configs(self, tmp_path, orca_ctx):
+        def objective(cfg, epoch):
+            if cfg["lr"] > 1e-2:
+                raise RuntimeError("diverged")
+            return float(cfg["lr"])
+
+        eng = LocalSearchEngine(_AnalyticBuilder(objective),
+                                logs_dir=str(tmp_path), name="poison")
+        eng.compile((None, None), {"lr": hp.loguniform(1e-4, 1.0)},
+                    n_sampling=20, epochs=1, metric="mse", mode="min",
+                    search_alg="bayes")
+        trials = eng.run()
+        assert any(t.status == "error" for t in trials)
+        assert eng.get_best_trial().best_metric is not None
+
+
+class TestHyperband:
+    def test_successive_halving_prunes_and_keeps_best(self, tmp_path,
+                                                      orca_ctx):
+        # metric improves at a config-specific rate; the best rate must
+        # survive all rungs, most trials must stop early
+        def objective(cfg, epoch):
+            return 10.0 / (1.0 + cfg["rate"] * epoch)
+
+        space = {"rate": hp.grid_search([0.1, 0.2, 0.5, 1.0, 2.0, 5.0,
+                                         10.0, 20.0, 50.0])}
+        eng = LocalSearchEngine(_AnalyticBuilder(objective),
+                                logs_dir=str(tmp_path), name="hb")
+        eng.compile((None, None), space, n_sampling=1, epochs=9,
+                    metric="mse", mode="min", scheduler="hyperband")
+        trials = eng.run()
+        stopped = [t for t in trials if t.status == "stopped"]
+        done = [t for t in trials if t.status == "done"]
+        assert len(stopped) >= 5, "halving never pruned"
+        assert all(len(t.metric_history) < 9 for t in stopped)
+        assert any(t.config["rate"] == 50.0 for t in done)
+        best = eng.get_best_trial()
+        assert best.config["rate"] == 50.0
+        # pruned trials spent less epoch budget than survivors
+        total = sum(len(t.metric_history) for t in trials)
+        assert total < 9 * len(trials) * 0.7
+
+    def test_device_packed_parallel_trials(self, tmp_path, orca_ctx):
+        """n_parallel='auto' packs trials round-robin over the 8 virtual
+        devices; every trial completes with correct results."""
+        x, y = linear_data(128)
+        eng = LocalSearchEngine(FlaxModelBuilder(mlp_creator),
+                                logs_dir=str(tmp_path), name="pack",
+                                n_parallel="auto")
+        eng.compile((x, y), {"hidden": hp.grid_search([4, 8, 16, 32]),
+                             "lr": 1e-2, "batch_size": 64},
+                    n_sampling=1, epochs=1, metric="mse")
+        trials = eng.run()
+        assert len(trials) == 4
+        assert all(t.status == "done" for t in trials)
+        assert all(np.isfinite(t.best_metric) for t in trials)
+
+
+class TestPopulationSearch:
+    def test_vmapped_population_matches_and_beats_serial(self, tmp_path,
+                                                         orca_ctx):
+        """The fused vmap population must (a) train every member for real,
+        (b) rank learning rates sensibly, (c) beat the serial per-trial
+        loop on wall clock (compile + dispatch amortized K-fold — the
+        SURVEY §7.6 trial-packing claim)."""
+        import time
+        from analytics_zoo_tpu.automl import PopulationSearchEngine
+
+        x, y = linear_data(256)
+        K, E = 32, 6
+        space = {"lr": hp.loguniform(1e-4, 3e-2)}
+
+        eng = PopulationSearchEngine(mlp_creator, loss="mse",
+                                     logs_dir=str(tmp_path), seed=3)
+        eng.compile((x, y), space, n_sampling=K, epochs=E, metric="mse",
+                    batch_size=64)
+        t0 = time.time()
+        trials = eng.run()
+        pop_wall = time.time() - t0
+        assert len(trials) == K
+        assert all(t.status == "done" for t in trials)
+        assert all(len(t.metric_history) == E for t in trials)
+        metrics = np.array([t.best_metric for t in trials])
+        assert np.isfinite(metrics).all()
+        assert len(set(np.round(metrics, 6))) > 1, "members identical"
+        # the best member actually learned the linear map
+        assert eng.get_best_trial().best_metric < np.var(y)
+        params = eng.get_best_params()
+        assert params is not None
+
+        # serial baseline: same creator, same trial count, same epochs
+        serial = LocalSearchEngine(FlaxModelBuilder(mlp_creator),
+                                   logs_dir=str(tmp_path), name="serial",
+                                   seed=3)
+        serial.compile((x, y), {"lr": hp.loguniform(1e-4, 3e-2),
+                                "batch_size": 64},
+                       n_sampling=K, epochs=E, metric="mse")
+        t0 = time.time()
+        serial.run()
+        serial_wall = time.time() - t0
+        speedup = serial_wall / max(pop_wall, 1e-9)
+        # measured ~5x on an idle single-core host (population cost is
+        # nearly flat in K — one compile, one dispatch per epoch); the
+        # assert keeps a wide margin so machine load can't flake it
+        assert speedup > 1.5, \
+            f"population packing only {speedup:.1f}x vs serial"
+
+
 class TestAutoEstimator:
     def test_fit_search_restores_best(self, tmp_path, orca_ctx):
         x, y = linear_data()
