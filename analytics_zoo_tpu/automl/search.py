@@ -366,6 +366,7 @@ class LocalSearchEngine(SearchEngine):
         if n_par in ("auto", 0):
             import jax
             n_par = len(jax.devices())
+        n_par = int(n_par or 1)
         if n_par > 1:
             # pack trials over mesh devices: worker i pins its trial's
             # computations to device i mod ndev (SURVEY §7.6: trial packing

