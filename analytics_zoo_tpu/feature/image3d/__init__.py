@@ -1,0 +1,9 @@
+from analytics_zoo_tpu.feature.image3d.transforms import (  # noqa: F401
+    AffineTransform3D,
+    CenterCrop3D,
+    Crop3D,
+    ImagePreprocessing3D,
+    RandomCrop3D,
+    Rotate3D,
+    rotation_matrix,
+)

@@ -1,0 +1,213 @@
+"""Tests for 3D image transforms (ref pyzoo/test/zoo/feature/image3d) and
+the parquet image dataset (ref pyzoo/test/zoo/orca/data/test_parquet_*)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.feature.image3d import (
+    AffineTransform3D, CenterCrop3D, Crop3D, RandomCrop3D, Rotate3D,
+    rotation_matrix,
+)
+from analytics_zoo_tpu.data.image import (
+    Image, NDarray, ParquetDataset, Scalar, write_from_directory,
+    write_mnist, write_ndarrays,
+)
+
+
+def _volume(d=8, h=10, w=12, seed=0):
+    return np.random.RandomState(seed).rand(d, h, w).astype(np.float32)
+
+
+class TestCrop3D:
+    def test_fixed_crop(self):
+        v = _volume()
+        out = Crop3D(start=[1, 2, 3], patch_size=[4, 5, 6]).apply_image(v)
+        np.testing.assert_array_equal(out, v[1:5, 2:7, 3:9])
+
+    def test_fixed_crop_out_of_range_raises(self):
+        with pytest.raises(ValueError, match="exceeds"):
+            Crop3D([6, 0, 0], [4, 4, 4]).apply_image(_volume())
+
+    def test_center_crop(self):
+        v = _volume()
+        out = CenterCrop3D(4, 4, 4).apply_image(v)
+        np.testing.assert_array_equal(out, v[2:6, 3:7, 4:8])
+
+    def test_random_crop_shape_and_content(self):
+        v = _volume()
+        out = RandomCrop3D(4, 5, 6).apply_image(v)
+        assert out.shape == (4, 5, 6)
+        # the patch exists somewhere in the volume
+        found = any(
+            np.array_equal(v[z:z + 4, y:y + 5, x:x + 6], out)
+            for z in range(5) for y in range(6) for x in range(7))
+        assert found
+
+    def test_feature_dict_and_chaining(self):
+        v = _volume()
+        pipeline = Crop3D([0, 0, 0], [6, 6, 6]) > CenterCrop3D(4, 4, 4)
+        out = pipeline({"image": v})
+        assert out["image"].shape == (4, 4, 4)
+
+
+class TestAffine3D:
+    def test_identity_is_noop(self):
+        v = _volume()
+        out = AffineTransform3D(np.eye(3)).apply_image(v)
+        np.testing.assert_allclose(out, v, atol=1e-5)
+
+    def test_translation_shifts(self):
+        v = _volume()
+        # dst(z) = src(z + 1): shift content up by one plane
+        out = AffineTransform3D(np.eye(3),
+                                translation=[1, 0, 0]).apply_image(v)
+        np.testing.assert_allclose(out[:-1], v[1:], atol=1e-5)
+
+    def test_padding_mode(self):
+        v = np.ones((4, 4, 4), np.float32)
+        out = AffineTransform3D(np.eye(3), translation=[10, 0, 0],
+                                clamp_mode="padding",
+                                pad_val=-3.0).apply_image(v)
+        np.testing.assert_allclose(out, -3.0)
+
+    def test_clamp_vs_padding_validation(self):
+        with pytest.raises(ValueError, match="pad_val"):
+            AffineTransform3D(np.eye(3), clamp_mode="clamp", pad_val=1.0)
+        with pytest.raises(ValueError, match="clamp_mode"):
+            AffineTransform3D(np.eye(3), clamp_mode="weird")
+
+    def test_channels_last_volume(self):
+        v = np.random.RandomState(1).rand(5, 6, 7, 2).astype(np.float32)
+        out = AffineTransform3D(np.eye(3)).apply_image(v)
+        assert out.shape == v.shape
+        np.testing.assert_allclose(out, v, atol=1e-5)
+
+
+class TestRotate3D:
+    def test_quarter_yaw_matches_numpy_rot(self):
+        """A 90° rotation about z equals an axis transpose+flip of the
+        (z, y, x) volume — exact up to interpolation at the grid points."""
+        v = _volume(6, 8, 8, seed=2)
+        out = Rotate3D([np.pi / 2, 0.0, 0.0]).apply_image(v)
+        # rotation about z mixes the (y, x) plane; compare against numpy
+        want = np.stack([np.rot90(v[z], k=1) for z in range(v.shape[0])])
+        np.testing.assert_allclose(out, want, atol=1e-4)
+
+    def test_full_turn_is_identity(self):
+        v = _volume(6, 6, 6, seed=3)
+        out = Rotate3D([2 * np.pi, 0, 0]).apply_image(v)
+        np.testing.assert_allclose(out, v, atol=1e-4)
+
+    def test_rotation_matrix_orthonormal(self):
+        m = rotation_matrix(0.3, -0.7, 1.1)
+        np.testing.assert_allclose(m @ m.T, np.eye(3), atol=1e-12)
+        assert np.linalg.det(m) == pytest.approx(1.0)
+
+
+class TestParquetDataset:
+    def _write_images(self, tmp_path, n=6):
+        from PIL import Image as PILImage
+        img_dir = tmp_path / "imgs"
+        for cls in ("cat", "dog"):
+            os.makedirs(img_dir / cls, exist_ok=True)
+        rng = np.random.RandomState(0)
+        for i in range(n):
+            cls = "cat" if i % 2 == 0 else "dog"
+            arr = rng.randint(0, 255, (8, 8, 3), dtype=np.uint8)
+            PILImage.fromarray(arr).save(img_dir / cls / f"{i}.png")
+        return str(img_dir)
+
+    def test_write_read_roundtrip_all_field_kinds(self, tmp_path, orca_ctx):
+        from PIL import Image as PILImage
+        img_path = str(tmp_path / "one.png")
+        arr = np.arange(48, dtype=np.uint8).reshape(4, 4, 3)
+        PILImage.fromarray(arr).save(img_path)
+
+        schema = {"id": Scalar("int64"), "feat": NDarray("float32"),
+                  "img": Image()}
+        rng = np.random.RandomState(1)
+        feats = rng.rand(5, 3).astype(np.float32)
+
+        def gen():
+            for i in range(5):
+                yield {"id": i, "feat": feats[i], "img": img_path}
+
+        out = str(tmp_path / "pq")
+        ParquetDataset.write(out, gen(), schema, block_size=2)
+        shards = ParquetDataset.read_as_xshards(out)
+        assert shards.num_partitions() == 3  # 2+2+1
+        data = shards.collect()
+        np.testing.assert_array_equal(
+            np.concatenate([d["id"] for d in data]), np.arange(5))
+        np.testing.assert_allclose(
+            np.concatenate([d["feat"] for d in data]), feats)
+        # image decoded losslessly (png)
+        np.testing.assert_array_equal(data[0]["img"][0], arr)
+
+    def test_write_mode_guard(self, tmp_path, orca_ctx):
+        out = str(tmp_path / "pq")
+        schema = {"id": Scalar("int64")}
+        ParquetDataset.write(out, iter([{"id": 1}]), schema)
+        with pytest.raises(FileExistsError):
+            ParquetDataset.write(out, iter([{"id": 2}]), schema,
+                                 write_mode="errorifexists")
+        ParquetDataset.write(out, iter([{"id": 3}]), schema)  # overwrite
+        data = ParquetDataset.read_as_xshards(out).collect()
+        assert list(data[0]["id"]) == [3]
+
+    def test_write_from_directory_and_train(self, tmp_path, orca_ctx):
+        """Image-tree → parquet → ShardedDataset → one Estimator epoch:
+        the reference's dataset-creation use case end-to-end."""
+        import flax.linen as nn
+        from analytics_zoo_tpu.learn.estimator import Estimator
+
+        img_dir = self._write_images(tmp_path, n=8)
+        out = str(tmp_path / "pq")
+        write_from_directory(img_dir, {"cat": 0, "dog": 1}, out,
+                             block_size=4)
+        ds = ParquetDataset.read_as_dataset(out, "image", "label")
+        assert ds.n == 8
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = x.astype(np.float32) / 255.0
+                return nn.Dense(2)(x.reshape(x.shape[0], -1))
+
+        est = Estimator.from_flax(
+            model=Net(), loss="sparse_categorical_crossentropy_logits",
+            optimizer="adam",
+            sample_input=np.zeros((2, 8, 8, 3), np.float32))
+        h = est.fit(ds, epochs=1, batch_size=8)
+        assert np.isfinite(h["loss"][0])
+
+    def test_row_iterator(self, tmp_path, orca_ctx):
+        out = str(tmp_path / "pq")
+        write_ndarrays(np.arange(12, dtype=np.float32).reshape(6, 2),
+                       np.arange(6, dtype=np.int64), out, block_size=4)
+        rows = list(ParquetDataset.read_as_torch(out)())
+        assert len(rows) == 6
+        np.testing.assert_allclose(rows[3]["image"], [6.0, 7.0])
+        assert rows[3]["label"] == 3
+
+    def test_write_mnist(self, tmp_path, orca_ctx):
+        # craft tiny IDX files
+        n, r, c = 4, 3, 3
+        images = np.arange(n * r * c, dtype=np.uint8).reshape(n, r, c)
+        labels = np.array([0, 1, 2, 3], np.uint8)
+        img_f, lbl_f = str(tmp_path / "img"), str(tmp_path / "lbl")
+        with open(img_f, "wb") as f:
+            for v in (2051, n, r, c):
+                f.write(int(v).to_bytes(4, "big"))
+            f.write(images.tobytes())
+        with open(lbl_f, "wb") as f:
+            for v in (2049, n):
+                f.write(int(v).to_bytes(4, "big"))
+            f.write(labels.tobytes())
+        out = str(tmp_path / "mnist")
+        write_mnist(img_f, lbl_f, out)
+        data = ParquetDataset.read_as_xshards(out).collect()
+        np.testing.assert_array_equal(data[0]["image"], images)
+        np.testing.assert_array_equal(data[0]["label"], labels)
