@@ -14,15 +14,23 @@ EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
 # the test env (CPU platform via conftest env vars)
 ALL = ["recommendation_ncf.py", "anomaly_detection.py",
        "autots_forecast.py", "cluster_serving.py", "torch_migration.py",
-       "distributed_training.py"]
+       "distributed_training.py", "dogs_vs_cats_transfer.py",
+       "sentiment_analysis.py", "vae.py"]
 
 
 @pytest.mark.parametrize("script", ALL)
 def test_example_runs(script):
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
+    # a sitecustomize may initialize a real accelerator backend regardless
+    # of JAX_PLATFORMS (same failure mode as __graft_entry__): force the
+    # CPU platform through the config API before the example runs
+    launcher = (
+        "import jax, runpy, sys; "
+        "jax.config.update('jax_platforms', 'cpu'); "
+        "runpy.run_path(sys.argv[1], run_name='__main__')")
     proc = subprocess.run(
-        [sys.executable, os.path.join(EXAMPLES, script)],
+        [sys.executable, "-c", launcher, os.path.join(EXAMPLES, script)],
         capture_output=True, text=True, timeout=900, env=env)
     assert proc.returncode == 0, (
         f"{script} failed:\nstdout:\n{proc.stdout[-2000:]}\n"
