@@ -524,7 +524,8 @@ class JaxEstimator:
             checkpoint_trigger: Optional[Trigger] = None,
             summary_interval: int = 20,
             shuffle: bool = True,
-            steps_per_loop: int = 1) -> Dict[str, List[float]]:
+            steps_per_loop: int = 1,
+            profile: bool = False) -> Dict[str, List[float]]:
         """(ref orca/learn/tf/estimator.py fit:486; batch_size is the GLOBAL
         batch — the reference required batch_size % num_workers == 0, here it
         must divide the data-axis size of the mesh).
@@ -532,7 +533,13 @@ class JaxEstimator:
         ``steps_per_loop > 1`` fuses that many optimizer steps into one
         compiled ``lax.scan`` dispatch — a large win for small models where
         per-step launch overhead dominates. Checkpoint triggers are then
-        evaluated once per loop, not per step."""
+        evaluated once per loop, not per step.
+
+        ``profile=True`` wraps the run in ``jax.profiler.trace`` (the TPU
+        analog of the reference's coarse stage timers, SURVEY §5 —
+        Utils.timeIt / serving Timer.scala): trace files land in
+        ``<tensorboard dir>/plugins/profile`` next to the TF-events
+        summaries, viewable in TensorBoard's profile tab or Perfetto."""
         ds = self._coerce(to_sharded_dataset(data, feature_cols, label_cols))
         val_ds = (self._coerce(to_sharded_dataset(validation_data, feature_cols,
                                                   label_cols))
@@ -547,34 +554,49 @@ class JaxEstimator:
         retries = 0
         target_epoch = self._epoch + epochs
 
-        while self._epoch < target_epoch:
-            try:
-                epoch_loss = self._run_epoch(
-                    ds, mesh, batch_size, shuffle, summary_interval,
-                    train_writer, checkpoint_trigger,
-                    steps_per_loop=steps_per_loop)
-            except Exception:
-                # elastic retry-from-snapshot (ref Topology.scala:1255-1337)
-                retries += 1
-                if not self.model_dir or retries > self.failure_retry_times:
-                    raise
-                found = ckpt_lib.find_latest_checkpoint(self.model_dir)
-                if found is None:
-                    raise
-                logger.exception("training step failed; retry %d/%d from %s",
-                                 retries, self.failure_retry_times, found[0])
-                self.load_orca_checkpoint(found[0])
-                continue
-            history["loss"].append(epoch_loss)
-            self._epoch += 1
-            if val_ds is not None:
-                val = self.evaluate(val_ds, batch_size=batch_size)
-                for k, v in val.items():
-                    history.setdefault("val_" + k, []).append(v)
-                    self._val_writer.add_scalar(k, v, self._py_step)
-            if checkpoint_trigger and self.model_dir and \
-                    checkpoint_trigger(self._epoch, self._py_step, epoch_loss):
-                self._save_snapshot()
+        profiling = False
+        if profile:
+            import jax
+            jax.profiler.start_trace(self._tb_dirs[0])
+            profiling = True
+            logger.info("jax profiler tracing to %s", self._tb_dirs[0])
+
+        try:
+            while self._epoch < target_epoch:
+                try:
+                    epoch_loss = self._run_epoch(
+                        ds, mesh, batch_size, shuffle, summary_interval,
+                        train_writer, checkpoint_trigger,
+                        steps_per_loop=steps_per_loop)
+                except Exception:
+                    # elastic retry-from-snapshot (ref Topology.scala:1255-1337)
+                    retries += 1
+                    if not self.model_dir or \
+                            retries > self.failure_retry_times:
+                        raise
+                    found = ckpt_lib.find_latest_checkpoint(self.model_dir)
+                    if found is None:
+                        raise
+                    logger.exception(
+                        "training step failed; retry %d/%d from %s",
+                        retries, self.failure_retry_times, found[0])
+                    self.load_orca_checkpoint(found[0])
+                    continue
+                history["loss"].append(epoch_loss)
+                self._epoch += 1
+                if val_ds is not None:
+                    val = self.evaluate(val_ds, batch_size=batch_size)
+                    for k, v in val.items():
+                        history.setdefault("val_" + k, []).append(v)
+                        self._val_writer.add_scalar(k, v, self._py_step)
+                if checkpoint_trigger and self.model_dir and \
+                        checkpoint_trigger(self._epoch, self._py_step,
+                                           epoch_loss):
+                    self._save_snapshot()
+        finally:
+            if profiling:
+                import jax
+                jax.profiler.stop_trace()
         train_writer.flush()
         if self._val_writer:
             self._val_writer.flush()

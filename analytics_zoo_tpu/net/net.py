@@ -42,13 +42,8 @@ class Net:
 
     @staticmethod
     def load_onnx(path: str):
-        """ONNX import (ref pyzoo onnx_loader.py:141). Gated: the ``onnx``
-        package is not part of the baked environment."""
-        try:
-            import onnx  # noqa: F401
-        except ImportError as e:
-            raise ImportError(
-                "ONNX import needs the optional 'onnx' package; convert the "
-                "model to torch and use Net.load_torch instead") from e
-        raise NotImplementedError(
-            "onnx runtime translation is not wired yet; use Net.load_torch")
+        """ONNX import (ref pyzoo onnx_loader.py:141): parses the ONNX
+        protobuf directly (no onnx package needed) and translates the node
+        graph to a jitted jax function — see net/onnx_net.py."""
+        from analytics_zoo_tpu.net.onnx_net import ONNXNet
+        return ONNXNet(path)

@@ -175,6 +175,20 @@ def test_retry_gives_up_after_budget(orca_ctx, tmp_path):
     assert calls["failures"] == est.failure_retry_times + 1
 
 
+def test_profile_writes_trace(orca_ctx, tmp_path):
+    """fit(profile=True) must produce jax profiler trace artifacts next to
+    the tensorboard summaries (SURVEY §5 tracing analog)."""
+    from analytics_zoo_tpu.learn.estimator import Estimator
+    x, y = _reg_data(n=64)
+    est = Estimator.from_flax(model=MLP(), loss="mse", sample_input=x[:2])
+    est.set_tensorboard(str(tmp_path), "prof")
+    est.fit((x, y), epochs=1, batch_size=32, profile=True)
+    trace_root = tmp_path / "prof" / "train"
+    found = [p for p in trace_root.rglob("*") if p.is_file()
+             and ("trace" in p.name or p.suffix in (".pb", ".gz", ".json"))]
+    assert found, f"no profiler trace files under {trace_root}"
+
+
 def test_gradient_clipping(orca_ctx):
     from analytics_zoo_tpu.learn.estimator import Estimator
     x, y = _reg_data(n=64)

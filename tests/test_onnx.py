@@ -1,0 +1,280 @@
+"""ONNX loader tests (ref pyzoo/test/zoo/pipeline/api/onnx tests).
+
+No ``onnx`` package exists in this environment, so the test ENCODES ONNX
+ModelProto bytes by hand following the public onnx.proto wire format —
+the loader must parse the spec, not a mirror of itself — and checks the
+translated jax graph numerically against numpy/torch references.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.net import Net, ONNXNet, onnx_to_jax
+
+
+# ---------------------------------------------------------- proto encoder
+
+def _varint(v: int) -> bytes:
+    v &= (1 << 64) - 1  # negatives: 10-byte two's complement per protobuf
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_field(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _int_field(field: int, v: int) -> bytes:
+    return _tag(field, 0) + _varint(v)
+
+
+def _float_field(field: int, v: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", v)
+
+
+def tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    dtype_code = {np.dtype("float32"): 1, np.dtype("int64"): 7}[arr.dtype]
+    out = b""
+    for d in arr.shape:
+        out += _int_field(1, d)
+    out += _int_field(2, dtype_code)
+    out += _len_field(8, name.encode())
+    out += _len_field(9, arr.tobytes())          # raw_data
+    return out
+
+
+def attr_int(name: str, v: int) -> bytes:
+    return _len_field(1, name.encode()) + _int_field(4, v) \
+        + _int_field(20, 2)                      # type = INT
+
+
+def attr_ints(name: str, vals) -> bytes:
+    out = _len_field(1, name.encode())
+    for v in vals:
+        out += _int_field(8, v)
+    return out + _int_field(20, 7)               # type = INTS
+
+
+def attr_float(name: str, v: float) -> bytes:
+    return _len_field(1, name.encode()) + _tag(3, 5) \
+        + struct.pack("<f", v) + _int_field(20, 1)
+
+
+def node(op: str, inputs, outputs, attrs=()) -> bytes:
+    out = b""
+    for i in inputs:
+        out += _len_field(1, i.encode())
+    for o in outputs:
+        out += _len_field(2, o.encode())
+    out += _len_field(4, op.encode())
+    for a in attrs:
+        out += _len_field(5, a)
+    return out
+
+
+def value_info(name: str) -> bytes:
+    return _len_field(1, name.encode())
+
+
+def model_proto(nodes, initializers, inputs, outputs) -> bytes:
+    graph = b""
+    for n in nodes:
+        graph += _len_field(1, n)
+    graph += _len_field(2, b"g")
+    for t in initializers:
+        graph += _len_field(5, t)
+    for i in inputs:
+        graph += _len_field(11, value_info(i))
+    for o in outputs:
+        graph += _len_field(12, value_info(o))
+    return _int_field(1, 8) + _len_field(7, graph)   # ir_version + graph
+
+
+# ---------------------------------------------------------------- tests
+
+class TestOnnxMLP:
+    def _mlp_bytes(self, w1, b1, w2, b2):
+        nodes = [
+            node("Gemm", ["x", "w1", "b1"], ["h"]),
+            node("Relu", ["h"], ["a"]),
+            node("Gemm", ["a", "w2", "b2"], ["y"],
+                 attrs=[attr_float("alpha", 1.0)]),
+            node("Softmax", ["y"], ["p"], attrs=[attr_int("axis", -1)]),
+        ]
+        inits = [tensor_proto("w1", w1), tensor_proto("b1", b1),
+                 tensor_proto("w2", w2), tensor_proto("b2", b2)]
+        return model_proto(nodes, inits, ["x", "w1", "b1", "w2", "b2"],
+                           ["p"])
+
+    def test_mlp_matches_numpy(self, orca_ctx, tmp_path):
+        rng = np.random.RandomState(0)
+        w1 = rng.randn(4, 8).astype(np.float32)
+        b1 = rng.randn(8).astype(np.float32)
+        w2 = rng.randn(8, 3).astype(np.float32)
+        b2 = rng.randn(3).astype(np.float32)
+        data = self._mlp_bytes(w1, b1, w2, b2)
+
+        x = rng.randn(5, 4).astype(np.float32)
+        h = np.maximum(x @ w1 + b1, 0)
+        logits = h @ w2 + b2
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        want = e / e.sum(-1, keepdims=True)
+
+        net = ONNXNet(data)
+        np.testing.assert_allclose(net.predict(x), want, atol=1e-5)
+        # params surfaced as a real pytree (trainable downstream)
+        assert set(net.params) == {"w1", "b1", "w2", "b2"}
+
+        # file path + Net.load_onnx entry point
+        p = str(tmp_path / "m.onnx")
+        with open(p, "wb") as fh:
+            fh.write(data)
+        np.testing.assert_allclose(Net.load_onnx(p).predict(x), want,
+                                   atol=1e-5)
+
+    def test_gemm_transB_and_matmul_add(self, orca_ctx):
+        rng = np.random.RandomState(1)
+        w = rng.randn(3, 4).astype(np.float32)   # transB: y = x @ w.T
+        b = rng.randn(3).astype(np.float32)
+        nodes = [node("Gemm", ["x", "w", "b"], ["g"],
+                      attrs=[attr_int("transB", 1)]),
+                 node("MatMul", ["g", "m"], ["mm"]),
+                 node("Add", ["mm", "c"], ["y"])]
+        m = rng.randn(3, 2).astype(np.float32)
+        c = rng.randn(2).astype(np.float32)
+        data = model_proto(
+            nodes, [tensor_proto("w", w), tensor_proto("b", b),
+                    tensor_proto("m", m), tensor_proto("c", c)],
+            ["x", "w", "b", "m", "c"], ["y"])
+        x = rng.randn(6, 4).astype(np.float32)
+        want = (x @ w.T + b) @ m + c
+        np.testing.assert_allclose(ONNXNet(data).predict(x), want,
+                                   atol=1e-5)
+
+
+class TestOnnxConvNet:
+    def test_conv_pool_bn_matches_torch(self, orca_ctx):
+        torch = pytest.importorskip("torch")
+        import torch.nn.functional as F
+
+        rng = np.random.RandomState(2)
+        w = rng.randn(5, 3, 3, 3).astype(np.float32) * 0.3
+        b = rng.randn(5).astype(np.float32)
+        scale = rng.rand(5).astype(np.float32) + 0.5
+        bias = rng.randn(5).astype(np.float32)
+        mean = rng.randn(5).astype(np.float32)
+        var = rng.rand(5).astype(np.float32) + 0.5
+
+        nodes = [
+            node("Conv", ["x", "w", "b"], ["c"],
+                 attrs=[attr_ints("kernel_shape", [3, 3]),
+                        attr_ints("strides", [1, 1]),
+                        attr_ints("pads", [1, 1, 1, 1])]),
+            node("BatchNormalization",
+                 ["c", "scale", "bias", "mean", "var"], ["n"],
+                 attrs=[attr_float("epsilon", 1e-5)]),
+            node("Relu", ["n"], ["r"]),
+            node("MaxPool", ["r"], ["p"],
+                 attrs=[attr_ints("kernel_shape", [2, 2]),
+                        attr_ints("strides", [2, 2])]),
+            node("GlobalAveragePool", ["p"], ["gap"]),
+            node("Flatten", ["gap"], ["y"], attrs=[attr_int("axis", 1)]),
+        ]
+        inits = [tensor_proto("w", w), tensor_proto("b", b),
+                 tensor_proto("scale", scale), tensor_proto("bias", bias),
+                 tensor_proto("mean", mean), tensor_proto("var", var)]
+        data = model_proto(nodes, inits,
+                           ["x", "w", "b", "scale", "bias", "mean", "var"],
+                           ["y"])
+
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        tx = torch.from_numpy(x)
+        t = F.conv2d(tx, torch.from_numpy(w), torch.from_numpy(b),
+                     padding=1)
+        t = F.batch_norm(t, torch.from_numpy(mean), torch.from_numpy(var),
+                         torch.from_numpy(scale), torch.from_numpy(bias),
+                         training=False, eps=1e-5)
+        t = F.max_pool2d(F.relu(t), 2)
+        want = t.mean(dim=(2, 3)).numpy()
+        np.testing.assert_allclose(ONNXNet(data).predict(x), want,
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestOnnxSemantics:
+    def test_omitted_zero_attr_and_variadic_sum(self, orca_ctx):
+        """proto3 omits i=0 on the wire: an axis=0 attribute arrives as
+        name+type only and must decode as 0, not None; Sum takes any number
+        of inputs."""
+        axis0 = _len_field(1, b"axis") + _int_field(20, 2)  # type=INT, no i
+        nodes = [node("Concat", ["x", "x"], ["c"], attrs=[axis0]),
+                 node("Sum", ["c", "c", "c"], ["y"])]
+        data = model_proto(nodes, [], ["x"], ["y"])
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        want = 3 * np.concatenate([x, x], axis=0)
+        np.testing.assert_allclose(ONNXNet(data).predict(x), want)
+
+    def test_flatten_is_always_2d(self, orca_ctx):
+        data = model_proto([node("Flatten", ["x"], ["y"],
+                                 attrs=[attr_int("axis", 2)])],
+                           [], ["x"], ["y"])
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 2, 2)
+        out = ONNXNet(data).predict(x)
+        assert out.shape == (6, 4)
+        np.testing.assert_allclose(out, x.reshape(6, 4))
+
+    def test_avgpool_excludes_padding_by_default(self, orca_ctx):
+        torch = pytest.importorskip("torch")
+        import torch.nn.functional as F
+        data = model_proto(
+            [node("AveragePool", ["x"], ["y"],
+                  attrs=[attr_ints("kernel_shape", [2, 2]),
+                         attr_ints("strides", [2, 2]),
+                         attr_ints("pads", [1, 1, 1, 1])])],
+            [], ["x"], ["y"])
+        x = np.random.RandomState(3).randn(1, 2, 4, 4).astype(np.float32)
+        want = F.avg_pool2d(torch.from_numpy(x), 2, 2, padding=1,
+                            count_include_pad=False).numpy()
+        np.testing.assert_allclose(ONNXNet(data).predict(x), want,
+                                   atol=1e-5)
+
+    def test_conv_auto_pad_same_upper(self, orca_ctx):
+        torch = pytest.importorskip("torch")
+        import torch.nn.functional as F
+        rng = np.random.RandomState(4)
+        w = rng.randn(4, 3, 3, 3).astype(np.float32) * 0.3
+        auto = _len_field(1, b"auto_pad") + _len_field(5, b"SAME_UPPER") \
+            + _int_field(20, 3)
+        data = model_proto(
+            [node("Conv", ["x", "w"], ["y"],
+                  attrs=[attr_ints("kernel_shape", [3, 3]), auto])],
+            [tensor_proto("w", w)], ["x", "w"], ["y"])
+        x = rng.randn(2, 3, 7, 7).astype(np.float32)
+        want = F.conv2d(torch.from_numpy(x), torch.from_numpy(w),
+                        padding="same").numpy()
+        got = ONNXNet(data).predict(x)
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+class TestOnnxErrors:
+    def test_unknown_op_raises(self, orca_ctx):
+        data = model_proto([node("FancyOp", ["x"], ["y"])], [], ["x"],
+                           ["y"])
+        with pytest.raises(NotImplementedError, match="FancyOp"):
+            ONNXNet(data).predict(np.zeros((1, 2), np.float32))
+
+    def test_not_onnx_raises(self):
+        with pytest.raises(ValueError, match="ModelProto"):
+            onnx_to_jax(_int_field(3, 7))
