@@ -5,6 +5,11 @@ from analytics_zoo_tpu.models.image.objectdetection.bbox_util import (
     iou_matrix,
     nms,
 )
+from analytics_zoo_tpu.models.image.objectdetection.evaluation import (
+    Visualizer,
+    average_precision,
+    mean_average_precision,
+)
 from analytics_zoo_tpu.models.image.objectdetection.multibox_loss import (
     MultiBoxLoss,
 )
@@ -16,4 +21,5 @@ from analytics_zoo_tpu.models.image.objectdetection.object_detector import (
 __all__ = [
     "generate_anchors", "iou_matrix", "encode_targets", "decode_boxes",
     "nms", "MultiBoxLoss", "SSDLite", "ObjectDetector",
+    "mean_average_precision", "average_precision", "Visualizer",
 ]

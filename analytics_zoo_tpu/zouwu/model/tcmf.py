@@ -27,12 +27,19 @@ class TCMFForecaster:
 
     def __init__(self, k: int = 8, lam: float = 1e-3, ar_order: int = 8,
                  lr: float = 0.05, basis_forecaster: str = "ar",
+                 use_local: bool = False, local_lookback: int = 16,
                  seed: int = 0):
         self.k, self.lam, self.ar_order, self.lr = k, lam, ar_order, lr
         self.basis_forecaster = basis_forecaster
+        # DeepGLO hybrid: a local temporal net on the residuals Y - F@X
+        # refines the global forecast (ref tcmf: global MF + per-series
+        # local TCN combination)
+        self.use_local = use_local
+        self.local_lookback = int(local_lookback)
         self.seed = seed
         self.F: Optional[np.ndarray] = None
         self.X: Optional[np.ndarray] = None
+        self._local = None
 
     def fit(self, y: np.ndarray, num_steps: int = 300) -> float:
         """y: [n_series, T]. Returns final reconstruction MSE."""
@@ -65,7 +72,69 @@ class TCMFForecaster:
             params, opt_state, loss = step(params, opt_state)
         self.F = np.asarray(params["F"])
         self.X = np.asarray(params["X"])
+        if self.use_local:
+            self._fit_local(np.asarray(y))
         return float(jnp.mean((params["F"] @ params["X"] - y) ** 2))
+
+    # ---- DeepGLO hybrid local model over residuals ----
+    def _fit_local(self, y: np.ndarray, epochs: int = 3):
+        """Train a TCN on residual windows pooled across series (ref
+        DeepGLO's local network refining the global factorization)."""
+        from analytics_zoo_tpu.zouwu.model.forecast import TCNForecaster
+
+        resid = y - self.F @ self.X                       # [n, T]
+        p = min(self.local_lookback, resid.shape[1] - 2)
+        if p < 2:
+            self._local = None
+            return
+        xs, ys = [], []
+        for row in resid:
+            # window starts 0..T-p-1 inclusive: the final window targets
+            # row[T-1], the freshest residual the TCN must extrapolate
+            for s in range(0, len(row) - p, max(1, p // 4)):
+                xs.append(row[s:s + p, None])
+                ys.append(row[s + p:s + p + 1])
+        self._local = TCNForecaster(future_seq_len=1,
+                                    num_channels=(16, 16), kernel_size=3)
+        self._local.fit(np.asarray(xs, np.float32),
+                        np.asarray(ys, np.float32), epochs=epochs,
+                        batch_size=min(64, len(xs)))
+        self._resid_hist = resid
+
+    def _local_forecast(self, horizon: int) -> np.ndarray:
+        """Roll the residual TCN forward per series — [n, horizon]."""
+        if self._local is None:
+            return 0.0
+        p = min(self.local_lookback, self._resid_hist.shape[1] - 2)
+        hist = self._resid_hist[:, -p:].astype(np.float32)  # [n, p]
+        outs = []
+        for _ in range(horizon):
+            nxt = self._local.predict(hist[..., None])      # [n, 1]
+            nxt = np.asarray(nxt).reshape(-1, 1)
+            outs.append(nxt)
+            hist = np.concatenate([hist[:, 1:], nxt], axis=1)
+        return np.concatenate(outs, axis=1)
+
+    def fit_incremental(self, y_new: np.ndarray) -> None:
+        """Extend the temporal basis for new observations with F FIXED:
+        each new column solves the ridge system
+        ``(FᵀF + λI) x_t = Fᵀ y_t`` in closed form
+        (ref TCMF.fit_incremental: update X on incoming data without
+        re-factorizing)."""
+        if self.F is None:
+            raise RuntimeError("call fit first")
+        y_new = np.asarray(y_new, np.float32)
+        if y_new.ndim != 2 or y_new.shape[0] != self.F.shape[0]:
+            raise ValueError(
+                f"y_new must be [n_series={self.F.shape[0]}, t_new], "
+                f"got {y_new.shape}")
+        g = self.F.T @ self.F + self.lam * np.eye(self.k, dtype=np.float32)
+        x_new = np.linalg.solve(g, self.F.T @ y_new)      # [k, t_new]
+        self.X = np.concatenate([self.X, x_new], axis=1)
+        if self.use_local and self._local is not None:
+            resid = y_new - self.F @ x_new
+            self._resid_hist = np.concatenate([self._resid_hist, resid],
+                                              axis=1)
 
     def _forecast_basis_ar(self, horizon: int) -> np.ndarray:
         """Closed-form AR(p) per factor row, rolled forward ``horizon``."""
@@ -115,7 +184,10 @@ class TCMFForecaster:
             xf = self._forecast_basis_tcn(horizon)
         else:
             xf = self._forecast_basis_ar(horizon)
-        return self.F @ xf
+        out = self.F @ xf
+        if self.use_local:
+            out = out + self._local_forecast(horizon)
+        return out
 
     def evaluate(self, y_true: np.ndarray, metrics=("mse",)) -> dict:
         from analytics_zoo_tpu.automl.metrics import Evaluator

@@ -235,3 +235,70 @@ class TestSSD:
         bad[0, 0, 4] = 5.0            # background at the positive anchor
         assert float(loss(jnp.asarray(y_true), jnp.asarray(good))) < \
             float(loss(jnp.asarray(y_true), jnp.asarray(bad)))
+
+
+class TestDetectionEvaluation:
+    """mAP + visualizer (ref MeanAveragePrecision validation +
+    Visualizer.scala)."""
+
+    def test_average_precision_known_curve(self):
+        from analytics_zoo_tpu.models.image.objectdetection import (
+            average_precision,
+        )
+        rec = np.array([0.5, 1.0])
+        prec = np.array([1.0, 0.5])
+        # area metric: 0.5*1.0 + 0.5*0.5 = 0.75
+        assert average_precision(rec, prec) == pytest.approx(0.75)
+        # 11-point: p(0..0.5)=1.0 (6 pts), p(0.6..1.0)=0.5 (5 pts)
+        ap07 = average_precision(rec, prec, use_07_metric=True)
+        assert ap07 == pytest.approx((6 * 1.0 + 5 * 0.5) / 11.0)
+
+    def test_map_perfect_and_missed(self):
+        from analytics_zoo_tpu.models.image.objectdetection import (
+            mean_average_precision,
+        )
+        gt_b = [np.array([[0.1, 0.1, 0.4, 0.4], [0.6, 0.6, 0.9, 0.9]])]
+        gt_l = [np.array([1, 2])]
+        perfect = [np.array([[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                             [2, 0.8, 0.6, 0.6, 0.9, 0.9]])]
+        res = mean_average_precision(perfect, gt_b, gt_l, n_classes=2)
+        assert res["mAP"] == pytest.approx(1.0)
+
+        # class-2 detection in the wrong place: its AP drops to 0
+        wrong = [np.array([[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                           [2, 0.8, 0.0, 0.0, 0.1, 0.1]])]
+        res = mean_average_precision(wrong, gt_b, gt_l, n_classes=2)
+        assert res["ap_per_class"][1] == pytest.approx(1.0)
+        assert res["ap_per_class"][2] == pytest.approx(0.0)
+        assert res["mAP"] == pytest.approx(0.5)
+
+    def test_map_duplicate_detections_are_fp(self):
+        from analytics_zoo_tpu.models.image.objectdetection import (
+            mean_average_precision,
+        )
+        gt_b = [np.array([[0.1, 0.1, 0.5, 0.5]])]
+        gt_l = [np.array([1])]
+        # two hits on the same gt: second is a false positive
+        dets = [np.array([[1, 0.9, 0.1, 0.1, 0.5, 0.5],
+                          [1, 0.8, 0.12, 0.1, 0.5, 0.5]])]
+        res = mean_average_precision(dets, gt_b, gt_l, n_classes=1)
+        # precision at rank2 = 0.5 but recall already 1.0 at rank1 → AP 1.0
+        assert res["mAP"] == pytest.approx(1.0)
+        # reversed scores: the duplicate outranks the hit → AP 0.5 (area)
+        dets = [np.array([[1, 0.8, 0.1, 0.1, 0.5, 0.5],
+                          [1, 0.9, 0.55, 0.1, 0.9, 0.5]])]
+        res = mean_average_precision(dets, gt_b, gt_l, n_classes=1)
+        assert res["mAP"] == pytest.approx(0.5)
+
+    def test_visualizer_draws(self, tmp_path):
+        from analytics_zoo_tpu.models.image.objectdetection import (
+            Visualizer,
+        )
+        img = np.zeros((64, 64, 3), np.uint8)
+        dets = np.array([[1, 0.9, 0.25, 0.25, 0.75, 0.75]])
+        vis = Visualizer(label_map={1: "cat"})
+        out = vis.draw(img, dets)
+        assert out.shape == img.shape
+        assert out.sum() > 0  # something was drawn
+        p = vis.save(str(tmp_path / "det.png"), img, dets)
+        assert (tmp_path / "det.png").exists() and p.endswith("det.png")

@@ -140,6 +140,46 @@ class TestTCMF:
         assert np.mean((pred - y[:, 96:]) ** 2) < np.mean(y[:, 96:] ** 2)
 
 
+    def test_fit_incremental_extends_basis(self):
+        """New observations update X in closed form with F fixed (ref
+        TCMF.fit_incremental) — forecasts then start from the new tail."""
+        rng = np.random.RandomState(1)
+        t = np.arange(144)
+        basis = np.stack([np.sin(t * 2 * np.pi / 24),
+                          np.cos(t * 2 * np.pi / 24)])
+        F = rng.normal(size=(12, 2))
+        y = (F @ basis + rng.normal(0, 0.01, (12, 144))).astype(np.float32)
+        m = TCMFForecaster(k=4, ar_order=24, lr=0.05)
+        m.fit(y[:, :96], num_steps=400)
+        t0 = m.X.shape[1]
+        m.fit_incremental(y[:, 96:120])
+        assert m.X.shape[1] == t0 + 24
+        # the new columns reconstruct the new data well
+        recon = m.F @ m.X[:, -24:]
+        assert np.mean((recon - y[:, 96:120]) ** 2) < 0.1
+        pred = m.predict(horizon=24)
+        assert np.mean((pred - y[:, 120:]) ** 2) < np.mean(y[:, 120:] ** 2)
+        with pytest.raises(ValueError, match="n_series"):
+            m.fit_incremental(np.zeros((5, 4), np.float32))
+
+    def test_hybrid_local_model(self, orca_ctx):
+        """use_local=True trains the DeepGLO-style residual TCN and its
+        refinement rides on top of the global forecast."""
+        rng = np.random.RandomState(2)
+        t = np.arange(120)
+        basis = np.sin(t * 2 * np.pi / 24)[None]
+        F = rng.normal(size=(6, 1))
+        y = (F @ basis + 0.02 * rng.standard_normal((6, 120))
+             ).astype(np.float32)
+        m = TCMFForecaster(k=2, ar_order=24, use_local=True,
+                           local_lookback=12)
+        m.fit(y[:, :96], num_steps=300)
+        assert m._local is not None
+        pred = m.predict(horizon=24)
+        assert pred.shape == (6, 24)
+        assert np.isfinite(pred).all()
+
+
 class TestAnomaly:
     def test_threshold_detector(self):
         rng = np.random.RandomState(0)
