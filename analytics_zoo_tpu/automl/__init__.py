@@ -12,6 +12,13 @@ from analytics_zoo_tpu.automl import hp
 from analytics_zoo_tpu.automl.auto_estimator import AutoEstimator
 from analytics_zoo_tpu.automl.metrics import Evaluator
 from analytics_zoo_tpu.automl.population import PopulationSearchEngine
+from analytics_zoo_tpu.automl.xgboost import (
+    AutoXGBClassifier,
+    AutoXGBoost,
+    AutoXGBRegressor,
+    XGBClassifier,
+    XGBRegressor,
+)
 from analytics_zoo_tpu.automl.search import (
     BayesSearcher,
     LocalSearchEngine,
@@ -27,5 +34,10 @@ __all__ = [
     "LocalSearchEngine",
     "PopulationSearchEngine",
     "BayesSearcher",
+    "XGBRegressor",
+    "XGBClassifier",
+    "AutoXGBRegressor",
+    "AutoXGBClassifier",
+    "AutoXGBoost",
     "Trial",
 ]

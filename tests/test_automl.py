@@ -309,3 +309,48 @@ class TestAutoEstimator:
         auto.fit((x, y), search_space={"hidden": hp.choice([8])},
                  n_sampling=1, epochs=2, metric="mse", batch_size=64)
         assert auto.get_best_trial().status == "done"
+
+
+class TestXGBoost:
+    """Native GBDT backend + AutoXGBoost (ref orca/automl/xgboost)."""
+
+    def test_regressor_learns_nonlinear(self, orca_ctx):
+        from analytics_zoo_tpu.automl import XGBRegressor
+        rng = np.random.RandomState(0)
+        x = rng.rand(400, 3).astype(np.float32)
+        y = (np.sin(4 * x[:, 0]) + (x[:, 1] > 0.5) * 2 + x[:, 2] ** 2)
+        m = XGBRegressor(n_estimators=60, max_depth=4, learning_rate=0.2)
+        m.fit(x[:300], y[:300])
+        mse = m.evaluate(x[300:], y[300:], metrics=["mse"])["mse"]
+        # trees must beat predicting the mean by a wide margin
+        assert mse < 0.1 * np.var(y[300:])
+
+    def test_classifier_and_proba(self, orca_ctx):
+        from analytics_zoo_tpu.automl import XGBClassifier
+        rng = np.random.RandomState(1)
+        x = rng.rand(400, 4).astype(np.float32)
+        y = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(np.int64)  # XOR
+        m = XGBClassifier(n_estimators=60, max_depth=4, learning_rate=0.3)
+        m.fit(x[:300], y[:300])
+        acc = (m.predict(x[300:]) == y[300:]).mean()
+        assert acc > 0.9, f"GBDT failed XOR: acc {acc}"
+        proba = m.predict_proba(x[300:])
+        assert proba.shape == (100, 2)
+        np.testing.assert_allclose(proba.sum(1), 1.0, atol=1e-6)
+
+    def test_auto_xgb_search(self, tmp_path, orca_ctx):
+        from analytics_zoo_tpu.automl import AutoXGBRegressor
+        rng = np.random.RandomState(2)
+        x = rng.rand(256, 3).astype(np.float32)
+        y = x[:, 0] * 3 + (x[:, 1] > 0.3)
+        auto = AutoXGBRegressor(logs_dir=str(tmp_path), name="axgb",
+                                n_estimators=30)
+        auto.fit((x[:192], y[:192]), validation_data=(x[192:], y[192:]),
+                 search_space={"max_depth": hp.grid_search([2, 4]),
+                               "learning_rate": hp.choice([0.1, 0.3])},
+                 n_sampling=1, metric="mse")
+        cfg = auto.get_best_config()
+        assert cfg["max_depth"] in (2, 4)
+        best = auto.get_best_model()
+        pred = best.predict(x[192:])
+        assert np.mean((pred - y[192:]) ** 2) < 0.1 * np.var(y)
