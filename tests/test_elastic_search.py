@@ -1,0 +1,126 @@
+"""EsTable tests against a fake in-process Elasticsearch REST server
+(ref pyzoo orca/data/elastic_search.py surface; no real ES in this
+environment, so the test speaks the same scroll/_bulk wire protocol)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.data.elastic_search import EsTable
+
+
+class _FakeES(BaseHTTPRequestHandler):
+    store = {}          # index -> list of {"_id", "_source"}
+    scrolls = {}        # scroll_id -> (index, cursor, size)
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, code, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(length).decode()
+        cls = type(self)
+        if self.path.endswith("/_bulk"):
+            index = self.path.split("/")[1]
+            lines = [ln for ln in raw.splitlines() if ln.strip()]
+            items = []
+            docs = cls.store.setdefault(index, [])
+            for i in range(0, len(lines), 2):
+                action = json.loads(lines[i])["index"]
+                doc = json.loads(lines[i + 1])
+                _id = action.get("_id", str(len(docs)))
+                docs.append({"_id": _id, "_source": doc})
+                items.append({"index": {"_id": _id, "status": 201}})
+            self._json(200, {"errors": False, "items": items})
+            return
+        if "/_search/scroll" in self.path:
+            sid = json.loads(raw)["scroll_id"]
+            index, cursor, size = cls.scrolls[sid]
+            docs = cls.store.get(index, [])
+            page = docs[cursor:cursor + size]
+            cls.scrolls[sid] = (index, cursor + size, size)
+            self._json(200, {"_scroll_id": sid,
+                             "hits": {"hits": page}})
+            return
+        if "/_search" in self.path:
+            index = self.path.split("/")[1]
+            body = json.loads(raw or "{}")
+            size = int(body.get("size", 10))
+            docs = cls.store.get(index, [])
+            if "query" in body:
+                term = body["query"].get("term", {})
+                for field, val in term.items():
+                    docs = [d for d in docs
+                            if d["_source"].get(field) == val]
+            sid = f"scroll-{index}-{len(cls.scrolls)}"
+            cls.scrolls[sid] = (index, size, size)
+            self._json(200, {"_scroll_id": sid,
+                             "hits": {"hits": docs[:size]}})
+            return
+        self._json(404, {"error": "unknown endpoint"})
+
+
+@pytest.fixture
+def fake_es():
+    _FakeES.store = {}
+    _FakeES.scrolls = {}
+    server = HTTPServer(("127.0.0.1", 0), _FakeES)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    cfg = {"host": "127.0.0.1", "port": server.server_address[1]}
+    yield cfg
+    server.shutdown()
+    server.server_close()
+
+
+class TestEsTable:
+    def test_write_then_scroll_read(self, fake_es, orca_ctx):
+        df = pd.DataFrame({"user": [1, 2, 3, 4, 5],
+                           "score": [0.1, 0.2, 0.3, 0.4, 0.5]})
+        n = EsTable.write_df(fake_es, "ratings", df)
+        assert n == 5
+        shards = EsTable.read_df(fake_es, "ratings", batch_size=2)
+        big = shards.to_pandas()
+        assert len(big) == 5  # scrolled through 3 pages
+        np.testing.assert_array_equal(np.sort(big["user"].to_numpy()),
+                                      [1, 2, 3, 4, 5])
+
+    def test_query_filter(self, fake_es, orca_ctx):
+        df = pd.DataFrame({"cls": ["a", "a", "b"], "v": [1, 2, 3]})
+        EsTable.write_df(fake_es, "docs", df)
+        got = EsTable.read_df(fake_es, "docs",
+                              query={"term": {"cls": "a"}}).to_pandas()
+        assert sorted(got["v"].tolist()) == [1, 2]
+
+    def test_read_rdd_records(self, fake_es, orca_ctx):
+        EsTable.write_df(fake_es, "r", pd.DataFrame({"x": [7]}))
+        recs = EsTable.read_rdd(fake_es, "r").collect()[0]
+        assert recs[0]["x"] == 7
+
+    def test_flatten_df(self):
+        df = pd.DataFrame({
+            "plain": [1, 2],
+            "nested": [{"a": 1, "b": 2}, {"a": 3}],
+        })
+        flat = EsTable.flatten_df(df)
+        assert sorted(flat.columns) == ["nested.a", "nested.b", "plain"]
+        assert flat["nested.a"].tolist() == [1, 3]
+        assert pd.isna(flat["nested.b"][1])
+
+    def test_num_shards_repartition(self, fake_es, orca_ctx):
+        EsTable.write_df(fake_es, "big",
+                         pd.DataFrame({"i": list(range(10))}))
+        shards = EsTable.read_df(fake_es, "big", num_shards=4)
+        assert shards.num_partitions() == 4
+        assert len(shards.to_pandas()) == 10
