@@ -70,13 +70,23 @@ class EsTable:
 
         n = drain(out)
         scroll_id = out.get("_scroll_id")
-        while n and scroll_id:
-            frames.append(pd.DataFrame(rows))
-            rows = []
-            out = _http("POST", f"{base}/_search/scroll",
-                        {"scroll": "2m", "scroll_id": scroll_id})
-            scroll_id = out.get("_scroll_id", scroll_id)
-            n = drain(out)
+        try:
+            while n and scroll_id:
+                frames.append(pd.DataFrame(rows))
+                rows = []
+                out = _http("POST", f"{base}/_search/scroll",
+                            {"scroll": "2m", "scroll_id": scroll_id})
+                scroll_id = out.get("_scroll_id", scroll_id)
+                n = drain(out)
+        finally:
+            if scroll_id:
+                # release the server-side search context (ES caps open
+                # scrolls; leaking them starves later reads)
+                try:
+                    _http("DELETE", f"{base}/_search/scroll",
+                          {"scroll_id": scroll_id})
+                except OSError:
+                    pass
         if rows:
             frames.append(pd.DataFrame(rows))
         if not frames:
@@ -115,30 +125,41 @@ class EsTable:
         return pd.DataFrame(out)
 
     @staticmethod
-    def write_df(es_config: Dict, es_resource: str, df) -> int:
-        """Bulk-index a DataFrame (ref write_df); returns indexed count."""
+    def write_df(es_config: Dict, es_resource: str, df,
+                 chunk_size: int = 1000) -> int:
+        """Bulk-index a DataFrame (ref write_df; the es-hadoop connector
+        also chunks bulk writes); returns the indexed count. Per-column
+        dtypes are preserved (no iterrows row-upcast) and NaN serializes
+        as JSON null."""
         base = _base_url(es_config)
-        lines = []
-        for _, row in df.iterrows():
-            rec = {k: (v.item() if isinstance(v, np.generic) else v)
-                   for k, v in row.items() if k != "_id"}
-            action: Dict = {"index": {}}
-            if "_id" in row and row["_id"] is not None:
-                _id = row["_id"]
-                action["index"]["_id"] = (_id.item()
-                                          if isinstance(_id, np.generic)
-                                          else _id)
-            lines.append(json.dumps(action))
-            lines.append(json.dumps(rec))
-        if not lines:
-            return 0
-        resp = _http("POST", f"{base}/{es_resource}/_bulk",
-                     ndjson="\n".join(lines) + "\n")
-        if resp.get("errors"):
-            failed = [i["index"] for i in resp.get("items", [])
-                      if i.get("index", {}).get("error")]
-            raise IOError(f"bulk index reported errors: {failed[:3]}")
-        return len(df)
+
+        def clean(v):
+            if isinstance(v, np.generic):
+                v = v.item()
+            if isinstance(v, float) and (v != v):   # NaN → null: ES's
+                return None                          # parser rejects NaN
+            return v
+
+        records = df.to_dict(orient="records")
+        total = 0
+        for start in range(0, len(records), int(chunk_size)):
+            lines = []
+            for rec in records[start:start + int(chunk_size)]:
+                _id = clean(rec.pop("_id", None))
+                action: Dict = {"index": {}}
+                if _id is not None:
+                    action["index"]["_id"] = _id
+                lines.append(json.dumps(action))
+                lines.append(json.dumps({k: clean(v)
+                                         for k, v in rec.items()}))
+            resp = _http("POST", f"{base}/{es_resource}/_bulk",
+                         ndjson="\n".join(lines) + "\n")
+            if resp.get("errors"):
+                failed = [i["index"] for i in resp.get("items", [])
+                          if i.get("index", {}).get("error")]
+                raise IOError(f"bulk index reported errors: {failed[:3]}")
+            total += len(lines) // 2
+        return total
 
     @staticmethod
     def read_rdd(es_config: Dict, es_resource: str,

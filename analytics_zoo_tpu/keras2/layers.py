@@ -1,0 +1,126 @@
+"""Keras-2-style layer spellings.
+
+Rebuild of the reference's keras2 subset
+(ref ``pyzoo/zoo/pipeline/api/keras2/layers/`` — 16 classes exposing the
+Keras-2 argument names: ``units``, ``filters``, ``kernel_size``,
+``strides``, ``padding``, ``rate``, ``pool_size`` — over the same
+execution engine as the keras-1 API). Each class here adapts those
+signatures onto the corresponding ``analytics_zoo_tpu.keras.layers``
+implementation, so keras-2-flavored user code runs unchanged on the same
+fused GraphModule.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+from analytics_zoo_tpu.keras import layers as k1
+
+Activation = k1.Activation
+Dropout = k1.Dropout  # keras2 'rate' is positional like keras1 'p'
+Flatten = k1.Flatten
+GlobalAveragePooling1D = k1.GlobalAveragePooling1D
+GlobalAveragePooling2D = k1.GlobalAveragePooling2D
+GlobalMaxPooling1D = k1.GlobalMaxPooling1D
+Cropping1D = k1.Cropping1D
+
+
+def _single(v):
+    return v[0] if isinstance(v, (tuple, list)) else v
+
+
+class Dense(k1.Dense):
+    """keras2: Dense(units, activation=..., use_bias=...)."""
+
+    def __init__(self, units: int, activation=None,
+                 kernel_initializer="glorot_uniform", use_bias: bool = True,
+                 input_shape=None, name=None, **kw):
+        super().__init__(units, activation=activation,
+                         init=kernel_initializer, bias=use_bias,
+                         input_shape=input_shape, name=name)
+
+
+class Conv1D(k1.Conv1D):
+    """keras2: Conv1D(filters, kernel_size, strides=1, padding='valid')."""
+
+    def __init__(self, filters: int, kernel_size: Union[int, Sequence[int]],
+                 strides: Union[int, Sequence[int]] = 1,
+                 padding: str = "valid", activation=None,
+                 dilation_rate: Union[int, Sequence[int]] = 1,
+                 use_bias: bool = True,
+                 kernel_initializer="glorot_uniform", input_shape=None,
+                 name=None, **kw):
+        super().__init__(filters, _single(kernel_size),
+                         activation=activation, border_mode=padding,
+                         subsample_length=_single(strides),
+                         init=kernel_initializer, bias=use_bias,
+                         dilation_rate=_single(dilation_rate),
+                         input_shape=input_shape, name=name)
+
+
+class Conv2D(k1.Conv2D):
+    """keras2: Conv2D(filters, kernel_size, ...)."""
+
+    def __init__(self, filters: int, kernel_size, strides=(1, 1),
+                 padding: str = "valid", activation=None,
+                 use_bias: bool = True,
+                 kernel_initializer="glorot_uniform", input_shape=None,
+                 name=None, **kw):
+        ks = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
+              else tuple(kernel_size))
+        super().__init__(filters, ks[0], ks[1], activation=activation,
+                         border_mode=padding, subsample=strides,
+                         init=kernel_initializer, bias=use_bias,
+                         input_shape=input_shape, name=name)
+
+
+class MaxPooling1D(k1.MaxPooling1D):
+    """keras2: MaxPooling1D(pool_size, strides=None, padding='valid')."""
+
+    def __init__(self, pool_size: int = 2, strides: Optional[int] = None,
+                 padding: str = "valid", input_shape=None, name=None, **kw):
+        super().__init__(pool_length=_single(pool_size),
+                         stride=_single(strides) if strides else None,
+                         border_mode=padding, input_shape=input_shape,
+                         name=name)
+
+
+class AveragePooling1D(k1.AveragePooling1D):
+    def __init__(self, pool_size: int = 2, strides: Optional[int] = None,
+                 padding: str = "valid", input_shape=None, name=None, **kw):
+        super().__init__(pool_length=_single(pool_size),
+                         stride=_single(strides) if strides else None,
+                         border_mode=padding, input_shape=input_shape,
+                         name=name)
+
+
+class LocallyConnected1D(k1.LocallyConnected1D):
+    """keras2: LocallyConnected1D(filters, kernel_size, strides=1)."""
+
+    def __init__(self, filters: int, kernel_size, strides=1,
+                 activation=None, use_bias: bool = True, input_shape=None,
+                 name=None, **kw):
+        super().__init__(filters, _single(kernel_size),
+                         activation=activation,
+                         subsample_length=_single(strides), bias=use_bias,
+                         input_shape=input_shape, name=name)
+
+
+class _MergeN(k1.Merge):
+    mode = "ave"
+
+    def __init__(self, input_shape=None, name=None, **kw):
+        super().__init__(mode=self.mode, input_shape=input_shape, name=name)
+
+
+class Average(_MergeN):
+    """Element-wise mean over inputs (ref keras2/merge.py Average)."""
+    mode = "ave"
+
+
+class Maximum(_MergeN):
+    mode = "max"
+
+
+class Minimum(_MergeN):
+    mode = "min"

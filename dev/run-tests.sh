@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Test runner (ref pyzoo/dev/run-pytests: suite sharding per heavy
+# dependency set). One env here — jax+torch coexist — so sharding is by
+# subsystem for parallel CI lanes and fail isolation; every lane runs on
+# the virtual 8-device CPU mesh (tests/conftest.py).
+#
+#   dev/run-tests.sh              # everything
+#   dev/run-tests.sh core         # one lane
+#   Lanes: core data keras models zouwu automl serving interop examples
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+lane="${1:-all}"
+
+run() { echo "== pytest $*"; python -m pytest -q "$@"; }
+
+case "$lane" in
+  core)     run tests/test_context.py tests/test_estimator.py \
+                tests/test_estimator_edge.py tests/test_estimator_factories.py \
+                tests/test_attention.py tests/test_pipeline.py tests/test_moe.py ;;
+  data)     run tests/test_data.py tests/test_native_store.py \
+                tests/test_feature.py tests/test_friesian.py \
+                tests/test_image3d_parquet.py tests/test_elastic_search.py ;;
+  keras)    run tests/test_keras.py tests/test_keras_layers_golden.py \
+                tests/test_keras2_multihost.py tests/test_nnframes_autograd.py ;;
+  models)   run tests/test_model_zoo.py tests/test_recommendation.py \
+                tests/test_text_bert.py tests/test_gan.py ;;
+  zouwu)    run tests/test_zouwu.py tests/test_autots.py \
+                tests/test_stats_forecast.py ;;
+  automl)   run tests/test_automl.py ;;
+  serving)  run tests/test_serving.py tests/test_inference_net.py \
+                tests/test_onnx.py tests/test_encryption.py ;;
+  interop)  run tests/test_inference_net.py tests/test_onnx.py ;;
+  examples) run tests/test_examples.py ;;
+  all)      run tests/ ;;
+  *) echo "unknown lane: $lane" >&2; exit 2 ;;
+esac

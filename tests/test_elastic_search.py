@@ -16,9 +16,17 @@ from analytics_zoo_tpu.data.elastic_search import EsTable
 class _FakeES(BaseHTTPRequestHandler):
     store = {}          # index -> list of {"_id", "_source"}
     scrolls = {}        # scroll_id -> (index, cursor, size)
+    deleted_scrolls = []
+    bulk_calls = 0
 
     def log_message(self, *a):
         pass
+
+    def do_DELETE(self):
+        length = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(length).decode()
+        type(self).deleted_scrolls.append(json.loads(raw)["scroll_id"])
+        self._json(200, {"succeeded": True})
 
     def _json(self, code, payload):
         body = json.dumps(payload).encode()
@@ -33,6 +41,7 @@ class _FakeES(BaseHTTPRequestHandler):
         raw = self.rfile.read(length).decode()
         cls = type(self)
         if self.path.endswith("/_bulk"):
+            cls.bulk_calls += 1
             index = self.path.split("/")[1]
             lines = [ln for ln in raw.splitlines() if ln.strip()]
             items = []
@@ -76,6 +85,8 @@ class _FakeES(BaseHTTPRequestHandler):
 def fake_es():
     _FakeES.store = {}
     _FakeES.scrolls = {}
+    _FakeES.deleted_scrolls = []
+    _FakeES.bulk_calls = 0
     server = HTTPServer(("127.0.0.1", 0), _FakeES)
     threading.Thread(target=server.serve_forever, daemon=True).start()
     cfg = {"host": "127.0.0.1", "port": server.server_address[1]}
@@ -95,6 +106,27 @@ class TestEsTable:
         assert len(big) == 5  # scrolled through 3 pages
         np.testing.assert_array_equal(np.sort(big["user"].to_numpy()),
                                       [1, 2, 3, 4, 5])
+
+    def test_scroll_context_released(self, fake_es, orca_ctx):
+        EsTable.write_df(fake_es, "r", pd.DataFrame({"x": [1, 2, 3]}))
+        EsTable.read_df(fake_es, "r", batch_size=1)
+        assert _FakeES.deleted_scrolls, "scroll context never deleted"
+
+    def test_write_preserves_dtypes_and_nan(self, fake_es, orca_ctx):
+        """Mixed int/float frames must keep ints as ints on the wire
+        (iterrows would upcast), and NaN must serialize as null."""
+        df = pd.DataFrame({"user": [1, 2], "score": [0.5, np.nan]})
+        EsTable.write_df(fake_es, "mixed", df)
+        docs = [d["_source"] for d in _FakeES.store["mixed"]]
+        assert docs[0]["user"] == 1 and isinstance(docs[0]["user"], int)
+        assert docs[1]["score"] is None
+
+    def test_write_chunks_bulk_requests(self, fake_es, orca_ctx):
+        df = pd.DataFrame({"i": list(range(25))})
+        n = EsTable.write_df(fake_es, "chunky", df, chunk_size=10)
+        assert n == 25
+        assert _FakeES.bulk_calls == 3  # 10 + 10 + 5
+        assert len(_FakeES.store["chunky"]) == 25
 
     def test_query_filter(self, fake_es, orca_ctx):
         df = pd.DataFrame({"cls": ["a", "a", "b"], "v": [1, 2, 3]})
