@@ -67,6 +67,50 @@ def main():
             param_rules=model.param_rules())
         h = est.fit((xb, yb), epochs=2, batch_size=64)
         print(f"{'dp2,pp4':10s} pipeline loss={h['loss'][-1]:.4f}")
+        mesh_lib.set_default_mesh(None)
+
+        # heterogeneous pipeline: embedding + blocks + LM head all INSIDE
+        # the gpipe schedule (per-stage param pytrees packed + switched)
+        from analytics_zoo_tpu.parallel.pipeline import (
+            PipelinedTransformerLM,
+        )
+        hmesh = mesh_lib.build_mesh(
+            axes=(mesh_lib.DATA_AXIS, mesh_lib.PIPE_AXIS), shape=[2, 4])
+        lm = PipelinedTransformerLM(vocab=32, d_model=16, n_heads=2,
+                                    d_ff=32, seq_len=8, n_stages=4,
+                                    n_microbatches=2, mesh=hmesh)
+        tokens = rng.randint(0, 32, (64, 8)).astype(np.int32)
+        targets = np.roll(tokens, -1, axis=1)
+        lparams = lm.init(jax.random.PRNGKey(1), tokens[:2])
+        lest = Estimator.from_fn(
+            apply_fn=lm.apply, params=lparams,
+            loss="sparse_categorical_crossentropy_logits",
+            optimizer="adam", strategy="dp2,pp4",
+            param_rules=lm.param_rules())
+        h = lest.fit((tokens, targets), epochs=2, batch_size=32)
+        print(f"{'dp2,pp4':10s} hetero-LM loss={h['loss'][-1]:.4f}")
+        mesh_lib.set_default_mesh(None)
+
+        # sequence parallelism: the same attention under the ring and
+        # Ulysses all-to-all modes (context parallel over the seq axis)
+        from analytics_zoo_tpu.ops.ring_attention import ring_attention
+        from analytics_zoo_tpu.ops.ulysses import ulysses_attention
+        from analytics_zoo_tpu.parallel.mesh import place_on_mesh
+        from analytics_zoo_tpu.parallel.strategy import ShardingStrategy
+        from jax.sharding import PartitionSpec as P
+
+        smesh = ShardingStrategy.parse("dp2,sp4").build_mesh()
+        q, k, v = (rng.randn(4, 32, 4, 8).astype(np.float32)
+                   for _ in range(3))
+        spec = lambda a: P("data", "seq", None, None)  # noqa: E731
+        gq, gk, gv = (place_on_mesh(t, smesh, spec) for t in (q, k, v))
+        ring = np.asarray(ring_attention(gq, gk, gv, mesh=smesh,
+                                         causal=True, batch_axis="data"))
+        uly = np.asarray(ulysses_attention(gq, gk, gv, mesh=smesh,
+                                           causal=True, batch_axis="data"))
+        np.testing.assert_allclose(ring, uly, rtol=2e-4, atol=2e-5)
+        print(f"{'dp2,sp4':10s} ring == ulysses attention "
+              f"(max|Δ|={np.abs(ring - uly).max():.2e})")
     finally:
         stop_orca_context()
 
