@@ -175,6 +175,44 @@ def measure_bert():
             "bert_base_mfu": round(mfu, 4) if mfu else None}
 
 
+def measure_serving():
+    """Cluster Serving end-to-end records/s through the native C++ broker
+    (ref BASELINE: Flink numRecordsOutPerSecond — the reference publishes
+    the metric surface, no number)."""
+    import numpy as np
+    import flax.linen as nn
+    from analytics_zoo_tpu.inference import InferenceModel
+    from analytics_zoo_tpu.serving import (
+        Broker, ClusterServing, InputQueue, OutputQueue,
+    )
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(8)(nn.relu(nn.Dense(32)(x)))
+
+    im = InferenceModel().load_flax(Net(), np.zeros((1, 16), np.float32))
+    N = 512
+    rng = np.random.default_rng(3)
+    payloads = rng.standard_normal((N, 16)).astype(np.float32)
+    with Broker.launch() as broker, \
+            ClusterServing(im, broker.port, batch_size=64).start() as eng:
+        in_q = InputQueue(port=broker.port)
+        out_q = OutputQueue(port=broker.port)
+        # warm the compile bucket
+        in_q.enqueue("warm", x=payloads[0])
+        out_q.query("warm", timeout=120.0)
+        t0 = time.perf_counter()
+        for i in range(N):
+            in_q.enqueue(f"r{i}", x=payloads[i])
+        for i in range(N):
+            out_q.query(f"r{i}", timeout=60.0)
+        dt = time.perf_counter() - t0
+        backend = broker.backend
+    return {"serving_records_per_sec": round(N / dt, 1),
+            "serving_broker": backend}
+
+
 def measure_tcn():
     """Zouwu TCN (ref tcn.py:91): training steps/sec on rolling windows."""
     import numpy as np
@@ -213,7 +251,7 @@ def main():
     sps = measure_ncf()
     out["value"] = round(sps, 1)
     out["vs_baseline"] = round(sps / CPU_BASELINE_SPS, 3)
-    for part in (measure_bert, measure_tcn):
+    for part in (measure_bert, measure_tcn, measure_serving):
         try:
             out.update(part())
         except Exception as e:  # a secondary bench must not kill the line
