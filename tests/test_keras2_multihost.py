@@ -129,6 +129,43 @@ class TestCoreLayerGoldens:
         np.testing.assert_allclose(got, want.numpy(), rtol=1e-4, atol=1e-4)
 
 
+    def test_lstm_matches_torch(self, orca_ctx):
+        """flax OptimizedLSTMCell vs torch.nn.LSTM weight-for-weight
+        (torch packs gates as i|f|g|o; flax names them ii/if/ig/io +
+        hi/hf/hg/ho with biases on the h-side)."""
+        import jax
+        import flax.linen as nn
+        x = np.random.RandomState(7).randn(2, 5, 3).astype(np.float32)
+        H = 4
+        cell = nn.OptimizedLSTMCell(features=H)
+        carry0 = (np.zeros((2, H), np.float32), np.zeros((2, H), np.float32))
+        variables = cell.init(jax.random.PRNGKey(0), carry0, x[:, 0])
+        p = variables["params"]
+
+        tl = torch.nn.LSTM(3, H, batch_first=True)
+        wi = np.concatenate([np.asarray(p[f"i{g}"]["kernel"]).T
+                             for g in "ifgo"])
+        wh = np.concatenate([np.asarray(p[f"h{g}"]["kernel"]).T
+                             for g in "ifgo"])
+        bh = np.concatenate([np.asarray(p[f"h{g}"]["bias"])
+                             for g in "ifgo"])
+        with torch.no_grad():
+            tl.weight_ih_l0.copy_(torch.from_numpy(wi))
+            tl.weight_hh_l0.copy_(torch.from_numpy(wh))
+            tl.bias_ih_l0.copy_(torch.from_numpy(np.zeros(4 * H, np.float32)))
+            tl.bias_hh_l0.copy_(torch.from_numpy(bh))
+            want, _ = tl(torch.from_numpy(x))
+        want = want.detach().numpy()
+
+        carry = carry0
+        outs = []
+        for t in range(x.shape[1]):
+            carry, y = cell.apply(variables, carry, x[:, t])
+            outs.append(np.asarray(y))
+        got = np.stack(outs, 1)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
 class TestMultihostBootstrap:
     """The jax.distributed init path (ref SURVEY §2.1 launchers; VERDICT
     weak #5: 'code exists, never exercised') — wiring verified with a
