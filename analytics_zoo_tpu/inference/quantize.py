@@ -44,14 +44,25 @@ def quantize_tree(params, min_elems: int = 1024):
     norms) stay float for accuracy."""
     import jax
 
+    import jax.numpy as jnp
+
     def maybe(leaf):
+        if isinstance(leaf, QuantizedLeaf):
+            # idempotent: already quantized (re-put on device — the
+            # device_get above pulled the fields to host)
+            return QuantizedLeaf(jnp.asarray(leaf.q),
+                                 jnp.asarray(leaf.scale))
         a = np.asarray(leaf)
         if a.ndim >= 2 and a.size >= min_elems and \
                 np.issubdtype(a.dtype, np.floating):
             return _quantize_array(a)
-        return leaf
+        # keep skipped leaves device-resident: host arrays here would be
+        # re-uploaded on every jitted call
+        return jnp.asarray(a)
 
-    return jax.tree_util.tree_map(maybe, jax.device_get(params))
+    return jax.tree_util.tree_map(
+        maybe, jax.device_get(params),
+        is_leaf=lambda x: isinstance(x, QuantizedLeaf))
 
 
 def dequantize_tree(qparams):

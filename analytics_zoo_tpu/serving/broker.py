@@ -326,6 +326,36 @@ class _PyBrokerServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._conns = set()
+        self._conns_lock = threading.Lock()
+
+    def process_request(self, request, client_address):
+        with self._conns_lock:
+            self._conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._conns_lock:
+            self._conns.discard(request)
+        super().shutdown_request(request)
+
+    def close_all_connections(self):
+        """Sever live client sockets so clients observe the broker's death
+        (the native broker gets this for free when its process exits)."""
+        with self._conns_lock:
+            for s in self._conns:
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+
 
 class Broker:
     """Owns a broker process (native) or thread (python fallback).
@@ -379,6 +409,7 @@ class Broker:
             self._proc = None
         if self._server is not None:
             self._server.shutdown()
+            self._server.close_all_connections()
             self._server.server_close()
             self._server = None
 

@@ -186,27 +186,31 @@ class ClusterServing:
         return n
 
     def _run(self):
-        client = BrokerClient(port=self.broker_port)
         logger.info("serving started: stream=%s batch=%d",
                     self.stream, self.batch_size)
+        client: Optional[BrokerClient] = None
         while not self._stop.is_set():
             try:
+                if client is None:
+                    client = BrokerClient(port=self.broker_port)
                 self._serve_once(client)
-            except ConnectionError:
+            except (ConnectionError, OSError):
+                # broker died or the socket went bad: DROP the client and
+                # redial next round (keeping a dead client would loop
+                # forever on bad-fd errors)
                 if self._stop.is_set():
                     break
-                logger.exception("broker connection lost; reconnecting")
-                time.sleep(0.2)
-                try:
+                logger.warning("broker connection lost; reconnecting")
+                if client is not None:
                     client.close()
-                    client = BrokerClient(port=self.broker_port)
-                except OSError:
-                    continue
+                    client = None
+                time.sleep(0.2)
             except Exception:
                 # the loop is the service — survive anything per-batch
                 logger.exception("serve step failed; continuing")
                 time.sleep(0.05)
-        client.close()
+        if client is not None:
+            client.close()
 
     # ---------------------------------------------------------------- api
     def start(self) -> "ClusterServing":

@@ -345,6 +345,15 @@ class TestInferenceModel:
         assert (np.argmax(after, -1) == np.argmax(before, -1)).mean() == 1.0
         np.testing.assert_allclose(after, before, atol=0.03)
 
+    def test_quantize_is_idempotent(self, orca_ctx):
+        m = _mlp()
+        x = np.random.RandomState(9).randn(8, 4).astype(np.float32)
+        im = InferenceModel().load_torch(m, x[:1])
+        im.quantize(min_elems=4)
+        once = im.predict(x)
+        im.quantize(min_elems=4)   # second call must be a no-op, not nest
+        np.testing.assert_allclose(im.predict(x), once, atol=1e-6)
+
     def test_quantize_requires_model(self):
         with pytest.raises(RuntimeError, match="load a model"):
             InferenceModel().quantize()
