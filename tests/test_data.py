@@ -213,3 +213,40 @@ def test_from_dataframe_cols(orca_ctx):
     ds = to_sharded_dataset(df, feature_cols=["f1", "f2"], label_cols="y")
     assert isinstance(ds.x, tuple) and len(ds.x) == 2
     assert ds.n == 10
+
+
+def test_streaming_dataset_scan_iterator(orca_ctx):
+    """steps_per_loop fusion must compose with the out-of-core feed
+    (device_scan_iterator drives iter_batches through the window logic)."""
+    import flax.linen as nn
+    from analytics_zoo_tpu.common.context import OrcaContext
+    from analytics_zoo_tpu.data import StreamingShardedDataset
+    from analytics_zoo_tpu.data.dataset import to_sharded_dataset
+    from analytics_zoo_tpu.data.shard import HostXShards
+    from analytics_zoo_tpu.learn.estimator import Estimator
+
+    OrcaContext.train_data_store = "DISK_2"
+    try:
+        rng = np.random.RandomState(3)
+        shards = []
+        for _ in range(4):
+            x = rng.randn(64, 4).astype(np.float32)
+            shards.append({"x": x, "y": (x.sum(1) > 0).astype(np.int32)})
+        ds = to_sharded_dataset(HostXShards(shards))
+        assert isinstance(ds, StreamingShardedDataset)
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(2)(nn.tanh(nn.Dense(8)(x)))
+
+        est = Estimator.from_flax(
+            model=Net(), loss="sparse_categorical_crossentropy_logits",
+            optimizer="adam", sample_input=np.zeros((2, 4), np.float32))
+        h = est.fit(ds, epochs=3, batch_size=32, steps_per_loop=4)
+        assert len(h["loss"]) == 3 and all(np.isfinite(h["loss"]))
+        # 256 rows / 32 per batch = 8 steps/epoch x 3 epochs
+        assert est._py_step == 24
+        assert ds.peak_window_rows <= 128 + 32
+    finally:
+        OrcaContext.train_data_store = "DRAM"
