@@ -98,6 +98,53 @@ class _ModuleRule:
             stride, padding = mod.stride, mod.padding
             return p, {}, lambda pr, x: _conv_general(
                 x, pr["kernel"], pr.get("bias"), stride, padding, dims)
+        if isinstance(mod, tnn.ConvTranspose2d):
+            if any(d != 1 for d in np.atleast_1d(mod.dilation)) \
+                    or mod.groups != 1 \
+                    or any(p != 0 for p in np.atleast_1d(mod.output_padding)):
+                raise NotImplementedError(
+                    "dilated/grouped/output-padded ConvTranspose2d "
+                    "not supported")
+            p = {"kernel": _np(mod.weight)}        # [in, out, kh, kw]
+            if mod.bias is not None:
+                p["bias"] = _np(mod.bias)
+            stride = (mod.stride if isinstance(mod.stride, tuple)
+                      else (mod.stride,) * 2)
+            pad = (mod.padding if isinstance(mod.padding, tuple)
+                   else (mod.padding,) * 2)
+
+            def deconv(pr, x):
+                import jax.lax as lax
+                k = pr["kernel"]
+                kh, kw = k.shape[2], k.shape[3]
+                # torch's transposed conv correlates with the FLIPPED
+                # kernel; padding p maps to (k - 1 - p) on the dilated grid
+                out = lax.conv_general_dilated(
+                    x, jnp.flip(k, (2, 3)).transpose(1, 0, 2, 3),
+                    window_strides=(1, 1),
+                    padding=[(kh - 1 - pad[0], kh - 1 - pad[0]),
+                             (kw - 1 - pad[1], kw - 1 - pad[1])],
+                    lhs_dilation=stride,
+                    dimension_numbers=("NCHW", "OIHW", "NCHW"))
+                if "bias" in pr:
+                    out = out + pr["bias"].reshape(1, -1, 1, 1)
+                return out
+            return p, {}, deconv
+        if isinstance(mod, tnn.GroupNorm):
+            p = {"scale": _np(mod.weight), "bias": _np(mod.bias)}
+            groups, eps = mod.num_groups, mod.eps
+
+            def gn(pr, x):
+                b, c = x.shape[0], x.shape[1]
+                g = x.reshape((b, groups, c // groups) + x.shape[2:])
+                axes = tuple(range(2, g.ndim))
+                mu = g.mean(axes, keepdims=True)
+                var = ((g - mu) ** 2).mean(axes, keepdims=True)
+                g = (g - mu) * jax.lax.rsqrt(var + eps)
+                shape = (1, c) + (1,) * (x.ndim - 2)
+                return g.reshape(x.shape) * pr["scale"].reshape(shape) \
+                    + pr["bias"].reshape(shape)
+            return p, {}, gn
         if isinstance(mod, (tnn.BatchNorm1d, tnn.BatchNorm2d)):
             # train-mode forward normalizes by BATCH statistics (matching
             # torch .train() semantics for loss/gradients); eval uses the
@@ -161,6 +208,20 @@ class _ModuleRule:
             return {}, {}, lambda pr, x: x.reshape(x.shape[:start] + (-1,))
         if isinstance(mod, tnn.ReLU):
             return {}, {}, lambda pr, x: jnp.maximum(x, 0)
+        if isinstance(mod, tnn.LeakyReLU):
+            slope = mod.negative_slope
+            return {}, {}, lambda pr, x: jnp.where(x >= 0, x, slope * x)
+        if isinstance(mod, tnn.ELU):
+            alpha = mod.alpha
+            return {}, {}, lambda pr, x: jnp.where(
+                x >= 0, x, alpha * (jnp.exp(x) - 1.0))
+        if isinstance(mod, tnn.Softplus):
+            return {}, {}, lambda pr, x: jax.nn.softplus(x)
+        if isinstance(mod, tnn.Hardtanh):
+            lo, hi = mod.min_val, mod.max_val
+            return {}, {}, lambda pr, x: jnp.clip(x, lo, hi)
+        if isinstance(mod, tnn.SiLU):
+            return {}, {}, lambda pr, x: jax.nn.silu(x)
         if isinstance(mod, tnn.GELU):
             return {}, {}, lambda pr, x: jax.nn.gelu(x)
         if isinstance(mod, tnn.Tanh):

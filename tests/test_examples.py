@@ -15,7 +15,8 @@ EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
 ALL = ["recommendation_ncf.py", "anomaly_detection.py",
        "autots_forecast.py", "cluster_serving.py", "torch_migration.py",
        "distributed_training.py", "dogs_vs_cats_transfer.py",
-       "sentiment_analysis.py", "vae.py"]
+       "sentiment_analysis.py", "vae.py", "fraud_detection.py",
+       "image_similarity.py"]
 
 
 @pytest.mark.parametrize("script", ALL)

@@ -58,6 +58,18 @@ class TestTorchTranslation:
         got = TorchNet(m).predict(x)
         np.testing.assert_allclose(got, want, atol=1e-5)
 
+    def test_convtranspose_groupnorm_activations_match_torch(self):
+        torch.manual_seed(11)
+        m = tnn.Sequential(
+            tnn.ConvTranspose2d(3, 5, 3, stride=2, padding=1),
+            tnn.GroupNorm(1, 5), tnn.LeakyReLU(0.2),
+            tnn.Conv2d(5, 4, 3, padding=1), tnn.GroupNorm(2, 4),
+            tnn.ELU(), tnn.SiLU(), tnn.Softplus(), tnn.Hardtanh(-2, 2))
+        x = np.random.RandomState(11).randn(2, 3, 6, 6).astype(np.float32)
+        want = m(torch.from_numpy(x)).detach().numpy()
+        got = TorchNet(m).predict(x)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
     def test_unsupported_module_raises(self):
         m = tnn.Sequential(tnn.Linear(4, 4), tnn.PReLU())
         with pytest.raises(NotImplementedError, match="PReLU"):
