@@ -131,7 +131,12 @@ class _ModuleRule:
                 return out
             return p, {}, deconv
         if isinstance(mod, tnn.GroupNorm):
-            p = {"scale": _np(mod.weight), "bias": _np(mod.bias)}
+            if mod.weight is None:  # affine=False
+                c = mod.num_channels
+                p = {"scale": np.ones(c, np.float32),
+                     "bias": np.zeros(c, np.float32)}
+            else:
+                p = {"scale": _np(mod.weight), "bias": _np(mod.bias)}
             groups, eps = mod.num_groups, mod.eps
 
             def gn(pr, x):

@@ -74,7 +74,8 @@ def build_ncf():
     return ncf, x, y
 
 
-def measure_ncf() -> float:
+def measure_ncf() -> dict:
+    """{'staged', 'cached' (None off single-device), 'best'} samples/s."""
     import jax
     ncf, x, y = build_ncf()
     est = ncf.model._ensure_estimator(for_training=True)
@@ -83,8 +84,35 @@ def measure_ncf() -> float:
     mesh = est._ensure_mesh()
     est._build_train_step()
 
-    # fused multi-step loop: one dispatch per STEPS_PER_LOOP optimizer
-    # steps (estimator fit(steps_per_loop=...) path)
+    sps_cached = None
+    if len(mesh.devices.reshape(-1)) == 1:
+        # single chip: also measure the HBM-cached epoch path — dataset
+        # device-resident, ONE dispatch per epoch
+        # (Estimator.fit(cache="device")); it wins when dispatch/transfer
+        # latency dominates (remote-tunnel chips), the host-staged scan
+        # wins when the per-step gather is the bottleneck
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        repl = NamedSharding(mesh, P())
+        x_dev = jax.device_put(x, repl)
+        y_dev = jax.device_put(y, repl)
+        key = jax.random.PRNGKey(0)
+        n_steps = len(x) // BATCH
+        state, losses = est._train_epoch_cached(
+            est._state, x_dev, y_dev, key, BATCH, False)   # compile+warm
+        jax.block_until_ready(losses)
+        epochs = max(1, MEASURE_STEPS // n_steps + 1)
+        t0 = time.perf_counter()
+        for e in range(epochs):
+            state, losses = est._train_epoch_cached(
+                state, x_dev, y_dev, jax.random.fold_in(key, e),
+                BATCH, False)
+        jax.block_until_ready(losses)
+        dt = time.perf_counter() - t0
+        est._state = state
+        sps_cached = epochs * n_steps * BATCH / dt
+
+    # host-staged fused multi-step loop, one dispatch per STEPS_PER_LOOP
+    # optimizer steps (estimator fit(steps_per_loop=...) path)
     def loops():
         while True:
             for b in ds.device_scan_iterator(mesh, est.strategy, BATCH,
@@ -105,7 +133,9 @@ def measure_ncf() -> float:
         est._state, losses = est._train_scan(est._state, (bx, by))
     jax.block_until_ready(losses)
     dt = time.perf_counter() - t0
-    return n_loops * STEPS_PER_LOOP * BATCH / dt
+    sps_staged = n_loops * STEPS_PER_LOOP * BATCH / dt
+    return {"staged": sps_staged, "cached": sps_cached,
+            "best": max(sps_staged, sps_cached or 0.0)}
 
 
 def _step_flops(train_step, state, x, y):
@@ -237,8 +267,10 @@ def main():
         os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "")
         import jax
         jax.config.update("jax_platforms", "cpu")
-        sps = measure_ncf()
-        print(f"# CPU baseline: {sps:,.0f} samples/s")
+        res = measure_ncf()
+        cached = (f"{res['cached']:,.0f}" if res["cached"] else "n/a")
+        print(f"# CPU baseline: {res['best']:,.0f} samples/s "
+              f"(staged {res['staged']:,.0f}, cached {cached})")
         return
     import jax
     out = {
@@ -248,9 +280,12 @@ def main():
         "vs_baseline": 0.0,
         "device": jax.devices()[0].device_kind,
     }
-    sps = measure_ncf()
-    out["value"] = round(sps, 1)
-    out["vs_baseline"] = round(sps / CPU_BASELINE_SPS, 3)
+    res = measure_ncf()
+    out["value"] = round(res["best"], 1)
+    out["vs_baseline"] = round(res["best"] / CPU_BASELINE_SPS, 3)
+    out["ncf_staged_sps"] = round(res["staged"], 1)
+    if res["cached"]:
+        out["ncf_hbm_cached_sps"] = round(res["cached"], 1)
     for part in (measure_bert, measure_tcn, measure_serving):
         try:
             out.update(part())
