@@ -17,6 +17,45 @@ import numpy as np
 from analytics_zoo_tpu.keras.engine import (GraphModule, Input, KerasLayer,
                                             Node, topo_sort)
 
+import pickle as _pickle
+
+
+def _activation_ids():
+    from analytics_zoo_tpu.keras.layers import _ACTIVATIONS
+    return {id(fn): name for name, fn in _ACTIVATIONS.items()}
+
+
+class _TopologyPickler(_pickle.Pickler):
+    """Reduces the two unpicklable callable kinds layers hold — registry
+    activations (incl. module-level lambdas) and flax initializer closures
+    — to symbolic persistent ids; everything else pickles normally."""
+
+    _MISSING = object()
+
+    def persistent_id(self, obj):
+        if callable(obj) and not isinstance(obj, type):
+            name = _activation_ids().get(id(obj), self._MISSING)
+            if name is not self._MISSING:
+                return ("activation", name)
+            mod = getattr(obj, "__module__", "") or ""
+            if "initializers" in mod:
+                # only used to INIT params; load_weights overwrites them,
+                # so a canonical default loses nothing after restore
+                return ("initializer", None)
+        return None
+
+
+class _TopologyUnpickler(_pickle.Unpickler):
+    def persistent_load(self, pid):
+        kind, name = pid
+        if kind == "activation":
+            from analytics_zoo_tpu.keras.layers import get_activation
+            return get_activation(name)
+        if kind == "initializer":
+            import flax.linen as nn
+            return nn.initializers.glorot_uniform()
+        raise _pickle.UnpicklingError(f"unknown persistent id {pid!r}")
+
 
 class KerasNet:
     """Shared compile/fit surface (ref Topology.scala KerasNet)."""
@@ -208,6 +247,39 @@ class KerasNet:
 
     def load_weights(self, path: str):
         self._ensure_estimator().load(path)
+
+    def save(self, path: str):
+        """Full model save: pickled topology (the layer/Node graph — layer
+        objects are plain config holders) + weights checkpoint
+        (ref Topology.scala saveModule: architecture + weights in one
+        artifact). Activation/initializer callables are reduced to registry
+        names; ``Lambda`` layers with unpicklable closures are the one
+        documented exception — use named functions there."""
+        import os
+        import pickle
+
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "topology.pkl"), "wb") as fh:
+            _TopologyPickler(fh, protocol=pickle.HIGHEST_PROTOCOL).dump(self)
+        self.save_weights(os.path.join(path, "weights"))
+        return path
+
+    @staticmethod
+    def load(path: str) -> "KerasNet":
+        """(ref Net.load for keras models)"""
+        import os
+
+        with open(os.path.join(path, "topology.pkl"), "rb") as fh:
+            model = _TopologyUnpickler(fh).load()
+        model.load_weights(os.path.join(path, "weights"))
+        return model
+
+    def __getstate__(self):
+        # topology + compile/strategy config only: the estimator (device
+        # arrays, jitted callables, writers) rebuilds lazily on load
+        state = dict(self.__dict__)
+        state["_estimator"] = None
+        return state
 
     def get_weights(self):
         return self._ensure_estimator().get_model()

@@ -207,3 +207,49 @@ def test_time_distributed_checkpoint_stable(orca_ctx, tmp_path):
     m2 = build()
     m1.save_weights(str(tmp_path / "w"))
     m2.load_weights(str(tmp_path / "w"))  # must not raise key mismatch
+
+
+def test_full_model_save_load_roundtrip(orca_ctx, tmp_path):
+    """Model.save/load persists TOPOLOGY + weights in one artifact (ref
+    Topology.scala saveModule) — no rebuilding code needed at load."""
+    from analytics_zoo_tpu.keras.models import KerasNet
+
+    m = Sequential()
+    m.add(zl.Dense(16, activation="relu", input_shape=(6,)))
+    m.add(zl.Dropout(0.1))
+    m.add(zl.Dense(3))
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 6).astype(np.float32)
+    y = rng.randint(0, 3, 64).astype(np.int32)
+    m.fit(x, y, batch_size=16, nb_epoch=2)
+    want = np.asarray(m.predict(x[:8]))
+
+    p = str(tmp_path / "full_model")
+    m.save(p)
+    loaded = KerasNet.load(p)
+    np.testing.assert_allclose(np.asarray(loaded.predict(x[:8])), want,
+                               atol=1e-5)
+    # the loaded model is trainable (compile config survived)
+    h = loaded.fit(x, y, batch_size=16, nb_epoch=1)
+    assert np.isfinite(h["loss"][0])
+
+
+def test_functional_model_save_load(orca_ctx, tmp_path):
+    from analytics_zoo_tpu.keras.models import KerasNet
+
+    a = Input(shape=(4,))
+    b = Input(shape=(4,))
+    out = zl.Dense(2)(zl.merge([zl.Dense(8)(a), zl.Dense(8)(b)],
+                               mode="concat"))
+    m = Model(input=[a, b], output=out)
+    m.compile(optimizer="adam", loss="mse")
+    xa = np.random.RandomState(1).randn(16, 4).astype(np.float32)
+    xb = np.random.RandomState(2).randn(16, 4).astype(np.float32)
+    m.fit([xa, xb], xa[:, :2], batch_size=8, nb_epoch=1)
+    want = np.asarray(m.predict([xa, xb]))
+    p = str(tmp_path / "func_model")
+    m.save(p)
+    loaded = KerasNet.load(p)
+    np.testing.assert_allclose(np.asarray(loaded.predict([xa, xb])), want,
+                               atol=1e-5)
