@@ -119,6 +119,13 @@ class WideAndDeep(Recommender):
         assert merged, "deep model needs indicator/embed/continuous columns"
         return inputs, merged
 
+    @staticmethod
+    def tp_param_rules():
+        """Tensor-parallel layout (new vs reference): categorical embedding
+        tables and dense kernels shard over the model axis."""
+        return [(r"embed_\d+/embedding$", (None, "model")),
+                (r"dense_\d+/kernel$", (None, "model"))]
+
     def _config(self):
         info = self.column_info
         return dict(class_num=self.class_num, model_type=self.model_type,

@@ -120,3 +120,33 @@ def test_session_recommender_with_history(orca_ctx):
     sr.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
     sr.fit([xs, xh], y, batch_size=16, nb_epoch=1)
     assert sr.predict([xs, xh]).shape == (n, items)
+
+
+def test_wide_and_deep_tensor_parallel(orca_ctx):
+    """W&D trains under dp2,tp4 with its embedding tables model-sharded
+    (tp_param_rules — same new capability NCF has)."""
+    from analytics_zoo_tpu.parallel import mesh as mesh_lib
+
+    info = ColumnFeatureInfo(
+        wide_base_cols=["a"], wide_base_dims=[16],
+        embed_cols=["u", "i"], embed_in_dims=[32, 32],
+        embed_out_dims=[8, 8], continuous_cols=["age"])
+    n = 64
+    rng = np.random.default_rng(1)
+    wide = np.zeros((n, 16), np.float32)
+    wide[np.arange(n), rng.integers(0, 16, n)] = 1.0
+    emb = np.stack([rng.integers(1, 33, n), rng.integers(1, 33, n)],
+                   1).astype(np.float32)
+    con = rng.normal(size=(n, 1)).astype(np.float32)
+    y = rng.integers(0, 2, n)
+
+    wnd = WideAndDeep(2, info, model_type="wide_n_deep")
+    wnd.model.set_strategy("dp2,tp4",
+                           param_rules=WideAndDeep.tp_param_rules())
+    wnd.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    h = wnd.fit([wide, emb, con], y, batch_size=32, nb_epoch=2)
+    assert all(np.isfinite(v) for v in h["loss"])
+    est = wnd.model.estimator
+    table = est._state["params"]["embed_0"]["embedding"]
+    assert "model" in str(table.sharding.spec), table.sharding.spec
+    mesh_lib.set_default_mesh(None)
