@@ -13,10 +13,13 @@ Top-level subpackages (mirroring the reference layer map, SURVEY.md §1):
 - ``data``     — XShards sharded data layer (ref: pyzoo/zoo/orca/data/shard.py)
 - ``parallel`` — mesh / sharding strategies / collectives (new capability; ref had
   data-parallel only, see reference Topology.scala:1145-1550)
-- ``ops``      — pallas TPU kernels (flash attention, ring attention)
+- ``ops``      — pallas TPU kernels + parallel attention (flash, ring, Ulysses, MoE)
 - ``learn``    — Orca-style Estimators: fit/predict/evaluate (ref:
   pyzoo/zoo/orca/learn/)
 - ``keras``    — Keras-style layer/model API (ref: pyzoo/zoo/pipeline/api/keras/)
+- ``keras2``   — Keras-2 argument spellings (ref: pyzoo/zoo/pipeline/api/keras2/)
+- ``net``      — model import: torch fx translation, ONNX protobuf parser
+- ``inference``— InferenceModel + int8 weight quantization
 - ``models``   — model zoo (ref: pyzoo/zoo/models/, zoo/.../models/)
 - ``automl``   — hyperparameter search (ref: pyzoo/zoo/automl/)
 - ``zouwu``    — time series: forecasters, AutoTS, anomaly (ref: pyzoo/zoo/zouwu/)
