@@ -84,10 +84,39 @@ def logloss(y_true, y_pred):
     return float(-np.mean(t * np.log(p) + (1 - t) * np.log(1 - p)))
 
 
+def auc(y_true, y_pred):
+    """Binary ROC AUC via the Mann-Whitney rank statistic (ref Evaluator
+    AUC). ``y_pred``: scores, or 2-column probabilities (column 1 used)."""
+    y_true = np.asarray(y_true).reshape(-1)
+    y_pred = np.asarray(y_pred, dtype=np.float64)
+    if y_pred.ndim == 2 and y_pred.shape[1] == 2:
+        y_pred = y_pred[:, 1]
+    y_pred = y_pred.reshape(-1)
+    pos = y_true == 1
+    n_pos, n_neg = int(pos.sum()), int((~pos).sum())
+    if n_pos == 0 or n_neg == 0:
+        raise ValueError("AUC needs both classes present")
+    # average ranks so ties contribute 0.5
+    order = np.argsort(y_pred)
+    ranks = np.empty(len(y_pred), np.float64)
+    ranks[order] = np.arange(1, len(y_pred) + 1)
+    sorted_p = y_pred[order]
+    i = 0
+    while i < len(sorted_p):
+        j = i
+        while j + 1 < len(sorted_p) and sorted_p[j + 1] == sorted_p[i]:
+            j += 1
+        if j > i:
+            ranks[order[i:j + 1]] = (i + j + 2) / 2.0
+        i = j + 1
+    return float((ranks[pos].sum() - n_pos * (n_pos + 1) / 2)
+                 / (n_pos * n_neg))
+
+
 _METRICS: Dict[str, Callable] = {
     "mse": mse, "rmse": rmse, "mae": mae, "r2": r2, "mape": mape,
     "smape": smape, "mpe": mpe, "mspe": mspe, "accuracy": accuracy,
-    "logloss": logloss,
+    "logloss": logloss, "auc": auc,
 }
 
 # metrics where smaller is better (used to orient the search)

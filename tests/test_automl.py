@@ -79,6 +79,23 @@ class TestEvaluator:
         logits = np.eye(3)
         assert Evaluator.evaluate("accuracy", y, logits) == 1.0
 
+    def test_auc(self):
+        y = np.array([0, 0, 1, 1])
+        assert Evaluator.evaluate("auc", y,
+                                  np.array([0.1, 0.2, 0.8, 0.9])) == 1.0
+        assert Evaluator.evaluate("auc", y,
+                                  np.array([0.9, 0.8, 0.2, 0.1])) == 0.0
+        # ties average to 0.5 credit
+        assert Evaluator.evaluate(
+            "auc", y, np.array([0.5, 0.5, 0.5, 0.5])) == pytest.approx(0.5)
+        # 2-column probabilities use column 1
+        probs = np.stack([1 - np.array([0.1, 0.2, 0.8, 0.9]),
+                          np.array([0.1, 0.2, 0.8, 0.9])], 1)
+        assert Evaluator.evaluate("auc", y, probs) == 1.0
+        assert Evaluator.get_metric_mode("auc") == "max"
+        with pytest.raises(ValueError, match="both classes"):
+            Evaluator.evaluate("auc", np.zeros(4), np.arange(4.0))
+
 
 class TestSearchEngine:
     def test_grid_random_counts_and_best(self, tmp_path, orca_ctx):
