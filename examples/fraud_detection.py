@@ -75,10 +75,8 @@ def main():
     logits = np.asarray(est.predict(x[split:], batch_size=512))
     probs = np.asarray(jax.nn.softmax(logits, -1))[:, 1]
     yt = y[split:]
-    # rank-statistic AUC (Mann-Whitney)
-    ranks = np.argsort(np.argsort(probs)) + 1
-    npos, nneg = (yt == 1).sum(), (yt == 0).sum()
-    auc = (ranks[yt == 1].sum() - npos * (npos + 1) / 2) / (npos * nneg)
+    from analytics_zoo_tpu.automl.metrics import Evaluator
+    auc = Evaluator.evaluate("auc", yt, probs)
     # recall at the threshold flagging 1% of traffic
     thresh = np.quantile(probs, 0.99)
     flagged = probs >= thresh
