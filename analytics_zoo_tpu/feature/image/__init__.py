@@ -6,5 +6,5 @@ from analytics_zoo_tpu.feature.image.transforms import (  # noqa: F401
     ImageChannelScaledNormalizer, ImageBrightness, ImageContrast,
     ImageSaturation, ImageHue, ImageColorJitter, ImageExpand, ImageFiller,
     ImageRandomPreprocessing, ImageBytesToArray, ImageSetToSample,
-    ImageMatToTensor,
+    ImageMatToTensor, ImageMirror, ImageChannelOrder, PerImageNormalize,
 )

@@ -33,7 +33,8 @@ __all__ = [
     "ImageChannelScaledNormalizer", "ImageBrightness", "ImageContrast",
     "ImageSaturation", "ImageHue", "ImageColorJitter", "ImageExpand",
     "ImageFiller", "ImageRandomPreprocessing", "ImageBytesToArray",
-    "ImageSetToSample", "ImageMatToTensor",
+    "ImageSetToSample", "ImageMatToTensor", "ImageMirror",
+    "ImageChannelOrder", "PerImageNormalize",
 ]
 
 
@@ -367,6 +368,37 @@ class ImageFiller(ImagePreprocessing):
         x1, y1, x2, y2 = self.box
         img[int(y1 * h):int(y2 * h), int(x1 * w):int(x2 * w)] = self.value
         return img
+
+
+class ImageMirror(ImagePreprocessing):
+    """Unconditional horizontal mirror (ref ImageMirror.scala — the always-on
+    counterpart of ImageHFlip's random flip)."""
+
+    def apply_image(self, img):
+        return np.ascontiguousarray(img[:, ::-1])
+
+
+class ImageChannelOrder(ImagePreprocessing):
+    """Swap channel order, e.g. RGB<->BGR (ref ImageChannelOrder.scala)."""
+
+    def apply_image(self, img):
+        return np.ascontiguousarray(img[..., ::-1])
+
+
+class PerImageNormalize(ImagePreprocessing):
+    """Scale each image to [min, max] by its own range (ref
+    pyzoo imagePreprocessing.py PerImageNormalize)."""
+
+    def __init__(self, min_val: float = 0.0, max_val: float = 1.0):
+        self.min_val, self.max_val = float(min_val), float(max_val)
+
+    def apply_image(self, img):
+        img = _to_float(img)
+        lo, hi = float(img.min()), float(img.max())
+        span = hi - lo
+        if span == 0.0:
+            return np.full_like(img, self.min_val)
+        return (img - lo) / span * (self.max_val - self.min_val) + self.min_val
 
 
 class ImageRandomPreprocessing(ImagePreprocessing):

@@ -37,7 +37,7 @@ _MODEL_KEYS = {
     "VanillaLSTM": ("lstm_units", "dropouts"),
     "TCN": ("num_channels", "kernel_size"),
     "Seq2Seq": ("latent_dim", "dropout"),
-    "MTNet": ("long_series_num", "series_length"),
+    "MTNet": ("long_series_num", "series_length", "ar_window"),
 }
 
 
@@ -51,7 +51,13 @@ def _build_forecaster(config: dict, future_seq_len: int):
         if "lstm_units" in kw:
             kw["lstm_units"] = tuple(kw["lstm_units"])
         if "dropouts" in kw:
-            kw["dropouts"] = tuple(kw["dropouts"])
+            d = kw["dropouts"]
+            # recipes may sample a scalar rate (e.g. RandomRecipe's
+            # hp.uniform) — apply it to every LSTM layer
+            if np.isscalar(d):
+                n = len(kw.get("lstm_units", (None, None)))
+                d = (float(d),) * n
+            kw["dropouts"] = tuple(d)
         return LSTMForecaster(target_dim=future_seq_len, optimizer=opt, **kw)
     if model == "TCN":
         if "num_channels" in kw:
@@ -222,7 +228,8 @@ class AutoTSTrainer:
         self.engine.compile(train_df, recipe.search_space(),
                             n_sampling=rt["n_sampling"], epochs=rt["epochs"],
                             validation_data=validation_df, metric=metric,
-                            scheduler=scheduler)
+                            scheduler=scheduler,
+                            search_alg=rt.get("search_alg"))
         self.engine.run()
         best = self.engine.get_best_trial()
         model = self.builder.build(best.config)

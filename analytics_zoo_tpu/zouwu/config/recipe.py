@@ -17,12 +17,14 @@ class Recipe:
 
     num_samples: int = 1
     epochs: int = 1
+    search_alg: "str | None" = None
 
     def search_space(self, all_available_features=None) -> dict:
         raise NotImplementedError
 
     def runtime_params(self) -> dict:
-        return {"n_sampling": self.num_samples, "epochs": self.epochs}
+        return {"n_sampling": self.num_samples, "epochs": self.epochs,
+                "search_alg": self.search_alg}
 
 
 class SmokeRecipe(Recipe):
@@ -42,6 +44,54 @@ class SmokeRecipe(Recipe):
         }
 
 
+class MTNetSmokeRecipe(Recipe):
+    """One tiny MTNet config — CI smoke (ref recipe.py MTNetSmokeRecipe)."""
+
+    num_samples = 1
+    epochs = 2
+
+    def search_space(self, all_available_features=None):
+        return {
+            "model": "MTNet",
+            "long_series_num": 2,
+            "series_length": 4,
+            "ar_window": 2,
+            "lr": 1e-2,
+            "batch_size": 32,
+        }
+
+
+class TCNSmokeRecipe(Recipe):
+    """One tiny TCN config — CI smoke (ref recipe.py TCNSmokeRecipe)."""
+
+    num_samples = 1
+    epochs = 2
+
+    def search_space(self, all_available_features=None):
+        return {
+            "model": "TCN",
+            "past_seq_len": 12,
+            "num_channels": (16, 16),
+            "kernel_size": 3,
+            "lr": 1e-2,
+            "batch_size": 32,
+        }
+
+
+class PastSeqParamHandler:
+    """Spell a look_back spec as an hp axis (ref recipe.py:93)."""
+
+    @staticmethod
+    def get_past_seq_config(look_back):
+        if isinstance(look_back, (tuple, list)):
+            if len(look_back) != 2 or look_back[1] < look_back[0]:
+                raise ValueError(
+                    "look_back should be an int or an ordered (min, max) "
+                    f"tuple, got {look_back!r}")
+            return hp.randint(look_back[0], look_back[1] + 1)
+        return look_back
+
+
 class GridRandomRecipe(Recipe):
     """Grid over model family x random draws of its hyperparameters
     (ref recipe.py GridRandomRecipe)."""
@@ -53,9 +103,7 @@ class GridRandomRecipe(Recipe):
         self.look_back = look_back
 
     def _past_seq(self):
-        if isinstance(self.look_back, (tuple, list)):
-            return hp.randint(self.look_back[0], self.look_back[1] + 1)
-        return self.look_back
+        return PastSeqParamHandler.get_past_seq_config(self.look_back)
 
     def search_space(self, all_available_features=None):
         return {
@@ -112,6 +160,23 @@ class Seq2SeqRandomRecipe(GridRandomRecipe):
         }
 
 
+class LSTMSeq2SeqRandomRecipe(GridRandomRecipe):
+    """Random draws across both LSTM and Seq2Seq families
+    (ref recipe.py LSTMSeq2SeqRandomRecipe)."""
+
+    def search_space(self, all_available_features=None):
+        return {
+            "model": hp.grid_search(["VanillaLSTM", "Seq2Seq"]),
+            "past_seq_len": self._past_seq(),
+            "lstm_units": hp.choice([(16, 16), (32, 32), (64, 64)]),
+            "dropouts": hp.choice([(0.1, 0.1), (0.2, 0.2)]),
+            "latent_dim": hp.choice([32, 64, 128]),
+            "dropout": hp.uniform(0.0, 0.3),
+            "lr": hp.loguniform(1e-3, 1e-2),
+            "batch_size": hp.choice([32, 64]),
+        }
+
+
 class MTNetGridRandomRecipe(GridRandomRecipe):
     """(ref recipe.py MTNetGridRandomRecipe)"""
 
@@ -124,4 +189,81 @@ class MTNetGridRandomRecipe(GridRandomRecipe):
             "series_length": hp.choice([4, 8]),
             "lr": hp.loguniform(1e-3, 1e-2),
             "batch_size": hp.choice([32, 64]),
+        }
+
+
+class RandomRecipe(GridRandomRecipe):
+    """Pure random search, no grid axes (ref recipe.py RandomRecipe)."""
+
+    def __init__(self, num_rand_samples: int = 1, epochs: int = 5,
+                 look_back: "int | tuple" = 24):
+        super().__init__(num_rand_samples, epochs, look_back)
+
+    def search_space(self, all_available_features=None):
+        return {
+            "model": hp.choice(["VanillaLSTM", "TCN"]),
+            "past_seq_len": self._past_seq(),
+            "lstm_units": hp.choice([(16, 16), (32, 32), (64, 64)]),
+            "dropouts": hp.uniform(0.0, 0.3),
+            "num_channels": hp.choice([(16, 16), (30, 30, 30)]),
+            "kernel_size": hp.choice([2, 3, 5]),
+            "lr": hp.loguniform(1e-4, 1e-1),
+            "batch_size": hp.qrandint(32, 128, 32),
+        }
+
+
+class BayesRecipe(Recipe):
+    """Search space shaped for the bayes (TPE-style) search alg — continuous
+    axes only, consumed with ``search_alg="bayes"``
+    (ref recipe.py BayesRecipe, skopt BayesOptSearch there)."""
+
+    search_alg = "bayes"
+
+    def __init__(self, num_samples: int = 1, epochs: int = 5,
+                 look_back: "int | tuple" = 24):
+        self.num_samples = num_samples
+        self.epochs = epochs
+        self.look_back = look_back
+
+    def search_space(self, all_available_features=None):
+        return {
+            "model": "TCN",
+            "past_seq_len":
+                PastSeqParamHandler.get_past_seq_config(self.look_back),
+            "num_channels": hp.choice([(16, 16), (30, 30, 30)]),
+            "kernel_size": hp.randint(2, 6),
+            "lr": hp.loguniform(1e-4, 1e-1),
+            "batch_size": hp.qrandint(32, 128, 32),
+        }
+
+
+class XgbRegressorGridRandomRecipe(Recipe):
+    """Search space for AutoXGBRegressor (ref recipe.py
+    XgbRegressorGridRandomRecipe)."""
+
+    def __init__(self, num_rand_samples: int = 1):
+        self.num_samples = num_rand_samples
+
+    def search_space(self, all_available_features=None):
+        return {
+            "n_estimators": hp.grid_search([50, 100]),
+            "max_depth": hp.grid_search([2, 4]),
+            "min_child_weight": hp.choice([1, 2, 3]),
+            "learning_rate": hp.loguniform(1e-3, 1e-1),
+        }
+
+
+class XgbRegressorSkOptRecipe(Recipe):
+    """Continuous XGB space for the bayes search alg (ref recipe.py
+    XgbRegressorSkOptRecipe, skopt there)."""
+
+    search_alg = "bayes"
+
+    def __init__(self, num_rand_samples: int = 10):
+        self.num_samples = num_rand_samples
+
+    def search_space(self, all_available_features=None):
+        return {
+            "n_estimators": hp.randint(5, 10),
+            "max_depth": hp.randint(2, 5),
         }

@@ -6,8 +6,11 @@ import pytest
 
 from analytics_zoo_tpu.zouwu.autots import AutoTSTrainer, TSPipeline
 from analytics_zoo_tpu.zouwu.config import (
-    GridRandomRecipe, LSTMGridRandomRecipe, MTNetGridRandomRecipe,
-    Seq2SeqRandomRecipe, SmokeRecipe, TCNGridRandomRecipe,
+    BayesRecipe, GridRandomRecipe, LSTMGridRandomRecipe,
+    LSTMSeq2SeqRandomRecipe, MTNetGridRandomRecipe, MTNetSmokeRecipe,
+    PastSeqParamHandler, RandomRecipe, Seq2SeqRandomRecipe, SmokeRecipe,
+    TCNGridRandomRecipe, TCNSmokeRecipe, XgbRegressorGridRandomRecipe,
+    XgbRegressorSkOptRecipe,
 )
 
 
@@ -22,15 +25,27 @@ class TestRecipes:
     def test_search_spaces_materialize(self):
         from analytics_zoo_tpu.automl import hp
         rng = np.random.default_rng(0)
-        for recipe in [SmokeRecipe(), GridRandomRecipe(),
-                       LSTMGridRandomRecipe(), TCNGridRandomRecipe(),
-                       Seq2SeqRandomRecipe(), MTNetGridRandomRecipe()]:
+        for recipe in [SmokeRecipe(), MTNetSmokeRecipe(), TCNSmokeRecipe(),
+                       GridRandomRecipe(), LSTMGridRandomRecipe(),
+                       LSTMSeq2SeqRandomRecipe(), TCNGridRandomRecipe(),
+                       Seq2SeqRandomRecipe(), MTNetGridRandomRecipe(),
+                       RandomRecipe(), BayesRecipe()]:
             space = recipe.search_space()
             for gp in hp.grid_points(space):
                 cfg = hp.sample_config(space, rng, gp)
                 assert "model" in cfg
             rt = recipe.runtime_params()
             assert rt["n_sampling"] >= 1 and rt["epochs"] >= 1
+        for recipe in [XgbRegressorGridRandomRecipe(),
+                       XgbRegressorSkOptRecipe()]:
+            space = recipe.search_space()
+            for gp in hp.grid_points(space):
+                cfg = hp.sample_config(space, rng, gp)
+                assert "n_estimators" in cfg and "max_depth" in cfg
+
+    def test_bayes_recipe_declares_search_alg(self):
+        assert BayesRecipe().runtime_params()["search_alg"] == "bayes"
+        assert XgbRegressorSkOptRecipe().runtime_params()["search_alg"] == "bayes"
 
     def test_look_back_range(self):
         r = LSTMGridRandomRecipe(look_back=(10, 20))
@@ -39,6 +54,8 @@ class TestRecipes:
         for _ in range(20):
             v = s["past_seq_len"].sample(rng)
             assert 10 <= v <= 20
+        with pytest.raises(ValueError):
+            PastSeqParamHandler.get_past_seq_config((20, 10))
 
 
 class TestAutoTS:
@@ -83,6 +100,15 @@ class TestAutoTS:
         trainer = AutoTSTrainer(horizon=2, logs_dir=str(tmp_path))
         recipe = TCNGridRandomRecipe(num_rand_samples=1, epochs=1,
                                      look_back=12)
+        ts = trainer.fit(train, val, recipe=recipe)
+        assert ts.config["model"] == "TCN"
+        assert ts.predict(val).shape[1] == 2
+
+    def test_bayes_recipe_search(self, tmp_path, orca_ctx):
+        df = sine_df(160)
+        train, val = df.iloc[:120], df.iloc[100:]
+        trainer = AutoTSTrainer(horizon=2, logs_dir=str(tmp_path))
+        recipe = BayesRecipe(num_samples=2, epochs=1, look_back=12)
         ts = trainer.fit(train, val, recipe=recipe)
         assert ts.config["model"] == "TCN"
         assert ts.predict(val).shape[1] == 2

@@ -16,7 +16,9 @@ ALL = ["recommendation_ncf.py", "anomaly_detection.py",
        "autots_forecast.py", "cluster_serving.py", "torch_migration.py",
        "distributed_training.py", "dogs_vs_cats_transfer.py",
        "sentiment_analysis.py", "vae.py", "fraud_detection.py",
-       "image_similarity.py"]
+       "image_similarity.py", "wide_and_deep.py", "object_detection.py",
+       "image_augmentation.py", "model_inference.py",
+       "automl_hp_search.py"]
 
 
 @pytest.mark.parametrize("script", ALL)

@@ -8,7 +8,8 @@ from analytics_zoo_tpu.feature.image import (
     ImageSet, ImageResize, ImageCenterCrop, ImageRandomCrop, ImageHFlip,
     ImageChannelNormalize, ImageBrightness, ImageAspectScale,
     ImageColorJitter, ImageExpand, ImageSetToSample, ChainedPreprocessing,
-    ImageMatToTensor, ImageRandomPreprocessing,
+    ImageMatToTensor, ImageRandomPreprocessing, ImageMirror,
+    ImageChannelOrder, PerImageNormalize,
 )
 from analytics_zoo_tpu.feature.text import TextSet
 
@@ -70,6 +71,22 @@ class TestImageSet:
         img = np.zeros((4, 4, 3), np.float32)
         out = ImageBrightness(10, 10).apply_image(img)
         assert np.allclose(out, 10.0)
+
+    def test_mirror_and_channel_order(self):
+        img = _imgs(1)[0]
+        assert np.array_equal(ImageMirror().apply_image(img), img[:, ::-1])
+        bgr = ImageChannelOrder().apply_image(img)
+        assert np.array_equal(bgr[..., 0], img[..., 2])
+        assert np.array_equal(
+            ImageChannelOrder().apply_image(bgr), img)
+
+    def test_per_image_normalize(self):
+        img = _imgs(1)[0]
+        out = PerImageNormalize(0.0, 1.0).apply_image(img)
+        assert out.min() == pytest.approx(0.0) and out.max() == pytest.approx(1.0)
+        flat = PerImageNormalize(0.5, 1.0).apply_image(
+            np.full((4, 4, 3), 7, np.uint8))
+        assert np.allclose(flat, 0.5)
 
     def test_read_from_disk_with_label(self, tmp_path):
         from PIL import Image
