@@ -5,5 +5,6 @@ from analytics_zoo_tpu.feature.image3d.transforms import (  # noqa: F401
     ImagePreprocessing3D,
     RandomCrop3D,
     Rotate3D,
+    Warp3D,
     rotation_matrix,
 )

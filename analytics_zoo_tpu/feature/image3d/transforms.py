@@ -28,7 +28,7 @@ from analytics_zoo_tpu.feature.image.transforms import (
 
 __all__ = [
     "ImagePreprocessing3D", "Crop3D", "RandomCrop3D", "CenterCrop3D",
-    "AffineTransform3D", "Rotate3D", "rotation_matrix",
+    "AffineTransform3D", "Rotate3D", "Warp3D", "rotation_matrix",
 ]
 
 
@@ -152,6 +152,59 @@ class AffineTransform3D(ImagePreprocessing3D):
                     wx = frac[:, 2] if dx else 1.0 - frac[:, 2]
                     out += (wz * wy * wx)[:, None] * gather((dz, dy, dx))
         out = out.reshape(D, H, W, C)
+        return out[..., 0] if squeeze else out
+
+
+class Warp3D(ImagePreprocessing3D):
+    """Warp a volume by a dense flow field (ref WarpTransformer /
+    Warp.scala): ``flow_field`` has shape ``(3, D, H, W)`` holding per-voxel
+    source coordinates — absolute when ``offset=False``, destination-
+    relative displacements when ``offset=True`` — sampled trilinearly with
+    the same clamp/padding semantics as AffineTransform3D."""
+
+    def __init__(self, flow_field: np.ndarray, offset: bool = True,
+                 clamp_mode: str = "clamp", pad_val: float = 0.0):
+        self.flow = np.asarray(flow_field, np.float64)
+        if self.flow.ndim != 4 or self.flow.shape[0] != 3:
+            raise ValueError(f"flow_field must be (3, D, H, W), got "
+                             f"{self.flow.shape}")
+        if clamp_mode not in ("clamp", "padding"):
+            raise ValueError("clamp_mode must be 'clamp' or 'padding'")
+        self.offset = bool(offset)
+        self.clamp_mode = clamp_mode
+        self.pad_val = float(pad_val)
+
+    def apply_image(self, img):
+        v = _vol(img).astype(np.float32)
+        squeeze = v.ndim == 3
+        if squeeze:
+            v = v[..., None]
+        D, H, W, C = v.shape
+        fd, fh, fw = self.flow.shape[1:]
+        src = self.flow.reshape(3, -1).T.copy()         # [N, 3] (z, y, x)
+        if self.offset:
+            zz, yy, xx = np.meshgrid(np.arange(fd), np.arange(fh),
+                                     np.arange(fw), indexing="ij")
+            src += np.stack([zz, yy, xx], -1).reshape(-1, 3)
+
+        limits = np.array([D, H, W]) - 1
+        off_vol = ((src < 0) | (src > limits)).any(axis=1)
+        src = np.clip(src, 0, limits)
+        lo = np.floor(src).astype(np.int64)
+        frac = (src - lo).astype(np.float32)
+        out = np.zeros((src.shape[0], C), np.float32)
+        for dz in (0, 1):
+            for dy in (0, 1):
+                for dx in (0, 1):
+                    idx = np.minimum(lo + (dz, dy, dx), limits)
+                    wz = frac[:, 0] if dz else 1.0 - frac[:, 0]
+                    wy = frac[:, 1] if dy else 1.0 - frac[:, 1]
+                    wx = frac[:, 2] if dx else 1.0 - frac[:, 2]
+                    out += (wz * wy * wx)[:, None] * \
+                        v[idx[:, 0], idx[:, 1], idx[:, 2]]
+        if self.clamp_mode == "padding":
+            out = np.where(off_vol[:, None], self.pad_val, out)
+        out = out.reshape(fd, fh, fw, C)
         return out[..., 0] if squeeze else out
 
 

@@ -1,4 +1,4 @@
 from analytics_zoo_tpu.feature.text.textset import (  # noqa: F401
     TextFeature, TextSet, Tokenizer, Normalizer, WordIndexer,
-    SequenceShaper, TextFeatureToSample,
+    SequenceShaper, TextFeatureToSample, Relation, Relations,
 )

@@ -30,6 +30,51 @@ class TextFeature(dict):
         return self.get("text")
 
 
+class Relation:
+    """A (id1, id2, label) relationship between two corpus items
+    (ref pyzoo/zoo/feature/common.py:30 Relation)."""
+
+    __slots__ = ("id1", "id2", "label")
+
+    def __init__(self, id1, id2, label):
+        self.id1, self.id2, self.label = str(id1), str(id2), int(label)
+
+    def to_tuple(self):
+        return self.id1, self.id2, self.label
+
+    def __repr__(self):
+        return f"Relation [id1: {self.id1}, id2: {self.id2}, " \
+               f"label: {self.label}]"
+
+    def __eq__(self, other):
+        return isinstance(other, Relation) and \
+            self.to_tuple() == other.to_tuple()
+
+
+class Relations:
+    """Relation readers (ref pyzoo/zoo/feature/common.py:52 Relations.read /
+    read_parquet — csv/txt rows are ``id1,id2,label`` without header)."""
+
+    @staticmethod
+    def read(path: str) -> List[Relation]:
+        out = []
+        with open(path, "r", errors="ignore") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                id1, id2, label = line.split(",")[:3]
+                out.append(Relation(id1, id2, int(label)))
+        return out
+
+    @staticmethod
+    def read_parquet(path: str) -> List[Relation]:
+        import pandas as pd
+        df = pd.read_parquet(path)
+        return [Relation(r.id1, r.id2, int(r.label))
+                for r in df.itertuples(index=False)]
+
+
 class TextTransformer:
     """Base stage (ref text/TextTransformer.scala)."""
 
@@ -123,12 +168,15 @@ class TextSet:
 
     @classmethod
     def from_texts(cls, texts: Sequence[str], labels: Optional[Sequence] = None,
-                   num_shards: Optional[int] = None) -> "TextSet":
+                   num_shards: Optional[int] = None,
+                   ids: Optional[Sequence[str]] = None) -> "TextSet":
         feats = []
         for i, t in enumerate(texts):
             f = TextFeature(text=t)
             if labels is not None:
                 f["label"] = labels[i]
+            if ids is not None:
+                f["id"] = str(ids[i])
             feats.append(f)
         return cls(HostXShards.from_records(feats, num_shards))
 
@@ -152,13 +200,87 @@ class TextSet:
 
     @classmethod
     def read_csv(cls, path: str, num_shards: Optional[int] = None) -> "TextSet":
-        """Read ``id,text,label`` csv (ref TextSet.readCSV used by QA)."""
+        """Read ``id,text[,label]`` csv (ref TextSet.readCSV used by QA —
+        the id column keys relation joins)."""
         import pandas as pd
         df = pd.read_csv(path)
         cols = list(df.columns)
         labels = df[cols[2]].tolist() if len(cols) > 2 else None
         return cls.from_texts(df[cols[1]].astype(str).tolist(), labels,
-                              num_shards)
+                              num_shards,
+                              ids=df[cols[0]].astype(str).tolist())
+
+    # ---------- QA-ranking relation joins (ref TextSet.scala
+    # fromRelationPairs/fromRelationLists; pyzoo text_set.py:369,401) ----------
+
+    @staticmethod
+    def _corpus_index(corpus: "TextSet", what: str) -> Dict[str, np.ndarray]:
+        idx: Dict[str, np.ndarray] = {}
+        for f in corpus._features():
+            if "id" not in f or "indexed_tokens" not in f:
+                raise ValueError(
+                    f"{what} features need an 'id' and indexed tokens — "
+                    "read with ids and run tokenize/word2idx/shape_sequence "
+                    "first")
+            idx[f["id"]] = np.asarray(f["indexed_tokens"], np.int32)
+        return idx
+
+    @classmethod
+    def from_relation_pairs(cls, relations: Sequence["Relation | tuple"],
+                            corpus1: "TextSet", corpus2: "TextSet",
+                            num_shards: Optional[int] = None) -> "TextSet":
+        """Pairwise-ranking TextSet: for each id1, every (positive id2,
+        negative id2) combination becomes one feature whose sample is
+        ``x: (2, len1+len2)`` int ids (positive row first) and
+        ``y: (2, 1) = [[1],[0]]`` (ref text_set.py:369 — same join, minus
+        the RDD machinery; corpora must be shaped to fixed lengths)."""
+        c1 = cls._corpus_index(corpus1, "corpus1")
+        c2 = cls._corpus_index(corpus2, "corpus2")
+        pos: Dict[str, List[str]] = {}
+        neg: Dict[str, List[str]] = {}
+        for r in relations:
+            id1, id2, label = r.to_tuple() if isinstance(r, Relation) else r
+            (pos if int(label) > 0 else neg).setdefault(str(id1), []).append(
+                str(id2))
+        feats = []
+        y = np.array([[1.0], [0.0]], np.float32)
+        for id1 in sorted(pos):
+            if id1 not in neg:
+                continue
+            t1 = c1[id1]
+            for p in pos[id1]:
+                for n in neg[id1]:
+                    x = np.stack([np.concatenate([t1, c2[p]]),
+                                  np.concatenate([t1, c2[n]])])
+                    feats.append(TextFeature(
+                        id=id1, sample={"x": x.astype(np.float32), "y": y}))
+        return cls(HostXShards.from_records(feats, num_shards),
+                   corpus1.get_word_index())
+
+    @classmethod
+    def from_relation_lists(cls, relations: Sequence["Relation | tuple"],
+                            corpus1: "TextSet", corpus2: "TextSet",
+                            num_shards: Optional[int] = None) -> "TextSet":
+        """Listwise-ranking TextSet: group relations by id1; each feature's
+        sample is ``x: (list_len, len1+len2)`` and ``y: (list_len, 1)``
+        labels, for ranking metrics like NDCG/MAP (ref text_set.py:401)."""
+        c1 = cls._corpus_index(corpus1, "corpus1")
+        c2 = cls._corpus_index(corpus2, "corpus2")
+        grouped: Dict[str, List[Tuple[str, int]]] = {}
+        for r in relations:
+            id1, id2, label = r.to_tuple() if isinstance(r, Relation) else r
+            grouped.setdefault(str(id1), []).append((str(id2), int(label)))
+        feats = []
+        for id1 in sorted(grouped):
+            t1 = c1[id1]
+            rows = np.stack([np.concatenate([t1, c2[id2]])
+                             for id2, _ in grouped[id1]])
+            labels = np.asarray([[lab] for _, lab in grouped[id1]],
+                                np.float32)
+            feats.append(TextFeature(
+                id=id1, sample={"x": rows.astype(np.float32), "y": labels}))
+        return cls(HostXShards.from_records(feats, num_shards),
+                   corpus1.get_word_index())
 
     # ---------- pipeline stages ----------
 

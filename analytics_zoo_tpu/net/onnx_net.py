@@ -24,44 +24,19 @@ from typing import Any, Dict, List, Tuple
 import numpy as np
 
 # ------------------------------------------------------------------ protobuf
+# wire-level decoding is shared with data/tfrecord.py: common/protowire.py
 
-WIRE_VARINT, WIRE_I64, WIRE_LEN, WIRE_I32 = 0, 1, 2, 5
+from analytics_zoo_tpu.common.protowire import (  # noqa: E402
+    WIRE_I32, WIRE_I64, WIRE_LEN, WIRE_VARINT, iter_fields, read_varint,
+)
 
-
-def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
-    out = 0
-    shift = 0
-    while True:
-        b = buf[i]
-        i += 1
-        out |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return out, i
-        shift += 7
+_read_varint = read_varint
 
 
 def _fields(buf: bytes) -> Dict[int, List[Tuple[int, Any]]]:
     """Parse one message into {field_number: [(wire_type, value), ...]}."""
     out: Dict[int, List[Tuple[int, Any]]] = {}
-    i = 0
-    n = len(buf)
-    while i < n:
-        key, i = _read_varint(buf, i)
-        field, wt = key >> 3, key & 7
-        if wt == WIRE_VARINT:
-            v, i = _read_varint(buf, i)
-        elif wt == WIRE_I64:
-            v = buf[i:i + 8]
-            i += 8
-        elif wt == WIRE_LEN:
-            ln, i = _read_varint(buf, i)
-            v = buf[i:i + ln]
-            i += ln
-        elif wt == WIRE_I32:
-            v = buf[i:i + 4]
-            i += 4
-        else:
-            raise ValueError(f"unsupported protobuf wire type {wt}")
+    for field, wt, v in iter_fields(buf):
         out.setdefault(field, []).append((wt, v))
     return out
 

@@ -11,7 +11,7 @@ from analytics_zoo_tpu.feature.image import (
     ImageMatToTensor, ImageRandomPreprocessing, ImageMirror,
     ImageChannelOrder, PerImageNormalize,
 )
-from analytics_zoo_tpu.feature.text import TextSet
+from analytics_zoo_tpu.feature.text import Relation, Relations, TextSet
 
 
 def _imgs(n=6, h=24, w=32):
@@ -159,3 +159,96 @@ class TestTextSet:
         assert emb.shape == (3, 2)
         assert np.allclose(emb[1], [1.0, 2.0])
         assert np.allclose(emb[0], 0.0)
+
+
+class TestRelations:
+    def _corpora(self):
+        q = TextSet.from_texts(["what is tpu", "how fast is light"],
+                               ids=["q1", "q2"])
+        a = TextSet.from_texts(
+            ["a tensor processing unit", "a kind of pasta",
+             "three hundred thousand km per second", "a type of bird"],
+            ids=["a1", "a2", "a3", "a4"])
+        q = q.tokenize().normalize().word2idx().shape_sequence(4)
+        a = (a.tokenize().normalize()
+             .word2idx(existing_map=q.get_word_index())
+             .shape_sequence(6))
+        # extend vocab for answer words not in questions
+        return q, a
+
+    def test_relation_read_roundtrip(self, tmp_path):
+        p = tmp_path / "rel.csv"
+        p.write_text("q1,a1,1\nq1,a2,0\nq2,a3,1\n")
+        rels = Relations.read(str(p))
+        assert rels[0] == Relation("q1", "a1", 1)
+        assert [r.label for r in rels] == [1, 0, 1]
+
+    def test_relation_read_parquet(self, tmp_path):
+        import pandas as pd
+        df = pd.DataFrame({"id1": ["q1"], "id2": ["a2"], "label": [0]})
+        df.to_parquet(tmp_path / "rel.parquet")
+        rels = Relations.read_parquet(str(tmp_path / "rel.parquet"))
+        assert rels == [Relation("q1", "a2", 0)]
+
+    def test_from_relation_pairs_shapes_and_join(self):
+        q, a = self._corpora()
+        rels = [Relation("q1", "a1", 1), Relation("q1", "a2", 0),
+                Relation("q2", "a3", 1), Relation("q2", "a4", 0),
+                Relation("q2", "a2", 0)]
+        ts = TextSet.from_relation_pairs(rels, q, a)
+        samples = ts.get_samples()
+        # q1: 1 pos x 1 neg; q2: 1 pos x 2 neg → 3 pairs
+        assert len(samples) == 3
+        for s in samples:
+            assert s["x"].shape == (2, 10)
+            np.testing.assert_array_equal(s["y"], [[1.0], [0.0]])
+        # the positive row must embed the positive answer's ids
+        a_index = {f["id"]: f["indexed_tokens"] for f in a._features()}
+        q_index = {f["id"]: f["indexed_tokens"] for f in q._features()}
+        np.testing.assert_array_equal(
+            samples[0]["x"][0], np.concatenate([q_index["q1"],
+                                                a_index["a1"]]))
+
+    def test_from_relation_lists_shapes(self):
+        q, a = self._corpora()
+        rels = [("q1", "a1", 1), ("q1", "a2", 0), ("q1", "a4", 0),
+                ("q2", "a3", 1)]
+        ts = TextSet.from_relation_lists(rels, q, a)
+        samples = ts.get_samples()
+        assert samples[0]["x"].shape == (3, 10)
+        assert samples[0]["y"].tolist() == [[1.0], [0.0], [0.0]]
+        assert samples[1]["x"].shape == (1, 10)
+
+    def test_missing_id_raises(self):
+        q, a = self._corpora()
+        with pytest.raises(KeyError):
+            TextSet.from_relation_pairs([("qX", "a1", 1), ("qX", "a2", 0)],
+                                        q, a)
+        bare = TextSet.from_texts(["no ids"]).tokenize().word2idx()
+        with pytest.raises(ValueError):
+            TextSet.from_relation_pairs([("q1", "a1", 1)], bare, a)
+
+    def test_knrm_trains_on_relation_pairs(self, orca_ctx):
+        from analytics_zoo_tpu.models.textmatching import KNRM
+        q, a = self._corpora()
+        rng = np.random.RandomState(0)
+        rels = []
+        for qi in ("q1", "q2"):
+            for ai in ("a1", "a2", "a3", "a4"):
+                rels.append(Relation(qi, ai, int(rng.rand() > 0.5)))
+        # ensure at least one pos+neg per query
+        rels += [Relation("q1", "a1", 1), Relation("q1", "a2", 0)]
+        ts = TextSet.from_relation_pairs(rels, q, a)
+        xs = np.concatenate([s["x"] for s in ts.get_samples()])  # flatten pairs
+        ys = np.concatenate([s["y"] for s in ts.get_samples()])
+        vocab = max(max(f["indexed_tokens"]) for f in a._features())
+        m = KNRM(text1_length=4, text2_length=6, vocab_size=vocab + 1,
+                 embed_dim=8, kernel_num=5)
+        m.compile(optimizer="adam", loss="binary_crossentropy")
+        m.fit(xs.astype(np.float32), ys, batch_size=8, nb_epoch=1)
+        scores = np.asarray(m.predict(xs.astype(np.float32)))
+        assert scores.shape == (len(xs), 1)
+        from analytics_zoo_tpu.models.textmatching.knrm import (
+            evaluate_map, evaluate_ndcg)
+        assert 0.0 <= evaluate_ndcg(ys[:, 0], scores[:, 0], k=3) <= 1.0
+        assert 0.0 <= evaluate_map(ys[:, 0], scores[:, 0]) <= 1.0

@@ -8,7 +8,7 @@ import pytest
 
 from analytics_zoo_tpu.feature.image3d import (
     AffineTransform3D, CenterCrop3D, Crop3D, RandomCrop3D, Rotate3D,
-    rotation_matrix,
+    Warp3D, rotation_matrix,
 )
 from analytics_zoo_tpu.data.image import (
     Image, NDarray, ParquetDataset, Scalar, write_from_directory,
@@ -83,6 +83,44 @@ class TestAffine3D:
         out = AffineTransform3D(np.eye(3)).apply_image(v)
         assert out.shape == v.shape
         np.testing.assert_allclose(out, v, atol=1e-5)
+
+
+class TestWarp3D:
+    def test_zero_offset_flow_is_noop(self):
+        v = _volume()
+        flow = np.zeros((3,) + v.shape, np.float64)
+        np.testing.assert_allclose(Warp3D(flow).apply_image(v), v,
+                                   atol=1e-5)
+
+    def test_absolute_flow_gathers(self):
+        v = _volume(4, 4, 4)
+        # every dst voxel reads src[1, 2, 3]
+        flow = np.zeros((3, 2, 2, 2), np.float64)
+        flow[0], flow[1], flow[2] = 1, 2, 3
+        out = Warp3D(flow, offset=False).apply_image(v)
+        assert out.shape == (2, 2, 2)
+        np.testing.assert_allclose(out, v[1, 2, 3], atol=1e-6)
+
+    def test_offset_flow_shifts(self):
+        v = _volume()
+        flow = np.zeros((3,) + v.shape, np.float64)
+        flow[0] = 1.0                      # dst(z) = src(z + 1)
+        out = Warp3D(flow).apply_image(v)
+        np.testing.assert_allclose(out[:-1], v[1:], atol=1e-5)
+
+    def test_padding_mode_marks_off_volume(self):
+        v = np.ones((4, 4, 4), np.float32)
+        flow = np.full((3, 4, 4, 4), 99.0)
+        out = Warp3D(flow, offset=False, clamp_mode="padding",
+                     pad_val=-7.0).apply_image(v)
+        np.testing.assert_allclose(out, -7.0)
+        # clamp mode instead clamps to the far corner value
+        out = Warp3D(flow, offset=False).apply_image(v)
+        np.testing.assert_allclose(out, 1.0)
+
+    def test_flow_shape_validation(self):
+        with pytest.raises(ValueError, match="flow_field"):
+            Warp3D(np.zeros((2, 4, 4, 4)))
 
 
 class TestRotate3D:
