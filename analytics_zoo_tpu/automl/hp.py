@@ -129,6 +129,26 @@ class RandN(Sampler):
         return float(rng.normal(self.mean, self.sd))
 
 
+class Subset(Sampler):
+    """A random non-empty subset of ``items``, order-preserving (the ref's
+    RandomSample over all_available_features — feature selection axis)."""
+
+    def __init__(self, items: Sequence[Any], min_items: int = 1):
+        self.items = list(items)
+        self.min_items = max(1, int(min_items))
+        if self.min_items > len(self.items):
+            raise ValueError(f"min_items {min_items} > {len(self.items)} items")
+
+    def sample(self, rng):
+        k = int(rng.integers(self.min_items, len(self.items) + 1))
+        picked = set(rng.choice(len(self.items), size=k, replace=False)
+                     .tolist())
+        return [it for i, it in enumerate(self.items) if i in picked]
+
+    def __repr__(self):
+        return f"subset({self.items})"
+
+
 class GridSearch(Sampler):
     """Exhaustive axis: the engine enumerates all values (cross-product with
     other grid axes), matching ray.tune ``grid_search``."""
@@ -173,6 +193,10 @@ def qrandint(lower, upper, q):
 
 def randn(mean=0.0, sd=1.0):
     return RandN(mean, sd)
+
+
+def subset(items, min_items: int = 1):
+    return Subset(items, min_items)
 
 
 def grid_search(values):

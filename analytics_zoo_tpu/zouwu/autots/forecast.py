@@ -96,7 +96,8 @@ class _TSTrialModel:
         self.transformer = TimeSequenceFeatureTransformer(
             past_seq_len=_effective_past_seq_len(config),
             future_seq_len=future_seq_len, dt_col=dt_col,
-            target_col=target_col, extra_features_col=extra_features_col)
+            target_col=target_col, extra_features_col=extra_features_col,
+            selected_features=config.get("selected_features"))
         self.forecaster = _build_forecaster(config, future_seq_len)
         self._train_xy = None
         self._val_xy = None
@@ -225,7 +226,12 @@ class AutoTSTrainer:
             scheduler: Optional[str] = None) -> TSPipeline:
         recipe = recipe or SmokeRecipe()
         rt = recipe.runtime_params()
-        self.engine.compile(train_df, recipe.search_space(),
+        # what the recipe's selected_features axis may draw from
+        available = TimeSequenceFeatureTransformer(
+            dt_col=self.dt_col, target_col=self.target_col,
+            extra_features_col=self.extra_features_col
+        ).all_available_features
+        self.engine.compile(train_df, recipe.search_space(available),
                             n_sampling=rt["n_sampling"], epochs=rt["epochs"],
                             validation_data=validation_df, metric=metric,
                             scheduler=scheduler,

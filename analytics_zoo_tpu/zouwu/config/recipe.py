@@ -105,8 +105,17 @@ class GridRandomRecipe(Recipe):
     def _past_seq(self):
         return PastSeqParamHandler.get_past_seq_config(self.look_back)
 
+    @staticmethod
+    def _features_axis(space: dict, all_available_features):
+        """Add the feature-selection axis when the caller supplies the
+        available names (ref recipes: 'selected_features':
+        RandomSample(all_available_features))."""
+        if all_available_features:
+            space["selected_features"] = hp.subset(all_available_features)
+        return space
+
     def search_space(self, all_available_features=None):
-        return {
+        return self._features_axis({
             "model": hp.grid_search(["VanillaLSTM", "TCN"]),
             "past_seq_len": self._past_seq(),
             "lstm_units": hp.choice([(16, 16), (32, 32)]),
@@ -115,49 +124,49 @@ class GridRandomRecipe(Recipe):
             "kernel_size": hp.choice([2, 3]),
             "lr": hp.loguniform(1e-3, 1e-2),
             "batch_size": hp.choice([32, 64]),
-        }
+        }, all_available_features)
 
 
 class LSTMGridRandomRecipe(GridRandomRecipe):
     """(ref recipe.py LSTMGridRandomRecipe)"""
 
     def search_space(self, all_available_features=None):
-        return {
+        return self._features_axis({
             "model": "VanillaLSTM",
             "past_seq_len": self._past_seq(),
             "lstm_units": hp.choice([(16, 16), (32, 32), (64, 64)]),
             "dropouts": hp.choice([(0.1, 0.1), (0.2, 0.2)]),
             "lr": hp.loguniform(1e-3, 1e-2),
             "batch_size": hp.choice([32, 64]),
-        }
+        }, all_available_features)
 
 
 class TCNGridRandomRecipe(GridRandomRecipe):
     """(ref recipe.py TCNGridRandomRecipe)"""
 
     def search_space(self, all_available_features=None):
-        return {
+        return self._features_axis({
             "model": "TCN",
             "past_seq_len": self._past_seq(),
             "num_channels": hp.choice([(16, 16), (30, 30, 30)]),
             "kernel_size": hp.grid_search([2, 3]),
             "lr": hp.loguniform(1e-3, 1e-2),
             "batch_size": hp.choice([32, 64]),
-        }
+        }, all_available_features)
 
 
 class Seq2SeqRandomRecipe(GridRandomRecipe):
     """(ref recipe.py Seq2SeqRandomRecipe)"""
 
     def search_space(self, all_available_features=None):
-        return {
+        return self._features_axis({
             "model": "Seq2Seq",
             "past_seq_len": self._past_seq(),
             "latent_dim": hp.choice([32, 64, 128]),
             "dropout": hp.uniform(0.0, 0.3),
             "lr": hp.loguniform(1e-3, 1e-2),
             "batch_size": hp.choice([32, 64]),
-        }
+        }, all_available_features)
 
 
 class LSTMSeq2SeqRandomRecipe(GridRandomRecipe):
@@ -165,7 +174,7 @@ class LSTMSeq2SeqRandomRecipe(GridRandomRecipe):
     (ref recipe.py LSTMSeq2SeqRandomRecipe)."""
 
     def search_space(self, all_available_features=None):
-        return {
+        return self._features_axis({
             "model": hp.grid_search(["VanillaLSTM", "Seq2Seq"]),
             "past_seq_len": self._past_seq(),
             "lstm_units": hp.choice([(16, 16), (32, 32), (64, 64)]),
@@ -174,7 +183,7 @@ class LSTMSeq2SeqRandomRecipe(GridRandomRecipe):
             "dropout": hp.uniform(0.0, 0.3),
             "lr": hp.loguniform(1e-3, 1e-2),
             "batch_size": hp.choice([32, 64]),
-        }
+        }, all_available_features)
 
 
 class MTNetGridRandomRecipe(GridRandomRecipe):
@@ -183,13 +192,13 @@ class MTNetGridRandomRecipe(GridRandomRecipe):
     def search_space(self, all_available_features=None):
         # MTNet's window is (long_series_num + 1) * series_length, so the
         # lookback is spelled by those two — no past_seq_len axis here
-        return {
+        return self._features_axis({
             "model": "MTNet",
             "long_series_num": hp.choice([2, 4]),
             "series_length": hp.choice([4, 8]),
             "lr": hp.loguniform(1e-3, 1e-2),
             "batch_size": hp.choice([32, 64]),
-        }
+        }, all_available_features)
 
 
 class RandomRecipe(GridRandomRecipe):
@@ -200,7 +209,7 @@ class RandomRecipe(GridRandomRecipe):
         super().__init__(num_rand_samples, epochs, look_back)
 
     def search_space(self, all_available_features=None):
-        return {
+        return self._features_axis({
             "model": hp.choice(["VanillaLSTM", "TCN"]),
             "past_seq_len": self._past_seq(),
             "lstm_units": hp.choice([(16, 16), (32, 32), (64, 64)]),
@@ -209,7 +218,7 @@ class RandomRecipe(GridRandomRecipe):
             "kernel_size": hp.choice([2, 3, 5]),
             "lr": hp.loguniform(1e-4, 1e-1),
             "batch_size": hp.qrandint(32, 128, 32),
-        }
+        }, all_available_features)
 
 
 class BayesRecipe(Recipe):

@@ -25,7 +25,8 @@ class TimeSequenceFeatureTransformer:
     def __init__(self, past_seq_len: int = 50, future_seq_len: int = 1,
                  dt_col: str = "datetime", target_col: str = "value",
                  extra_features_col: Optional[Sequence[str]] = None,
-                 with_dt_features: bool = True, scale: bool = True):
+                 with_dt_features: bool = True, scale: bool = True,
+                 selected_features: Optional[Sequence[str]] = None):
         self.past_seq_len = int(past_seq_len)
         self.future_seq_len = int(future_seq_len)
         self.dt_col = dt_col
@@ -33,6 +34,17 @@ class TimeSequenceFeatureTransformer:
         self.extra_features_col = list(extra_features_col or [])
         self.with_dt_features = with_dt_features
         self.scale = scale
+        # feature-selection axis (ref recipes sample `selected_features`
+        # from all_available_features): names among the non-target features
+        # to keep; the target itself is always feature 0
+        self.selected_features = (None if selected_features is None
+                                  else [str(s) for s in selected_features])
+        if self.selected_features is not None:
+            unknown = set(self.selected_features) - set(
+                self.all_available_features)
+            if unknown:
+                raise ValueError(f"unknown selected_features {sorted(unknown)}"
+                                 f"; available: {self.all_available_features}")
         self._mins: Optional[np.ndarray] = None
         self._maxs: Optional[np.ndarray] = None
 
@@ -55,14 +67,32 @@ class TimeSequenceFeatureTransformer:
             feats.append(df[c].to_numpy(np.float32)[:, None])
         if self.with_dt_features:
             feats.append(self._dt_features(df[self.dt_col]))
-        return np.concatenate(feats, axis=1)
+        mat = np.concatenate(feats, axis=1)
+        if self.selected_features is not None:
+            keep = set(self.selected_features)
+            cols = [0] + [i for i, n in enumerate(
+                self.all_available_features, start=1) if n in keep]
+            mat = mat[:, cols]
+        return mat
 
     @property
-    def feature_names(self) -> List[str]:
-        names = [self.target_col] + list(self.extra_features_col)
+    def all_available_features(self) -> List[str]:
+        """Every selectable (non-target) feature name — what a recipe's
+        ``selected_features`` axis draws from (ref
+        TimeSequenceFeatureTransformer.get_feature_list)."""
+        names = list(self.extra_features_col)
         if self.with_dt_features:
             names += list(_DT_FEATURES)
         return names
+
+    @property
+    def feature_names(self) -> List[str]:
+        if self.selected_features is not None:
+            keep = set(self.selected_features)
+            return [self.target_col] + [n for n in
+                                        self.all_available_features
+                                        if n in keep]
+        return [self.target_col] + self.all_available_features
 
     @property
     def n_features(self) -> int:
@@ -130,7 +160,10 @@ class TimeSequenceFeatureTransformer:
             dt_col=self.dt_col, target_col=self.target_col,
             extra_features_col=np.asarray(self.extra_features_col, dtype=object)
             if self.extra_features_col else np.zeros(0, dtype="U1"),
-            with_dt_features=self.with_dt_features, scale=self.scale)
+            with_dt_features=self.with_dt_features, scale=self.scale,
+            has_selected=self.selected_features is not None,
+            selected_features=np.asarray(self.selected_features, dtype=object)
+            if self.selected_features else np.zeros(0, dtype="U1"))
 
     def restore(self, path: str):
         d = np.load(path if path.endswith(".npz") else path + ".npz",
@@ -146,3 +179,7 @@ class TimeSequenceFeatureTransformer:
         self.extra_features_col = [str(c) for c in d["extra_features_col"]]
         self.with_dt_features = bool(d["with_dt_features"])
         self.scale = bool(d["scale"])
+        if "has_selected" in d and bool(d["has_selected"]):
+            self.selected_features = [str(c) for c in d["selected_features"]]
+        else:
+            self.selected_features = None

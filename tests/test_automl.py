@@ -59,6 +59,18 @@ class TestHp:
                                np.random.default_rng(0))
         assert cfg["lr"] == 0.1 and cfg["nested"]["k"] == 7
 
+    def test_subset_sampler(self):
+        rng = np.random.default_rng(0)
+        items = ["a", "b", "c", "d"]
+        for _ in range(30):
+            s = hp.subset(items).sample(rng)
+            assert 1 <= len(s) <= 4
+            assert s == [it for it in items if it in s]  # order preserved
+            assert len(set(s)) == len(s)
+        assert len(hp.subset(items, min_items=3).sample(rng)) >= 3
+        with pytest.raises(ValueError):
+            hp.subset(["a"], min_items=2)
+
 
 class TestEvaluator:
     def test_metrics(self):

@@ -104,6 +104,26 @@ class TestAutoTS:
         assert ts.config["model"] == "TCN"
         assert ts.predict(val).shape[1] == 2
 
+    def test_feature_selection_axis(self, tmp_path, orca_ctx):
+        """selected_features flows recipe → trial transformer → pipeline
+        save/load (ref recipes' RandomSample(all_available_features))."""
+        df = sine_df(160)
+        train, val = df.iloc[:120], df.iloc[100:]
+        trainer = AutoTSTrainer(horizon=2, logs_dir=str(tmp_path))
+        recipe = TCNGridRandomRecipe(num_rand_samples=1, epochs=1,
+                                     look_back=12)
+        space = recipe.search_space(["HOUR", "DAY", "IS_WEEKEND"])
+        assert "selected_features" in space
+        ts = trainer.fit(train, val, recipe=recipe)
+        sel = ts.config.get("selected_features")
+        assert sel and set(sel) <= {"HOUR", "DAY", "DAYOFWEEK", "MONTH",
+                                    "IS_WEEKEND"}
+        assert ts.predict(val).shape[1] == 2
+        ts.save(str(tmp_path / "pipe"))
+        ts2 = TSPipeline.load(str(tmp_path / "pipe"))
+        np.testing.assert_allclose(ts.predict(val), ts2.predict(val),
+                                   rtol=1e-5, atol=1e-5)
+
     def test_bayes_recipe_search(self, tmp_path, orca_ctx):
         df = sine_df(160)
         train, val = df.iloc[:120], df.iloc[100:]

@@ -68,6 +68,37 @@ class TestFeatureTransformer:
         x, y = tf2.transform(sine_df(80))
         assert x.shape[1] == 12
 
+    def test_selected_features_subset(self):
+        df = sine_df()
+        df["extra"] = np.arange(len(df), dtype=float)
+        full = TimeSequenceFeatureTransformer(
+            past_seq_len=8, extra_features_col=["extra"])
+        assert full.all_available_features == \
+            ["extra", "HOUR", "DAY", "DAYOFWEEK", "MONTH", "IS_WEEKEND"]
+        sel = TimeSequenceFeatureTransformer(
+            past_seq_len=8, extra_features_col=["extra"],
+            selected_features=["HOUR", "IS_WEEKEND"])
+        x, y = sel.fit_transform(df)
+        # target + 2 selected
+        assert x.shape[-1] == 3
+        assert sel.feature_names == ["value", "HOUR", "IS_WEEKEND"]
+        # selected column values match the full matrix's columns
+        xf, _ = full.fit_transform(df)
+        hour_full = full.feature_names.index("HOUR")
+        np.testing.assert_allclose(x[..., 1], xf[..., hour_full], atol=1e-6)
+
+    def test_selected_features_validation_and_restore(self, tmp_path):
+        with pytest.raises(ValueError, match="unknown selected_features"):
+            TimeSequenceFeatureTransformer(selected_features=["NOPE"])
+        tf = TimeSequenceFeatureTransformer(
+            past_seq_len=8, selected_features=["HOUR"])
+        tf.fit_transform(sine_df())
+        tf.save(str(tmp_path / "tf"))
+        tf2 = TimeSequenceFeatureTransformer()
+        tf2.restore(str(tmp_path / "tf"))
+        assert tf2.selected_features == ["HOUR"]
+        assert tf2.transform(sine_df(40), with_y=False).shape[-1] == 2
+
 
 def _xy(n=96, lookback=16, horizon=2, feats=3):
     rng = np.random.RandomState(0)
