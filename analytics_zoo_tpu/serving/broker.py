@@ -74,7 +74,7 @@ class BrokerClient:
         line, self._buf = self._buf.split(b"\n", 1)
         return line.decode()
 
-    def _reply(self):
+    def _reply(self, raise_on_error: bool = True):
         line = self._readline()
         kind, rest = line[0], line[1:]
         if kind == "+":
@@ -86,12 +86,38 @@ class BrokerClient:
         if kind == "*":
             return [self._readline() for _ in range(int(rest))]
         if kind == "-":
-            raise RuntimeError(f"broker error: {rest}")
+            err = RuntimeError(f"broker error: {rest}")
+            if raise_on_error:
+                raise err
+            return err
         raise RuntimeError(f"bad reply line: {line!r}")
 
     def _cmd(self, *parts: str):
         self._send(*parts)
         return self._reply()
+
+    # writes are chunked so the broker can drain its send buffer between
+    # chunks — one giant sendall can deadlock both peers once the replies
+    # fill the kernel buffers while the client is still writing
+    PIPELINE_CHUNK = 512
+
+    def pipeline(self, cmds) -> list:
+        """Send commands in chunked batches, reading each chunk's replies
+        before the next write (same contract as redis-py pipelines in the
+        reference client). ``cmds`` is an iterable of argument tuples.
+        ALL replies are read before an error is raised, so the connection
+        stays in sync even when a command fails."""
+        cmds = list(cmds)
+        out: list = []
+        for start in range(0, len(cmds), self.PIPELINE_CHUNK):
+            chunk = cmds[start:start + self.PIPELINE_CHUNK]
+            blob = "".join(" ".join(parts) + "\n" for parts in chunk)
+            self.sock.sendall(blob.encode())
+            out.extend(self._reply(raise_on_error=False) for _ in chunk)
+        for r in out:
+            if isinstance(r, RuntimeError):
+                raise r
+        return out
 
     # --- commands ---
     def ping(self) -> bool:

@@ -82,18 +82,40 @@ std::map<std::string, std::map<std::string, std::string>> g_hashes;
 bool g_shutdown = false;
 int g_srv_fd = -1;
 
-std::string ReadLine(int fd, bool* ok) {
-  std::string line;
-  char c;
+// Per-connection receive buffer: bulk recv instead of byte-at-a-time
+// syscalls, and leftover bytes carry over so pipelined commands (many
+// lines in one TCP segment) parse correctly.
+struct ConnBuf {
+  std::string buf;
+  size_t pos = 0;
+};
+
+std::string ReadLine(int fd, ConnBuf* cb, bool* ok) {
   while (true) {
-    ssize_t n = recv(fd, &c, 1, 0);
-    if (n <= 0) { *ok = false; return line; }
-    if (c == '\n') break;
-    if (c != '\r') line.push_back(c);
-    if (line.size() > (64u << 20)) { *ok = false; return line; }
+    size_t nl = cb->buf.find('\n', cb->pos);
+    if (nl != std::string::npos) {
+      std::string line = cb->buf.substr(cb->pos, nl - cb->pos);
+      cb->pos = nl + 1;
+      if (cb->pos > (1u << 20)) {  // compact consumed prefix
+        cb->buf.erase(0, cb->pos);
+        cb->pos = 0;
+      }
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      *ok = true;
+      return line;
+    }
+    if (cb->buf.size() - cb->pos > (64u << 20)) {
+      *ok = false;
+      return std::string();
+    }
+    char chunk[65536];
+    ssize_t n = recv(fd, chunk, sizeof(chunk), 0);
+    if (n <= 0) {
+      *ok = false;
+      return std::string();
+    }
+    cb->buf.append(chunk, static_cast<size_t>(n));
   }
-  *ok = true;
-  return line;
 }
 
 void SendAll(int fd, const std::string& s) {
@@ -121,9 +143,10 @@ std::vector<std::string> Split(const std::string& s, size_t max_parts) {
 void HandleConn(int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  ConnBuf cb;
   while (true) {
     bool ok;
-    std::string line = ReadLine(fd, &ok);
+    std::string line = ReadLine(fd, &cb, &ok);
     if (!ok) break;
     if (line.empty()) continue;
     std::vector<std::string> p = Split(line, 8);

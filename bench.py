@@ -233,11 +233,12 @@ def measure_serving():
         in_q.enqueue("warm", x=payloads[0])
         out_q.query("warm", timeout=120.0)
         t0 = time.perf_counter()
-        for i in range(N):
-            in_q.enqueue(f"r{i}", x=payloads[i])
-        for i in range(N):
-            out_q.query(f"r{i}", timeout=60.0)
+        uris = in_q.enqueue_batch(
+            (f"r{i}", {"x": payloads[i]}) for i in range(N))
+        res = out_q.query_many(uris, timeout=60.0)
         dt = time.perf_counter() - t0
+        missing = [u for u, v in res.items() if v is None]
+        assert not missing, f"{len(missing)} records unanswered"
         backend = broker.backend
     return {"serving_records_per_sec": round(N / dt, 1),
             "serving_broker": backend}

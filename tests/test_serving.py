@@ -124,6 +124,54 @@ class TestEndToEnd:
         assert m["records_out"] == 10
         assert "inference" in m and m["inference"]["count"] >= 1
 
+    def test_batch_enqueue_and_query_many(self, broker):
+        """Pipelined client path: one socket write for N records, pipelined
+        HGET polling for the results."""
+        im, torch_m = _make_model()
+        rng = np.random.RandomState(1)
+        xs = [rng.randn(4).astype(np.float32) for _ in range(12)]
+        with ClusterServing(im, broker.port, batch_size=4).start():
+            in_q = InputQueue(port=broker.port)
+            out_q = OutputQueue(port=broker.port)
+            uris = in_q.enqueue_batch(
+                [(None if i % 2 else f"b{i}", {"x": x})
+                 for i, x in enumerate(xs)])
+            assert len(uris) == 12 and uris[0] == "b0"
+            res = out_q.query_many(uris, timeout=20.0, delete=True)
+            import torch
+            for uri, x in zip(uris, xs):
+                assert res[uri] is not None, f"no result for {uri}"
+                want = torch_m(torch.from_numpy(x[None])).detach().numpy()[0]
+                np.testing.assert_allclose(res[uri], want, atol=1e-4)
+            # delete=True removed the fetched entries
+            assert out_q.query(uris[0]) is None
+
+    def test_pipeline_command_interleaving(self, broker):
+        """Raw pipeline: many XADDs in one write return in-order ids."""
+        from analytics_zoo_tpu.serving.broker import BrokerClient
+        c = BrokerClient(port=broker.port)
+        ids = c.pipeline(("XADD", "pstream", f"payload{i}")
+                         for i in range(50))
+        assert [int(v) for v in ids] == list(range(1, 51))
+        assert c.xlen("pstream") == 50
+        assert c.pipeline([]) == []
+        # exceeds one chunk: still ordered and fully applied
+        n = c.PIPELINE_CHUNK + 37
+        ids = c.pipeline(("XADD", "pstream2", f"p{i}") for i in range(n))
+        assert len(ids) == n and c.xlen("pstream2") == n
+
+    def test_pipeline_error_keeps_connection_in_sync(self, broker):
+        """A failing command mid-pipeline raises AFTER all replies are
+        drained, so later commands on the same client see fresh replies."""
+        from analytics_zoo_tpu.serving.broker import BrokerClient
+        c = BrokerClient(port=broker.port)
+        with pytest.raises(RuntimeError):
+            c.pipeline([("XADD", "estream", "a"), ("BOGUSCMD", "x"),
+                        ("XADD", "estream", "b")])
+        # both valid XADDs applied; the connection is not desynced
+        assert c.xlen("estream") == 2
+        assert c.ping()
+
     def test_dequeue_drains(self, broker):
         im, _ = _make_model()
         with ClusterServing(im, broker.port, batch_size=2).start():
