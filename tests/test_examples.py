@@ -18,7 +18,7 @@ ALL = ["recommendation_ncf.py", "anomaly_detection.py",
        "sentiment_analysis.py", "vae.py", "fraud_detection.py",
        "image_similarity.py", "wide_and_deep.py", "object_detection.py",
        "image_augmentation.py", "model_inference.py",
-       "automl_hp_search.py"]
+       "automl_hp_search.py", "qa_ranker.py"]
 
 
 @pytest.mark.parametrize("script", ALL)
