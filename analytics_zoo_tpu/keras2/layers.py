@@ -19,6 +19,8 @@ from analytics_zoo_tpu.keras import layers as k1
 Activation = k1.Activation
 Dropout = k1.Dropout  # keras2 'rate' is positional like keras1 'p'
 Flatten = k1.Flatten
+# same signatures in both API generations (ref keras2/convolutional.py:196
+# Cropping1D, keras2/pooling.py Global*Pooling)
 GlobalAveragePooling1D = k1.GlobalAveragePooling1D
 GlobalAveragePooling2D = k1.GlobalAveragePooling2D
 GlobalMaxPooling1D = k1.GlobalMaxPooling1D

@@ -304,7 +304,9 @@ class JaxEstimator:
             import jax
             tx = self._tx()
             params = self._state["params"]
-            new_opt = tx.init(jax.device_get(params))
+            host_params = jax.device_get(params)
+            new_opt = self._unalias_opt_state(tx.init(host_params),
+                                              host_params)
             state = dict(self._state)
             state["opt_state"] = new_opt
             shardings = self._state_shardings(
@@ -367,6 +369,24 @@ class JaxEstimator:
                 self._mesh = self.strategy.build_mesh()
         return self._mesh
 
+    @staticmethod
+    def _unalias_opt_state(opt_state, params):
+        """Some optax states alias buffers — either the passed params
+        (lbfgs keeps the previous params) or each other (jax dedupes the
+        identical zeros arrays lbfgs uses for its history buffers). The
+        train step donates the whole state, and XLA rejects the same
+        buffer donated twice — copy every repeated leaf."""
+        import jax
+        seen = {id(leaf) for leaf in jax.tree_util.tree_leaves(params)}
+
+        def uniq(leaf):
+            if id(leaf) in seen:
+                leaf = leaf.copy()
+            seen.add(id(leaf))
+            return leaf
+
+        return jax.tree_util.tree_map(uniq, opt_state)
+
     def _init_state(self):
         import jax
         if self._state is not None:
@@ -374,7 +394,7 @@ class JaxEstimator:
         mesh = self._ensure_mesh()
         tx = self._tx()
         params = self.adapter.params
-        opt_state = tx.init(params)
+        opt_state = self._unalias_opt_state(tx.init(params), params)
         state = {"step": np.zeros((), np.int32),
                  "params": params,
                  "opt_state": opt_state,

@@ -103,7 +103,7 @@ class Optimizer:
             table = {"sgd": SGD, "adam": Adam, "adamw": AdamWeightDecay,
                      "rmsprop": RMSprop, "adagrad": Adagrad,
                      "adadelta": Adadelta, "adamax": Adamax, "nadam": Nadam,
-                     "lars": LARS, "lamb": LAMB}
+                     "lars": LARS, "lamb": LAMB, "lbfgs": LBFGS}
             if name not in table:
                 raise ValueError(f"unknown optimizer {opt!r}")
             return table[name]()
@@ -228,3 +228,31 @@ class LAMB(Optimizer):
 
     def to_optax(self):
         return optax.lamb(self.lr, weight_decay=self.wd)
+
+
+class LBFGS(Optimizer):
+    """Memory-limited BFGS (ref optimizers_impl.py:99 LBFGS, BigDL's
+    torch-style implementation). The reference's default path — no line
+    search, fixed ``learningrate``-scaled steps along the two-loop
+    direction — is exactly ``optax.lbfgs(linesearch=None)``, and that is
+    what runs inside the jitted train step here. ``ncorrection`` is the
+    history length. The reference's driver-loop knobs (``max_iter``,
+    ``max_eval``, ``tolfun``, ``tolx``) govern BigDL's inner convergence
+    loop, which has no analog in a per-minibatch SPMD step; they are
+    accepted for signature parity and ignored."""
+
+    def __init__(self, max_iter: int = 20, max_eval=None,
+                 tolfun: float = 1e-5, tolx: float = 1e-9,
+                 ncorrection: int = 100, learningrate: float = 1.0,
+                 verbose: bool = False, linesearch=None,
+                 linesearch_options=None):
+        if linesearch is not None:
+            raise ValueError("custom line-search functions are not "
+                             "supported inside the jitted step; use the "
+                             "default fixed-step mode")
+        self.lr = learningrate
+        self.ncorrection = int(ncorrection)
+
+    def to_optax(self):
+        return optax.lbfgs(self.lr, memory_size=self.ncorrection,
+                           linesearch=None)

@@ -20,7 +20,8 @@ case "$lane" in
                 tests/test_attention.py tests/test_pipeline.py tests/test_moe.py ;;
   data)     run tests/test_data.py tests/test_native_store.py \
                 tests/test_feature.py tests/test_friesian.py \
-                tests/test_image3d_parquet.py tests/test_elastic_search.py ;;
+                tests/test_image3d_parquet.py tests/test_elastic_search.py \
+                tests/test_tfrecord.py ;;
   keras)    run tests/test_keras.py tests/test_keras_layers_golden.py \
                 tests/test_keras2_multihost.py tests/test_nnframes_autograd.py ;;
   models)   run tests/test_model_zoo.py tests/test_recommendation.py \

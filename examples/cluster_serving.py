@@ -32,11 +32,16 @@ def main():
         with ClusterServing(im, broker.port, batch_size=8).start() as engine:
             in_q = InputQueue(port=broker.port)
             out_q = OutputQueue(port=broker.port)
-            for k in range(16):
-                in_q.enqueue(f"req-{k}",
-                             x=rng.randn(8).astype(np.float32))
-            results = {f"req-{k}": out_q.query(f"req-{k}", timeout=30.0)
-                       for k in range(16)}
+            # single-record path (interactive clients)
+            in_q.enqueue("req-single", x=rng.randn(8).astype(np.float32))
+            single = out_q.query("req-single", timeout=30.0)
+            assert single is not None
+            # pipelined batch path (bulk producers — one socket write for
+            # all records, pipelined polling for the results)
+            uris = in_q.enqueue_batch(
+                (f"req-{k}", {"x": rng.randn(8).astype(np.float32)})
+                for k in range(16))
+            results = out_q.query_many(uris, timeout=30.0)
             assert all(v is not None for v in results.values())
             print("queue results:", {k: v.argmax() for k, v in
                                      list(results.items())[:4]})

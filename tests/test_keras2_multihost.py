@@ -32,6 +32,20 @@ class TestKeras2:
         assert np.isfinite(h["loss"][-1])
         assert m.predict(x[:4]).shape == (4, 2)
 
+    def test_cropping_and_global_pooling(self, orca_ctx):
+        """keras2 aliases for Cropping1D and Global*Pooling compute the
+        obvious numpy reductions."""
+        x = np.random.RandomState(4).randn(3, 10, 5).astype(np.float32)
+        got, _ = run_layer(k2.Cropping1D(cropping=(2, 3)), x)
+        np.testing.assert_allclose(got, x[:, 2:-3], atol=1e-6)
+        got, _ = run_layer(k2.GlobalMaxPooling1D(), x)
+        np.testing.assert_allclose(got, x.max(1), atol=1e-6)
+        got, _ = run_layer(k2.GlobalAveragePooling1D(), x)
+        np.testing.assert_allclose(got, x.mean(1), atol=1e-5)
+        img = np.random.RandomState(5).randn(2, 6, 7, 3).astype(np.float32)
+        got, _ = run_layer(k2.GlobalAveragePooling2D(), img)
+        np.testing.assert_allclose(got, img.mean((1, 2)), atol=1e-5)
+
     def test_conv2d_matches_torch(self, orca_ctx):
         x = np.random.RandomState(1).randn(2, 8, 8, 3).astype(np.float32)
         got, p = run_layer(k2.Conv2D(4, kernel_size=3, name="c2"), x)
