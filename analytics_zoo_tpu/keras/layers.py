@@ -226,6 +226,40 @@ class Lambda(KerasLayer):
         return self.function(*args)
 
 
+class KerasLayerWrapper(KerasLayer):
+    """Wrap an arbitrary flax ``nn.Module`` as a keras layer (ref
+    wrappers.py:86 KerasLayerWrapper, which wraps a raw BigDL layer —
+    here the "raw layer" idiom is a flax module; its params train with
+    the rest of the model).
+
+    ``call_with_train=True`` forwards the keras train flag as the
+    module's ``train=`` kwarg (for modules with dropout/BN)."""
+
+    def __init__(self, flax_module: "nn.Module",
+                 call_with_train: bool = False,
+                 input_shape=None, name=None):
+        super().__init__(name or getattr(flax_module, "name", None),
+                         input_shape)
+        self.flax_module = flax_module
+        self.call_with_train = bool(call_with_train)
+
+    def make_module(self):
+        # make_module runs inside the parent's compact __call__ on every
+        # trace. flax only auto-adopts modules CONSTRUCTED in that scope
+        # (clone() passes parent=None and opts out), so re-construct the
+        # wrapped module from its dataclass fields each time.
+        import dataclasses
+        fields = {f.name: getattr(self.flax_module, f.name)
+                  for f in dataclasses.fields(self.flax_module)
+                  if f.init and f.name not in ("parent", "name")}
+        return type(self.flax_module)(**fields, name=self.name)
+
+    def apply(self, module, args, train):
+        if self.call_with_train:
+            return module(*args, train=train)
+        return module(*args)
+
+
 class Constant(KerasLayer):
     def __init__(self, value, name=None):
         super().__init__(name)
@@ -369,14 +403,23 @@ Convolution2D = Conv2D
 
 
 class SeparableConv2D(KerasLayer):
+    """Depthwise spatial conv (``depth_multiplier`` outputs per input
+    channel) followed by a 1x1 pointwise mix (ref convolutional.py:313
+    SeparableConvolution2D)."""
+
+    # class-level default keeps topology.pkl files pickled before the
+    # depth_multiplier attribute existed loadable
+    depth_multiplier = 1
+
     def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
                  activation=None, border_mode="valid", subsample=(1, 1),
-                 input_shape=None, name=None):
+                 depth_multiplier: int = 1, input_shape=None, name=None):
         super().__init__(name, input_shape)
         self.nb_filter, self.kernel = nb_filter, (nb_row, nb_col)
         self.activation = get_activation(activation)
         self.padding = border_mode.upper()
         self.strides = _pair(subsample)
+        self.depth_multiplier = int(depth_multiplier)
 
     def make_module(self):
         # depthwise (feature_group_count) + pointwise
@@ -385,20 +428,25 @@ class SeparableConv2D(KerasLayer):
             kernel: tuple
             strides: tuple
             padding: str
+            depth_multiplier: int
 
             @nn.compact
             def __call__(self, x):
                 c = x.shape[-1]
-                x = nn.Conv(c, self.kernel, strides=self.strides,
+                x = nn.Conv(c * self.depth_multiplier, self.kernel,
+                            strides=self.strides,
                             padding=self.padding, feature_group_count=c,
                             name="depthwise")(x)
                 return nn.Conv(self.nb_filter, (1, 1), name="pointwise")(x)
 
         return _Sep(self.nb_filter, self.kernel, self.strides, self.padding,
-                    name=self.name)
+                    self.depth_multiplier, name=self.name)
 
     def apply(self, module, args, train):
         return self.activation(module(args[0]))
+
+
+SeparableConvolution2D = SeparableConv2D
 
 
 class _Pool(KerasLayer):

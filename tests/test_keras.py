@@ -253,3 +253,51 @@ def test_functional_model_save_load(orca_ctx, tmp_path):
     loaded = KerasNet.load(p)
     np.testing.assert_allclose(np.asarray(loaded.predict([xa, xb])), want,
                                atol=1e-5)
+
+
+def test_keras_layer_wrapper(orca_ctx):
+    """KerasLayerWrapper adopts an arbitrary flax module into the keras
+    graph; its params train with the rest (ref wrappers.py:86)."""
+    import flax.linen as nn
+    from analytics_zoo_tpu.keras.layers import Dense, KerasLayerWrapper
+    from analytics_zoo_tpu.keras.models import Sequential
+
+    class Block(nn.Module):
+        feats: int = 8
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = nn.Dense(self.feats)(x)
+            x = nn.Dropout(0.5, deterministic=not train)(x)
+            return nn.relu(x)
+
+    m = Sequential()
+    m.add(KerasLayerWrapper(Block(), call_with_train=True,
+                            input_shape=(4,), name="blk"))
+    m.add(Dense(2))
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 4).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+    import jax
+    before = jax.tree_util.tree_map(np.array, m.get_weights())
+    h = m.fit(x, y, batch_size=32, nb_epoch=3)
+    assert np.isfinite(h["loss"][-1])
+    # wrapped params exist under the layer's name AND were trained
+    after = m.get_weights()
+    assert "blk" in after, f"wrapped params missing: {list(after)}"
+    changed = jax.tree_util.tree_map(
+        lambda a, b: not np.allclose(a, b), before["blk"], after["blk"])
+    assert any(jax.tree_util.tree_leaves(changed)), \
+        "wrapped module params did not update"
+    probs = np.asarray(m.predict(x[:4]))
+    assert probs.shape == (4, 2)
+    # dropout inside the wrapped module is inert at predict time
+    np.testing.assert_allclose(probs, np.asarray(m.predict(x[:4])),
+                               atol=1e-6)
+
+
+def test_separable_convolution2d_alias():
+    from analytics_zoo_tpu.keras.layers import (SeparableConv2D,
+                                                SeparableConvolution2D)
+    assert SeparableConvolution2D is SeparableConv2D

@@ -141,6 +141,27 @@ def test_conv3d_matches_torch(orca_ctx):
                                rtol=1e-4, atol=1e-4)
 
 
+def test_separable_conv2d_matches_torch(orca_ctx):
+    """Depthwise (groups=in, depth_multiplier=2) + pointwise 1x1 vs the
+    same composition in torch (ref convolutional.py:313)."""
+    x = _x((2, 9, 10, 3))
+    got, p = run_layer(
+        zl.SeparableConvolution2D(5, 3, 3, depth_multiplier=2, name="sep"),
+        x)
+    dw = np.asarray(p["sep"]["depthwise"]["kernel"])   # [3,3,1,6]
+    db = np.asarray(p["sep"]["depthwise"]["bias"])
+    pw = np.asarray(p["sep"]["pointwise"]["kernel"])   # [1,1,6,5]
+    pb = np.asarray(p["sep"]["pointwise"]["bias"])
+    tx = torch.from_numpy(x.transpose(0, 3, 1, 2))
+    mid = F.conv2d(tx, torch.from_numpy(dw.transpose(3, 2, 0, 1).copy()),
+                   torch.from_numpy(db), groups=3)
+    want = F.conv2d(mid, torch.from_numpy(pw.transpose(3, 2, 0, 1).copy()),
+                    torch.from_numpy(pb)).numpy()
+    np.testing.assert_allclose(got, want.transpose(0, 2, 3, 1),
+                               rtol=1e-4, atol=1e-4)
+    assert got.shape == (2, 7, 8, 5)
+
+
 def test_atrous_conv_matches_torch(orca_ctx):
     x = _x((2, 12, 3))
     got, p = run_layer(zl.AtrousConvolution1D(5, 3, atrous_rate=2,
