@@ -29,6 +29,7 @@ from __future__ import annotations
 import logging
 import os
 import time
+import warnings
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -43,6 +44,32 @@ from analytics_zoo_tpu.learn.trigger import EveryEpoch, Trigger
 from analytics_zoo_tpu.parallel.strategy import ShardingStrategy
 
 logger = logging.getLogger(__name__)
+
+
+def _fire_trigger(trigger, epoch, iteration, loss, score):
+    """Evaluate a checkpoint trigger, passing ``score`` only to triggers
+    whose ``__call__`` accepts it — user subclasses written against the
+    old 3-arg signature keep working."""
+    import inspect
+    try:
+        sig = inspect.signature(trigger.__call__)
+        takes_score = ("score" in sig.parameters or any(
+            p.kind is inspect.Parameter.VAR_KEYWORD
+            for p in sig.parameters.values()))
+    except (TypeError, ValueError):
+        takes_score = False
+    if takes_score:
+        return trigger(epoch, iteration, loss, score=score)
+    return trigger(epoch, iteration, loss)
+
+
+def _trigger_needs_score(trigger) -> bool:
+    """True if the trigger (transitively) contains a MaxScore."""
+    from analytics_zoo_tpu.learn.trigger import MaxScore
+    if isinstance(trigger, MaxScore):
+        return True
+    return any(_trigger_needs_score(t)
+               for t in getattr(trigger, "triggers", ()))
 
 
 def _as_args(x):
@@ -600,6 +627,12 @@ class JaxEstimator:
         self._build_train_step()
         if checkpoint_trigger is None and self.model_dir:
             checkpoint_trigger = EveryEpoch()
+        if checkpoint_trigger is not None and \
+                _trigger_needs_score(checkpoint_trigger) and val_ds is None:
+            warnings.warn(
+                "checkpoint_trigger contains MaxScore but fit() got no "
+                "validation_data — the trigger can never fire and no "
+                "checkpoints will be written")
 
         train_writer, _ = self._writers()
         history: Dict[str, List[float]] = {"loss": []}
@@ -636,14 +669,18 @@ class JaxEstimator:
                     continue
                 history["loss"].append(epoch_loss)
                 self._epoch += 1
+                val_score = None
                 if val_ds is not None:
                     val = self.evaluate(val_ds, batch_size=batch_size)
                     for k, v in val.items():
                         history.setdefault("val_" + k, []).append(v)
                         self._val_writer.add_scalar(k, v, self._py_step)
+                    # first non-loss validation metric feeds MaxScore
+                    val_score = next((v for k, v in val.items()
+                                      if k != "loss"), None)
                 if checkpoint_trigger and self.model_dir and \
-                        checkpoint_trigger(self._epoch, self._py_step,
-                                           epoch_loss):
+                        _fire_trigger(checkpoint_trigger, self._epoch,
+                                      self._py_step, epoch_loss, val_score):
                     self._save_snapshot()
         finally:
             if profiling:

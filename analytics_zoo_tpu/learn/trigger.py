@@ -8,7 +8,8 @@ from __future__ import annotations
 
 
 class Trigger:
-    def __call__(self, epoch: int, iteration: int, loss: float) -> bool:
+    def __call__(self, epoch: int, iteration: int, loss: float,
+                 score: "float | None" = None) -> bool:
         raise NotImplementedError
 
     @staticmethod
@@ -25,7 +26,7 @@ class EveryEpoch(Trigger):
     def __init__(self):
         self._last_epoch = None
 
-    def __call__(self, epoch, iteration, loss):
+    def __call__(self, epoch, iteration, loss, score=None):
         fired = self._last_epoch is not None and epoch != self._last_epoch
         self._last_epoch = epoch
         return fired
@@ -38,7 +39,7 @@ class SeveralIteration(Trigger):
         assert interval > 0
         self.interval = interval
 
-    def __call__(self, epoch, iteration, loss):
+    def __call__(self, epoch, iteration, loss, score=None):
         return iteration > 0 and iteration % self.interval == 0
 
 
@@ -46,7 +47,7 @@ class MaxEpoch(Trigger):
     def __init__(self, max_epoch: int):
         self.max_epoch = max_epoch
 
-    def __call__(self, epoch, iteration, loss):
+    def __call__(self, epoch, iteration, loss, score=None):
         return epoch >= self.max_epoch
 
 
@@ -54,7 +55,7 @@ class MaxIteration(Trigger):
     def __init__(self, max_iteration: int):
         self.max_iteration = max_iteration
 
-    def __call__(self, epoch, iteration, loss):
+    def __call__(self, epoch, iteration, loss, score=None):
         return iteration >= self.max_iteration
 
 
@@ -62,21 +63,35 @@ class MinLoss(Trigger):
     def __init__(self, min_loss: float):
         self.min_loss = min_loss
 
-    def __call__(self, epoch, iteration, loss):
+    def __call__(self, epoch, iteration, loss, score=None):
         return loss is not None and loss < self.min_loss
+
+
+class MaxScore(Trigger):
+    """Fires when the validation score exceeds ``max`` (ref
+    util/triggers.py:111 MaxScore — accuracy-style metrics where higher
+    is better; the estimator passes the first validation metric)."""
+
+    def __init__(self, max: float):
+        self.max = float(max)
+
+    def __call__(self, epoch, iteration, loss, score=None):
+        return score is not None and score > self.max
 
 
 class TriggerAnd(Trigger):
     def __init__(self, *triggers):
         self.triggers = triggers
 
-    def __call__(self, epoch, iteration, loss):
-        return all(t(epoch, iteration, loss) for t in self.triggers)
+    def __call__(self, epoch, iteration, loss, score=None):
+        return all(t(epoch, iteration, loss, score)
+                   for t in self.triggers)
 
 
 class TriggerOr(Trigger):
     def __init__(self, *triggers):
         self.triggers = triggers
 
-    def __call__(self, epoch, iteration, loss):
-        return any(t(epoch, iteration, loss) for t in self.triggers)
+    def __call__(self, epoch, iteration, loss, score=None):
+        return any(t(epoch, iteration, loss, score)
+                   for t in self.triggers)

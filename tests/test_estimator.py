@@ -301,6 +301,49 @@ def test_triggers():
     assert s(0, 5, None) and not s(0, 6, None)
     o = TriggerOr(MaxEpoch(3), MinLoss(0.1))
     assert o(3, 0, 1.0) and o(0, 0, 0.05) and not o(1, 0, 1.0)
+    from analytics_zoo_tpu.learn.trigger import MaxScore
+    ms = MaxScore(0.7)
+    assert ms(0, 0, 1.0, score=0.8) and not ms(0, 0, 1.0, score=0.6)
+    assert not ms(0, 0, 1.0)  # no validation score yet → never fires
+    assert TriggerOr(MaxScore(0.9), MinLoss(0.1))(0, 0, 0.05, score=0.2)
+
+
+def test_trigger_score_plumbing_and_compat(orca_ctx, tmp_path):
+    from analytics_zoo_tpu.learn.estimator import (_fire_trigger,
+                                                   _trigger_needs_score)
+    from analytics_zoo_tpu.learn.trigger import (MaxScore, MinLoss, Trigger,
+                                                 TriggerOr)
+
+    class OldStyle(Trigger):          # pre-score 3-arg user subclass
+        def __call__(self, epoch, iteration, loss):
+            return loss < 0.5
+
+    assert _fire_trigger(OldStyle(), 1, 1, 0.4, score=0.9)
+    assert _fire_trigger(MaxScore(0.5), 1, 1, 0.4, score=0.9)
+    assert not _fire_trigger(MaxScore(0.5), 1, 1, 0.4, score=None)
+    assert _trigger_needs_score(TriggerOr(MinLoss(0.1), MaxScore(0.5)))
+    assert not _trigger_needs_score(MinLoss(0.1))
+
+    # MaxScore without validation_data warns (trigger can never fire)
+    import warnings as w
+    import flax.linen as nn
+    from analytics_zoo_tpu.learn.estimator import Estimator
+
+    class Lin(nn.Module):
+        @nn.compact
+        def __call__(self, inp, train=False):
+            return nn.Dense(1)(inp)
+
+    x = np.random.RandomState(0).randn(32, 4).astype(np.float32)
+    y = x.sum(1, keepdims=True).astype(np.float32)
+    est = Estimator.from_flax(model=Lin(), loss="mse", optimizer="sgd",
+                              sample_input=x[:2],
+                              model_dir=str(tmp_path))
+    with w.catch_warnings(record=True) as rec:
+        w.simplefilter("always")
+        est.fit((x, y), epochs=1, batch_size=32,
+                checkpoint_trigger=MaxScore(0.9))
+    assert any("MaxScore" in str(r.message) for r in rec)
 
 
 def test_auc_metric(orca_ctx):
