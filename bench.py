@@ -263,6 +263,35 @@ def measure_tcn():
             "tcn_samples_per_sec": round(B / dt, 1)}
 
 
+def _device_watchdog(timeout_s: float = 180.0):
+    """Fail fast if backend init hangs (a wedged axon tunnel makes
+    jax.devices() block forever — better a clear error in the bench record
+    than a driver-side timeout with no output)."""
+    import threading
+    result = {}
+
+    def probe():
+        try:
+            import jax
+            result["devices"] = jax.devices()
+        except BaseException as e:      # report the real failure, not a hang
+            result["error"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "error" in result:
+        raise result["error"]           # fast failure: surface the traceback
+    if "devices" not in result:
+        print(json.dumps({
+            "metric": "ncf_train_samples_per_sec", "value": 0.0,
+            "unit": "samples/s", "vs_baseline": 0.0,
+            "error": f"device init did not complete within {timeout_s:.0f}s "
+                     "(accelerator tunnel unresponsive)"}))
+        sys.stdout.flush()
+        os._exit(3)
+
+
 def main():
     if "--cpu-baseline" in sys.argv:
         os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "")
@@ -273,6 +302,7 @@ def main():
         print(f"# CPU baseline: {res['best']:,.0f} samples/s "
               f"(staged {res['staged']:,.0f}, cached {cached})")
         return
+    _device_watchdog()
     import jax
     out = {
         "metric": "ncf_train_samples_per_sec",
