@@ -69,6 +69,12 @@ def _pool_args(mod):
     return k, s, p
 
 
+class _NoRule(NotImplementedError):
+    """No translation rule exists for this module TYPE (distinct from an
+    unsupported CONFIG of a known type, which raises plain
+    NotImplementedError and must propagate)."""
+
+
 class _ModuleRule:
     """Translate one torch layer instance into
     ``(trainable params, frozen buffers, jax fn)``; the executor calls
@@ -186,6 +192,73 @@ class _ModuleRule:
         if isinstance(mod, tnn.Embedding):
             p = {"embedding": _np(mod.weight)}
             return p, {}, lambda pr, x: pr["embedding"][x.astype(jnp.int32)]
+        if isinstance(mod, (tnn.LSTM, tnn.GRU)):
+            if mod.bidirectional:
+                raise NotImplementedError("bidirectional RNNs not supported")
+            if mod.dropout and mod.num_layers > 1:
+                # single-layer dropout is a documented torch no-op
+                raise NotImplementedError(
+                    "inter-layer RNN dropout not supported; set dropout=0")
+            if getattr(mod, "proj_size", 0):
+                raise NotImplementedError("LSTM proj_size not supported")
+            n_layers = mod.num_layers
+            batch_first = mod.batch_first
+            is_lstm = isinstance(mod, tnn.LSTM)
+            p = {}
+            for layer in range(n_layers):
+                p[f"wi{layer}"] = _np(getattr(mod, f"weight_ih_l{layer}"))
+                p[f"wh{layer}"] = _np(getattr(mod, f"weight_hh_l{layer}"))
+                if mod.bias:
+                    p[f"bi{layer}"] = _np(getattr(mod, f"bias_ih_l{layer}"))
+                    p[f"bh{layer}"] = _np(getattr(mod, f"bias_hh_l{layer}"))
+            hidden = mod.hidden_size
+
+            def rnn(pr, x, *rest):
+                import jax.lax as lax
+                if rest:
+                    raise NotImplementedError(
+                        "explicit initial RNN state is not supported — "
+                        "the translated RNN always starts from zeros")
+                if batch_first:                       # (B,T,I) → (T,B,I)
+                    x = jnp.swapaxes(x, 0, 1)
+                T, B = x.shape[0], x.shape[1]
+                finals_h, finals_c = [], []
+                for layer in range(n_layers):
+                    wi, wh = pr[f"wi{layer}"], pr[f"wh{layer}"]
+                    bi = pr.get(f"bi{layer}", 0.0)
+                    bh = pr.get(f"bh{layer}", 0.0)
+                    h0 = jnp.zeros((B, hidden), x.dtype)
+
+                    if is_lstm:
+                        def step(carry, x_t, wi=wi, wh=wh, bi=bi, bh=bh):
+                            h, c = carry
+                            z = x_t @ wi.T + h @ wh.T + bi + bh
+                            i, f, g, o = jnp.split(z, 4, axis=-1)
+                            c = jax.nn.sigmoid(f) * c + \
+                                jax.nn.sigmoid(i) * jnp.tanh(g)
+                            h = jax.nn.sigmoid(o) * jnp.tanh(c)
+                            return (h, c), h
+                        (hT, cT), x = lax.scan(step, (h0, h0), x)
+                        finals_c.append(cT)
+                    else:
+                        def step(h, x_t, wi=wi, wh=wh, bi=bi, bh=bh):
+                            gi = x_t @ wi.T + bi
+                            gh = h @ wh.T + bh
+                            ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+                            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+                            r = jax.nn.sigmoid(ir + hr)
+                            z = jax.nn.sigmoid(iz + hz)
+                            n = jnp.tanh(in_ + r * hn)   # torch's gate form
+                            h = (1.0 - z) * n + z * h
+                            return h, h
+                        hT, x = lax.scan(step, h0, x)
+                    finals_h.append(hT)
+                out = jnp.swapaxes(x, 0, 1) if batch_first else x
+                h_n = jnp.stack(finals_h)             # (layers, B, H)
+                if is_lstm:
+                    return out, (h_n, jnp.stack(finals_c))
+                return out, h_n
+            return p, {}, rnn
         if isinstance(mod, tnn.Identity):
             return {}, {}, lambda pr, x: x
         if isinstance(mod, tnn.Dropout):
@@ -266,7 +339,7 @@ class _ModuleRule:
             if size not in (1, (1, 1)):
                 raise NotImplementedError("AdaptiveAvgPool2d only to (1,1)")
             return {}, {}, lambda pr, x: x.mean(axis=(2, 3), keepdims=True)
-        raise NotImplementedError(
+        raise _NoRule(
             f"torch module {type(mod).__name__} has no TPU translation rule")
 
 
@@ -288,6 +361,31 @@ def torch_to_jax(module) -> Tuple[Callable, Dict[str, Any]]:
     import jax.numpy as jnp
 
     module = module.eval()
+    # A bare leaf module (e.g. nn.LSTM passed directly) must not be fx-
+    # traced — fx only treats torch.nn classes as leaves when they are
+    # SUBmodules; tracing into an RNN's forward hits data-dependent
+    # control flow. Translate it directly instead. "has no TPU translation
+    # rule" falls through to the fx path for containers/custom modules;
+    # any other NotImplementedError (unsupported config of a known leaf)
+    # propagates.
+    try:
+        p, b, fn = _ModuleRule.translate(module)
+        is_leaf = True
+    except _NoRule:
+        is_leaf = False
+    if is_leaf:
+        variables = {"params": {"root": p}, "buffers": {"root": b}}
+
+        def leaf_apply(variables, *inputs, train=False, rng=None):
+            merged = dict(variables["buffers"].get("root", {}))
+            merged.update(variables["params"].get("root", {}))
+            if getattr(fn, "_needs_ctx", False):
+                merged["__train__"] = train
+                merged["__rng__"] = rng
+            return fn(merged, *inputs)
+
+        return leaf_apply, variables
+
     graph_module = fx.symbolic_trace(module)
     modules = dict(graph_module.named_modules())
 

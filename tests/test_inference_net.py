@@ -373,3 +373,100 @@ class TestInferenceModel:
     def test_predict_without_model_raises(self):
         with pytest.raises(RuntimeError, match="no model"):
             InferenceModel().predict(np.zeros((2, 2)))
+
+
+class TestRecurrentTranslation:
+    @pytest.mark.parametrize("batch_first", [True, False])
+    def test_lstm_matches_torch(self, batch_first):
+        torch.manual_seed(3)
+        m = tnn.LSTM(input_size=5, hidden_size=7, num_layers=2,
+                     batch_first=batch_first)
+        shape = (3, 6, 5) if batch_first else (6, 3, 5)
+        x = np.random.RandomState(0).randn(*shape).astype(np.float32)
+        apply_fn, variables = torch_to_jax(m)
+        out, (h_n, c_n) = apply_fn(variables, x)
+        with torch.no_grad():
+            want, (wh, wc) = m(torch.from_numpy(x))
+        np.testing.assert_allclose(np.asarray(out), want.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(h_n), wh.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(c_n), wc.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_gru_matches_torch(self):
+        torch.manual_seed(4)
+        m = tnn.GRU(input_size=4, hidden_size=6, num_layers=2,
+                    batch_first=True)
+        x = np.random.RandomState(1).randn(2, 5, 4).astype(np.float32)
+        apply_fn, variables = torch_to_jax(m)
+        out, h_n = apply_fn(variables, x)
+        with torch.no_grad():
+            want, wh = m(torch.from_numpy(x))
+        np.testing.assert_allclose(np.asarray(out), want.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(h_n), wh.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_lstm_classifier_end_to_end(self, orca_ctx):
+        """Embedding → LSTM → last step → Linear, traced through fx and
+        served via TorchNet (the sentiment-analysis torch shape)."""
+        torch.manual_seed(5)
+
+        class Clf(tnn.Module):
+            def __init__(self):
+                super().__init__()
+                self.emb = tnn.Embedding(50, 8)
+                self.lstm = tnn.LSTM(8, 12, batch_first=True)
+                self.fc = tnn.Linear(12, 2)
+
+            def forward(self, ids):
+                x = self.emb(ids)
+                x, _ = self.lstm(x)
+                return self.fc(x[:, -1])
+
+        m = Clf()
+        ids = np.random.RandomState(2).randint(0, 50, (4, 9))
+        tn = TorchNet(m)
+        got = np.asarray(tn.predict(ids.astype(np.float32)))
+        with torch.no_grad():
+            want = m(torch.from_numpy(ids)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_unsupported_rnn_configs_raise(self):
+        with pytest.raises(NotImplementedError, match="bidirectional"):
+            torch_to_jax(tnn.LSTM(4, 4, bidirectional=True))
+        with pytest.raises(NotImplementedError, match="dropout"):
+            torch_to_jax(tnn.GRU(4, 4, num_layers=2, dropout=0.5))
+        with pytest.raises(NotImplementedError, match="proj_size"):
+            torch_to_jax(tnn.LSTM(4, 8, proj_size=3))
+
+    def test_single_layer_dropout_is_noop_like_torch(self):
+        # torch documents dropout as a no-op when num_layers == 1
+        import warnings as w
+        with w.catch_warnings():
+            w.simplefilter("ignore")
+            m = tnn.LSTM(4, 6, batch_first=True, dropout=0.3)
+        x = np.random.RandomState(3).randn(2, 5, 4).astype(np.float32)
+        apply_fn, variables = torch_to_jax(m)
+        out, _ = apply_fn(variables, x)
+        with torch.no_grad():
+            want, _ = m(torch.from_numpy(x))
+        np.testing.assert_allclose(np.asarray(out), want.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_explicit_initial_state_rejected(self):
+        class WithState(tnn.Module):
+            def __init__(self):
+                super().__init__()
+                self.gru = tnn.GRU(4, 6, batch_first=True)
+
+            def forward(self, x, h0):
+                out, _ = self.gru(x, h0)
+                return out
+
+        x = np.zeros((2, 5, 4), np.float32)
+        h0 = np.zeros((1, 2, 6), np.float32)
+        apply_fn, variables = torch_to_jax(WithState())
+        with pytest.raises(NotImplementedError, match="initial RNN state"):
+            apply_fn(variables, x, h0)
