@@ -15,11 +15,13 @@ like any native model — so ``Estimator.from_torch`` trains it with the same
 pjit train step (no Jep, no flat-tensor shuttling; XLA owns the layout).
 
 Supported surface: the torch layer/function vocabulary used across the
-reference's torch examples and tests (Linear, Conv1d/2d, BatchNorm1d/2d,
-LayerNorm, Embedding, Dropout, ReLU/GELU/Tanh/Sigmoid/Softmax/LogSoftmax,
-Max/AvgPool2d, AdaptiveAvgPool2d(1), Flatten, Sequential + residual adds,
-cat, view/reshape/permute/transpose/mean/sum, matmul). Unsupported nodes
-raise with the node name so the gap is explicit.
+reference's torch examples and tests (Linear, Conv1d/2d, ConvTranspose2d,
+BatchNorm1d/2d, GroupNorm, LayerNorm, Embedding, LSTM, GRU,
+MultiheadAttention, Dropout, ReLU/GELU/ELU/SiLU/LeakyReLU/Tanh/Sigmoid/
+Softmax/LogSoftmax/Softplus/Hardtanh, Max/AvgPool2d, AdaptiveAvgPool2d(1),
+Flatten, Sequential + residual adds, cat, view/reshape/permute/transpose/
+mean/sum, matmul). Unsupported nodes raise with the node name so the gap
+is explicit.
 """
 
 from __future__ import annotations
@@ -192,6 +194,76 @@ class _ModuleRule:
         if isinstance(mod, tnn.Embedding):
             p = {"embedding": _np(mod.weight)}
             return p, {}, lambda pr, x: pr["embedding"][x.astype(jnp.int32)]
+        if isinstance(mod, tnn.MultiheadAttention):
+            if mod.in_proj_weight is None:
+                raise NotImplementedError(
+                    "MultiheadAttention with distinct q/k/v embed dims "
+                    "not supported")
+            if mod.bias_k is not None or mod.add_zero_attn:
+                raise NotImplementedError(
+                    "add_bias_kv / add_zero_attn not supported")
+            if mod.dropout:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "translated MultiheadAttention: attention dropout "
+                    "(p=%.2f) is inert — eval semantics in both modes",
+                    mod.dropout)
+            E, H = mod.embed_dim, mod.num_heads
+            mha_batch_first = mod.batch_first
+            p = {"in_w": _np(mod.in_proj_weight),      # (3E, E)
+                 "out_w": _np(mod.out_proj.weight)}    # (E, E)
+            if mod.in_proj_bias is not None:
+                p["in_b"] = _np(mod.in_proj_bias)
+            if mod.out_proj.bias is not None:
+                p["out_b"] = _np(mod.out_proj.bias)
+
+            def mha(pr, q, k, v, key_padding_mask=None, need_weights=True,
+                    attn_mask=None, average_attn_weights=True,
+                    is_causal=False):
+                if key_padding_mask is not None or attn_mask is not None \
+                        or is_causal:
+                    raise NotImplementedError(
+                        "attention masks are not supported in the "
+                        "translated MultiheadAttention")
+                if q.ndim != 3:
+                    raise NotImplementedError(
+                        "translated MultiheadAttention needs batched "
+                        "(B, T, E) / (T, B, E) input")
+                if not mha_batch_first:                # (T,B,E) → (B,T,E)
+                    q, k, v = (jnp.swapaxes(t, 0, 1) for t in (q, k, v))
+                wq, wk, wv = jnp.split(pr["in_w"], 3, axis=0)
+                bq = bk = bv = 0.0
+                if "in_b" in pr:
+                    bq, bk, bv = jnp.split(pr["in_b"], 3, axis=0)
+                d = E // H
+
+                def heads(x, w, b):
+                    y = x @ w.T + b
+                    return y.reshape(y.shape[0], y.shape[1], H, d)
+
+                qh, kh, vh = heads(q, wq, bq), heads(k, wk, bk), \
+                    heads(v, wv, bv)
+                if need_weights:
+                    # probs must be materialized — reference chain
+                    scores = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) / \
+                        jnp.sqrt(jnp.asarray(d, q.dtype))
+                    attn = jax.nn.softmax(scores, axis=-1)
+                    out = jnp.einsum("bhqk,bkhd->bqhd", attn, vh)
+                    w_out = attn.mean(1) if average_attn_weights else attn
+                else:
+                    # shared attention core (pallas flash kernel on TPU
+                    # when shapes are tile-aligned)
+                    from analytics_zoo_tpu.ops.attention import (
+                        dot_product_attention,
+                    )
+                    out = dot_product_attention(qh, kh, vh)
+                    w_out = None
+                out = out.reshape(out.shape[0], out.shape[1], E)
+                out = out @ pr["out_w"].T + pr.get("out_b", 0.0)
+                if not mha_batch_first:
+                    out = jnp.swapaxes(out, 0, 1)
+                return out, w_out
+            return p, {}, mha
         if isinstance(mod, (tnn.LSTM, tnn.GRU)):
             if mod.bidirectional:
                 raise NotImplementedError("bidirectional RNNs not supported")
@@ -213,13 +285,16 @@ class _ModuleRule:
                     p[f"bh{layer}"] = _np(getattr(mod, f"bias_hh_l{layer}"))
             hidden = mod.hidden_size
 
-            def rnn(pr, x, *rest):
+            def rnn(pr, x, *rest, hx=None):
                 import jax.lax as lax
-                if rest:
+                if rest or hx is not None:
                     raise NotImplementedError(
                         "explicit initial RNN state is not supported — "
                         "the translated RNN always starts from zeros")
-                if batch_first:                       # (B,T,I) → (T,B,I)
+                unbatched = x.ndim == 2               # torch (T, I) input
+                if unbatched:
+                    x = x[:, None]                    # → (T, 1, I)
+                elif batch_first:                     # (B,T,I) → (T,B,I)
                     x = jnp.swapaxes(x, 0, 1)
                 T, B = x.shape[0], x.shape[1]
                 finals_h, finals_c = [], []
@@ -253,6 +328,12 @@ class _ModuleRule:
                             return h, h
                         hT, x = lax.scan(step, h0, x)
                     finals_h.append(hT)
+                if unbatched:
+                    out = x[:, 0]                     # (T, H)
+                    h_n = jnp.stack(finals_h)[:, 0]   # (layers, H)
+                    if is_lstm:
+                        return out, (h_n, jnp.stack(finals_c)[:, 0])
+                    return out, h_n
                 out = jnp.swapaxes(x, 0, 1) if batch_first else x
                 h_n = jnp.stack(finals_h)             # (layers, B, H)
                 if is_lstm:
@@ -376,13 +457,13 @@ def torch_to_jax(module) -> Tuple[Callable, Dict[str, Any]]:
     if is_leaf:
         variables = {"params": {"root": p}, "buffers": {"root": b}}
 
-        def leaf_apply(variables, *inputs, train=False, rng=None):
+        def leaf_apply(variables, *inputs, train=False, rng=None, **kw):
             merged = dict(variables["buffers"].get("root", {}))
             merged.update(variables["params"].get("root", {}))
             if getattr(fn, "_needs_ctx", False):
                 merged["__train__"] = train
                 merged["__rng__"] = rng
-            return fn(merged, *inputs)
+            return fn(merged, *inputs, **kw)
 
         return leaf_apply, variables
 
@@ -542,7 +623,8 @@ def torch_to_jax(module) -> Tuple[Callable, Dict[str, Any]]:
                     pr["__train__"] = bool(train)
                     pr["__rng__"] = None if rng is None else \
                         jax.random.fold_in(rng, ctx_nodes[name])
-                env[name] = fn(pr, *[lookup(a) for a in args])
+                env[name] = fn(pr, *[lookup(a) for a in args],
+                               **{k: lookup(v) for k, v in kwargs.items()})
             elif op == "call_function":
                 env[name] = _FN_MAP[target](
                     *[lookup(a) for a in args],

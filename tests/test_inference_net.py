@@ -470,3 +470,78 @@ class TestRecurrentTranslation:
         apply_fn, variables = torch_to_jax(WithState())
         with pytest.raises(NotImplementedError, match="initial RNN state"):
             apply_fn(variables, x, h0)
+        # keyword spelling lands in the same guard
+        apply_fn2, variables2 = torch_to_jax(tnn.GRU(4, 6, batch_first=True))
+        with pytest.raises(NotImplementedError, match="initial RNN state"):
+            apply_fn2(variables2, x, hx=h0)
+
+    def test_unbatched_rnn_matches_torch(self):
+        torch.manual_seed(9)
+        m = tnn.LSTM(4, 6, num_layers=2)
+        x = np.random.RandomState(4).randn(7, 4).astype(np.float32)
+        apply_fn, variables = torch_to_jax(m)
+        out, (h_n, c_n) = apply_fn(variables, x)
+        with torch.no_grad():
+            want, (wh, wc) = m(torch.from_numpy(x))
+        assert np.asarray(out).shape == (7, 6)
+        np.testing.assert_allclose(np.asarray(out), want.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(h_n), wh.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(c_n), wc.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestAttentionTranslation:
+    @pytest.mark.parametrize("batch_first", [True, False])
+    def test_self_attention_matches_torch(self, batch_first):
+        torch.manual_seed(6)
+        m = tnn.MultiheadAttention(embed_dim=8, num_heads=2,
+                                   batch_first=batch_first)
+        shape = (2, 5, 8) if batch_first else (5, 2, 8)
+        x = np.random.RandomState(0).randn(*shape).astype(np.float32)
+        apply_fn, variables = torch_to_jax(m)
+        out, w = apply_fn(variables, x, x, x)
+        with torch.no_grad():
+            want, ww = m(*(torch.from_numpy(x),) * 3)
+        np.testing.assert_allclose(np.asarray(out), want.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(w), ww.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_cross_attention_in_model(self, orca_ctx):
+        """A traced block calling attn(q, kv, kv, need_weights=False) —
+        exercises call_module kwargs passing."""
+        torch.manual_seed(7)
+
+        class Block(tnn.Module):
+            def __init__(self):
+                super().__init__()
+                self.attn = tnn.MultiheadAttention(8, 2, batch_first=True)
+                self.fc = tnn.Linear(8, 3)
+
+            def forward(self, q, kv):
+                x, _ = self.attn(q, kv, kv, need_weights=False)
+                return self.fc(x.mean(1))
+
+        m = Block()
+        rng = np.random.RandomState(1)
+        q = rng.randn(2, 4, 8).astype(np.float32)
+        kv = rng.randn(2, 6, 8).astype(np.float32)
+        apply_fn, variables = torch_to_jax(m)
+        got = np.asarray(apply_fn(variables, q, kv))
+        with torch.no_grad():
+            want = m(torch.from_numpy(q), torch.from_numpy(kv)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_unsupported_mha_configs(self):
+        with pytest.raises(NotImplementedError, match="embed dims"):
+            torch_to_jax(tnn.MultiheadAttention(8, 2, kdim=4, vdim=4))
+        with pytest.raises(NotImplementedError, match="add_bias_kv"):
+            torch_to_jax(tnn.MultiheadAttention(8, 2, add_bias_kv=True))
+        m = tnn.MultiheadAttention(8, 2, batch_first=True)
+        apply_fn, variables = torch_to_jax(m)
+        x = np.zeros((1, 3, 8), np.float32)
+        mask = np.zeros((3, 3), np.float32)
+        with pytest.raises(NotImplementedError, match="masks"):
+            apply_fn(variables, x, x, x, attn_mask=mask)
