@@ -17,7 +17,8 @@ pjit train step (no Jep, no flat-tensor shuttling; XLA owns the layout).
 Supported surface: the torch layer/function vocabulary used across the
 reference's torch examples and tests (Linear, Conv1d/2d, ConvTranspose2d,
 BatchNorm1d/2d, GroupNorm, LayerNorm, Embedding, LSTM, GRU,
-MultiheadAttention, Dropout, ReLU/GELU/ELU/SiLU/LeakyReLU/Tanh/Sigmoid/
+MultiheadAttention, TransformerEncoder(Layer), Dropout,
+ReLU/GELU/ELU/SiLU/LeakyReLU/Tanh/Sigmoid/
 Softmax/LogSoftmax/Softplus/Hardtanh, Max/AvgPool2d, AdaptiveAvgPool2d(1),
 Flatten, Sequential + residual adds, cat, view/reshape/permute/transpose/
 mean/sum, matmul). Unsupported nodes raise with the node name so the gap
@@ -75,6 +76,24 @@ class _NoRule(NotImplementedError):
     """No translation rule exists for this module TYPE (distinct from an
     unsupported CONFIG of a known type, which raises plain
     NotImplementedError and must propagate)."""
+
+
+def _sub_translate(sub, what: str):
+    """Translate a composite rule's sub-component. A _NoRule here must NOT
+    escape as _NoRule (torch_to_jax would misread it as 'no rule for the
+    TOP module' and fall into fx tracing); stateful/ctx-needing
+    sub-components are rejected clearly at translation time rather than
+    crashing at first forward."""
+    try:
+        p, b, fn = _ModuleRule.translate(sub)
+    except _NoRule as e:
+        raise NotImplementedError(
+            f"{what}: {type(sub).__name__} has no translation rule") from e
+    if b or getattr(fn, "_needs_ctx", False):
+        raise NotImplementedError(
+            f"{what}: {type(sub).__name__} with frozen state or train-time "
+            "randomness is not supported inside a composite rule")
+    return p, b, fn
 
 
 class _ModuleRule:
@@ -243,19 +262,17 @@ class _ModuleRule:
 
                 qh, kh, vh = heads(q, wq, bq), heads(k, wk, bk), \
                     heads(v, wv, bv)
+                from analytics_zoo_tpu.ops.attention import (
+                    _reference_attention, dot_product_attention,
+                )
                 if need_weights:
-                    # probs must be materialized — reference chain
-                    scores = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) / \
-                        jnp.sqrt(jnp.asarray(d, q.dtype))
-                    attn = jax.nn.softmax(scores, axis=-1)
-                    out = jnp.einsum("bhqk,bkhd->bqhd", attn, vh)
+                    # probs must be materialized — shared reference chain
+                    out, attn = _reference_attention(qh, kh, vh,
+                                                     return_probs=True)
                     w_out = attn.mean(1) if average_attn_weights else attn
                 else:
                     # shared attention core (pallas flash kernel on TPU
                     # when shapes are tile-aligned)
-                    from analytics_zoo_tpu.ops.attention import (
-                        dot_product_attention,
-                    )
                     out = dot_product_attention(qh, kh, vh)
                     w_out = None
                 out = out.reshape(out.shape[0], out.shape[1], E)
@@ -264,6 +281,81 @@ class _ModuleRule:
                     out = jnp.swapaxes(out, 0, 1)
                 return out, w_out
             return p, {}, mha
+        if isinstance(mod, tnn.TransformerEncoderLayer):
+            # compose from the already-translated pieces (fx treats the
+            # whole layer as a leaf, so the rule recurses explicitly)
+            pa, _, attn_fn = _sub_translate(mod.self_attn, "self_attn")
+            p1, _, lin1_fn = _sub_translate(mod.linear1, "linear1")
+            p2, _, lin2_fn = _sub_translate(mod.linear2, "linear2")
+            pn1, _, norm1_fn = _sub_translate(mod.norm1, "norm1")
+            pn2, _, norm2_fn = _sub_translate(mod.norm2, "norm2")
+            norm_first = mod.norm_first
+            import torch
+            import torch.nn.functional as tF
+            act_map = {tF.relu: jax.nn.relu, tF.gelu: jax.nn.gelu,
+                       torch.relu: jax.nn.relu}
+            act = act_map.get(mod.activation)
+            if act is None and isinstance(mod.activation, tnn.Module):
+                _, _, act_leaf = _sub_translate(mod.activation, "activation")
+                act = lambda x: act_leaf({}, x)  # noqa: E731
+            if act is None:
+                raise NotImplementedError(
+                    f"TransformerEncoderLayer activation "
+                    f"{mod.activation} not supported")
+            if mod.dropout1.p or mod.dropout.p:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "translated TransformerEncoderLayer: dropout is inert "
+                    "— eval semantics in both modes")
+            p = {"attn": pa, "lin1": p1, "lin2": p2,
+                 "norm1": pn1, "norm2": pn2}
+
+            def tel(pr, x, src_mask=None, src_key_padding_mask=None,
+                    is_causal=False):
+                if src_mask is not None or src_key_padding_mask is not None \
+                        or is_causal:
+                    raise NotImplementedError(
+                        "masks are not supported in the translated "
+                        "TransformerEncoderLayer")
+
+                def sa(y):
+                    return attn_fn(pr["attn"], y, y, y,
+                                   need_weights=False)[0]
+
+                def ff(y):
+                    return lin2_fn(pr["lin2"],
+                                   act(lin1_fn(pr["lin1"], y)))
+
+                if norm_first:
+                    x = x + sa(norm1_fn(pr["norm1"], x))
+                    return x + ff(norm2_fn(pr["norm2"], x))
+                x = norm1_fn(pr["norm1"], x + sa(x))
+                return norm2_fn(pr["norm2"], x + ff(x))
+            return p, {}, tel
+        if isinstance(mod, tnn.TransformerEncoder):
+            stack = [_sub_translate(layer, f"layers[{i}]")
+                     for i, layer in enumerate(mod.layers)]
+            final = None
+            p = {f"layer{i}": lp for i, (lp, _, _) in enumerate(stack)}
+            if mod.norm is not None:
+                pn, _, final = _sub_translate(mod.norm, "norm")
+                p["final_norm"] = pn
+            layer_fns = [fn for _, _, fn in stack]
+            final_fn = final
+
+            def tenc(pr, x, mask=None, src_key_padding_mask=None,
+                     is_causal=None):
+                if mask is not None or src_key_padding_mask is not None \
+                        or is_causal:
+                    raise NotImplementedError(
+                        "masks are not supported in the translated "
+                        "TransformerEncoder")
+                for i, fn in enumerate(layer_fns):
+                    x = fn(pr[f"layer{i}"], x)
+                if final_fn is not None:
+                    x = final_fn(pr["final_norm"], x)
+                return x
+            return p, {}, tenc
         if isinstance(mod, (tnn.LSTM, tnn.GRU)):
             if mod.bidirectional:
                 raise NotImplementedError("bidirectional RNNs not supported")

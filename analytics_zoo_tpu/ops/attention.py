@@ -55,7 +55,8 @@ def _flash_ok(q, k, mask) -> bool:
     return on_tpu and aligned and scores_bytes > (1 << 31)  # > 2 GiB
 
 
-def _reference_attention(q, k, v, mask=None, causal=False):
+def _reference_attention(q, k, v, mask=None, causal=False,
+                         return_probs: bool = False):
     d = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(d).astype(q.dtype)
     if causal:
@@ -66,7 +67,8 @@ def _reference_attention(q, k, v, mask=None, causal=False):
         scores = jnp.where(mask.astype(bool), scores,
                            jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return (out, probs) if return_probs else out
 
 
 class AttentionModule(nn.Module):

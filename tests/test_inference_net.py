@@ -545,3 +545,71 @@ class TestAttentionTranslation:
         mask = np.zeros((3, 3), np.float32)
         with pytest.raises(NotImplementedError, match="masks"):
             apply_fn(variables, x, x, x, attn_mask=mask)
+
+
+class TestTransformerTranslation:
+    @pytest.mark.parametrize("norm_first", [False, True])
+    def test_encoder_layer_matches_torch(self, norm_first):
+        torch.manual_seed(10)
+        m = tnn.TransformerEncoderLayer(
+            d_model=8, nhead=2, dim_feedforward=16, dropout=0.0,
+            batch_first=True, norm_first=norm_first).eval()
+        x = np.random.RandomState(0).randn(2, 5, 8).astype(np.float32)
+        apply_fn, variables = torch_to_jax(m)
+        got = np.asarray(apply_fn(variables, x))
+        with torch.no_grad():
+            want = m(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_encoder_stack_matches_torch(self):
+        torch.manual_seed(11)
+        layer = tnn.TransformerEncoderLayer(
+            d_model=8, nhead=2, dim_feedforward=16, dropout=0.0,
+            activation="gelu", batch_first=True)
+        m = tnn.TransformerEncoder(layer, num_layers=3,
+                                   norm=tnn.LayerNorm(8)).eval()
+        x = np.random.RandomState(1).randn(2, 6, 8).astype(np.float32)
+        apply_fn, variables = torch_to_jax(m)
+        got = np.asarray(apply_fn(variables, x))
+        with torch.no_grad():
+            want = m(torch.from_numpy(x)).numpy()
+        # float32 accumulation drift across 3 stacked layers
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=5e-4)
+        # stacked layers have independent (deep-copied) weights in torch
+        assert len(variables["params"]["root"]) == 4  # 3 layers + final norm
+
+    def test_transformer_classifier_end_to_end(self, orca_ctx):
+        torch.manual_seed(12)
+
+        class Clf(tnn.Module):
+            def __init__(self):
+                super().__init__()
+                self.emb = tnn.Embedding(30, 8)
+                layer = tnn.TransformerEncoderLayer(
+                    8, 2, dim_feedforward=16, dropout=0.0,
+                    batch_first=True)
+                self.enc = tnn.TransformerEncoder(layer, 2)
+                self.fc = tnn.Linear(8, 2)
+
+            def forward(self, ids):
+                x = self.emb(ids)
+                x = self.enc(x)
+                return self.fc(x.mean(1))
+
+        m = Clf().eval()
+        ids = np.random.RandomState(2).randint(0, 30, (4, 7))
+        got = np.asarray(TorchNet(m).predict(ids.astype(np.float32)))
+        with torch.no_grad():
+            want = m(torch.from_numpy(ids)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_unsupported_sub_components_raise_cleanly(self):
+        with pytest.raises(NotImplementedError, match="activation.*PReLU"):
+            torch_to_jax(tnn.TransformerEncoderLayer(
+                8, 2, dropout=0.0, activation=tnn.PReLU(),
+                batch_first=True))
+        layer = tnn.TransformerEncoderLayer(8, 2, dropout=0.0,
+                                            batch_first=True)
+        with pytest.raises(NotImplementedError, match="norm.*frozen state"):
+            torch_to_jax(tnn.TransformerEncoder(layer, 1,
+                                                norm=tnn.BatchNorm1d(8)))
