@@ -9,11 +9,15 @@ GraphProto / NodeProto / TensorProto / AttributeProto) and translates the
 node graph into a pure jax function, exactly like ``torch_net.torch_to_jax``
 — the result jits, shards and differentiates like any native model.
 
-Supported op set (the reference loader's vocabulary): MatMul, Gemm,
-Add/Sub/Mul/Div, Relu/Sigmoid/Tanh/Softmax/Erf, Conv (2d), MaxPool,
-AveragePool, GlobalAveragePool, BatchNormalization (inference), Flatten,
-Reshape, Transpose, Concat, Gather, Squeeze/Unsqueeze, Identity, Constant.
-Unsupported nodes raise with the op name.
+Supported op set (the reference loader's vocabulary plus the common
+export surface): MatMul, Gemm, Add/Sub/Mul/Div/Pow/Neg/Abs,
+Relu/LeakyRelu/Elu/Sigmoid/Tanh/Softmax/Erf, Exp/Log/Sqrt/Clip,
+Conv (2d), MaxPool, AveragePool, GlobalAveragePool, BatchNormalization
+(inference), Flatten, Reshape, Transpose, Concat, Gather,
+Squeeze/Unsqueeze, ReduceMean/ReduceSum, Pad (constant), Cast, Where,
+Expand, Slice (attr and input forms), Identity, Constant. Unsupported
+nodes raise with the op name; integer/bool initializers stay static so
+shape operands remain concrete under jit.
 """
 
 from __future__ import annotations
@@ -64,6 +68,11 @@ def _signed(v: int) -> int:
 
 _DTYPES = {1: np.float32, 6: np.int32, 7: np.int64, 9: np.bool_,
            10: np.float16, 11: np.float64}
+try:                                    # jax ships ml_dtypes
+    import ml_dtypes as _mld
+    _DTYPES[16] = np.dtype(_mld.bfloat16)
+except ImportError:                     # pragma: no cover
+    pass
 
 
 def _tensor(buf: bytes) -> Tuple[str, np.ndarray]:
@@ -298,6 +307,86 @@ def _apply_node(node: _Node, env: Dict[str, Any]):
         return x[0]
     if op == "Constant":
         return jnp.asarray(a["value"])
+    if op == "LeakyRelu":
+        alpha = a.get("alpha", 0.01)
+        return jnp.where(x[0] >= 0, x[0], alpha * x[0])
+    if op == "Elu":
+        alpha = a.get("alpha", 1.0)
+        return jnp.where(x[0] >= 0, x[0], alpha * (jnp.exp(x[0]) - 1.0))
+    if op == "Clip":
+        # opset<11: attrs; opset>=11: optional min/max inputs
+        lo = x[1] if len(x) > 1 and x[1] is not None else a.get("min")
+        hi = x[2] if len(x) > 2 and x[2] is not None else a.get("max")
+        return jnp.clip(x[0], lo, hi)
+    if op == "Exp":
+        return jnp.exp(x[0])
+    if op == "Log":
+        return jnp.log(x[0])
+    if op == "Sqrt":
+        return jnp.sqrt(x[0])
+    if op == "Pow":
+        return x[0] ** x[1]
+    if op == "Neg":
+        return -x[0]
+    if op == "Abs":
+        return jnp.abs(x[0])
+    if op == "ReduceMean":
+        axes = a.get("axes") or ([int(v) for v in np.asarray(x[1])]
+                                 if len(x) > 1 and x[1] is not None
+                                 else None)
+        keep = bool(a.get("keepdims", 1))
+        return x[0].mean(axis=tuple(axes) if axes else None, keepdims=keep)
+    if op == "ReduceSum":
+        axes = a.get("axes") or ([int(v) for v in np.asarray(x[1])]
+                                 if len(x) > 1 and x[1] is not None
+                                 else None)
+        if not axes and a.get("noop_with_empty_axes"):
+            return x[0]                 # opset-13: empty axes = identity
+        keep = bool(a.get("keepdims", 1))
+        return x[0].sum(axis=tuple(axes) if axes else None, keepdims=keep)
+    if op == "Pad":
+        mode = a.get("mode", b"constant")
+        mode = mode.decode() if isinstance(mode, bytes) else mode
+        if mode != "constant":
+            raise NotImplementedError(f"Pad mode {mode!r} not supported")
+        pads = a.get("pads") or [int(v) for v in np.asarray(x[1])]
+        # keep the value traced — a float initializer lands in params
+        value = (x[2] if len(x) > 2 and x[2] is not None
+                 else a.get("value", 0.0))
+        n = x[0].ndim
+        widths = [(int(pads[i]), int(pads[i + n])) for i in range(n)]
+        return jnp.pad(x[0], widths, constant_values=value)
+    if op == "Cast":
+        to = int(a["to"])
+        if to not in _DTYPES:
+            raise NotImplementedError(f"Cast to dtype code {to} "
+                                      "not supported")
+        return x[0].astype(_DTYPES[to])
+    if op == "Where":
+        return jnp.where(x[0].astype(bool), x[1], x[2])
+    if op == "Expand":
+        shape = [int(v) for v in np.asarray(x[1])]
+        return jnp.broadcast_to(x[0], np.broadcast_shapes(x[0].shape,
+                                                          tuple(shape)))
+    if op == "Slice":
+        # opset>=10: starts/ends[/axes/steps] inputs; opset<10: attrs
+        if len(x) == 1:
+            starts, ends = list(a["starts"]), list(a["ends"])
+            axes = list(a.get("axes") or range(len(starts)))
+            steps = [1] * len(starts)
+        else:
+            starts = [int(v) for v in np.asarray(x[1])]
+            ends = [int(v) for v in np.asarray(x[2])]
+            axes = ([int(v) for v in np.asarray(x[3])]
+                    if len(x) > 3 and x[3] is not None
+                    else list(range(len(starts))))
+            steps = ([int(v) for v in np.asarray(x[4])]
+                     if len(x) > 4 and x[4] is not None
+                     else [1] * len(starts))
+        idx = [slice(None)] * x[0].ndim
+        for ax, st, en, sp in zip(axes, starts, ends, steps):
+            idx[ax] = slice(st, en, sp)
+        return x[0][tuple(idx)]
     raise NotImplementedError(f"ONNX op {op!r} has no TPU translation")
 
 
@@ -305,12 +394,24 @@ def onnx_to_jax(data: bytes):
     """ONNX ModelProto bytes → ``(apply_fn, {"params": initializers})``
     where ``apply_fn(variables, *inputs)`` is a pure jax function."""
     nodes, inits, graph_inputs, graph_outputs = parse_onnx(data)
-    params = {k: np.asarray(v) for k, v in inits.items()}
+    # integer/bool initializers are shape/index operands (Reshape, Slice,
+    # Pad, Expand, Gather indices…) — they must stay STATIC so the
+    # consuming op sees concrete values under jit; float initializers are
+    # the trainable params
+    params: Dict[str, Any] = {}
+    static: Dict[str, Any] = {}
+    for k, v in inits.items():
+        arr = np.asarray(v)
+        if np.issubdtype(arr.dtype, np.integer) or arr.dtype == np.bool_:
+            static[k] = arr
+        else:
+            params[k] = arr
 
     def apply_fn(variables, *inputs):
         import jax.numpy as jnp
-        env: Dict[str, Any] = {k: jnp.asarray(v)
-                               for k, v in variables["params"].items()}
+        env: Dict[str, Any] = dict(static)
+        env.update({k: jnp.asarray(v)
+                    for k, v in variables["params"].items()})
         if len(inputs) != len(graph_inputs):
             raise ValueError(f"model takes {len(graph_inputs)} inputs "
                              f"({graph_inputs}), got {len(inputs)}")

@@ -45,7 +45,8 @@ def _float_field(field: int, v: float) -> bytes:
 
 
 def tensor_proto(name: str, arr: np.ndarray) -> bytes:
-    dtype_code = {np.dtype("float32"): 1, np.dtype("int64"): 7}[arr.dtype]
+    dtype_code = {np.dtype("float32"): 1, np.dtype("int32"): 6,
+                  np.dtype("int64"): 7}[arr.dtype]
     out = b""
     for d in arr.shape:
         out += _int_field(1, d)
@@ -278,3 +279,118 @@ class TestOnnxErrors:
     def test_not_onnx_raises(self):
         with pytest.raises(ValueError, match="ModelProto"):
             onnx_to_jax(_int_field(3, 7))
+
+class TestOnnxExtendedOps:
+    def _run1(self, nodes, inits, in_names, x, n_out=1):
+        data = model_proto(nodes, inits, in_names, ["y"])
+        return ONNXNet(data).predict(x)
+
+    def test_elementwise_unary_chain(self, orca_ctx):
+        # y = -(sqrt(exp(log(abs(x)+1)))) through a single graph
+        nodes = [
+            node("Abs", ["x"], ["a"]),
+            node("Add", ["a", "one"], ["a1"]),
+            node("Log", ["a1"], ["l"]),
+            node("Exp", ["l"], ["e"]),
+            node("Sqrt", ["e"], ["s"]),
+            node("Neg", ["s"], ["y"]),
+        ]
+        inits = [tensor_proto("one", np.float32(1.0).reshape(()))]
+        x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        want = -np.sqrt(np.abs(x) + 1.0)
+        np.testing.assert_allclose(
+            self._run1(nodes, inits, ["x", "one"], x), want,
+            rtol=1e-5, atol=1e-5)
+
+    def test_leaky_elu_clip_pow(self, orca_ctx):
+        nodes = [
+            node("LeakyRelu", ["x"], ["lr"],
+                 attrs=[attr_float("alpha", 0.2)]),
+            node("Elu", ["lr"], ["el"], attrs=[attr_float("alpha", 0.5)]),
+            node("Clip", ["el", "lo", "hi"], ["cl"]),
+            node("Pow", ["cl", "two"], ["y"]),
+        ]
+        inits = [tensor_proto("lo", np.float32(-0.4).reshape(())),
+                 tensor_proto("hi", np.float32(0.9).reshape(())),
+                 tensor_proto("two", np.float32(2.0).reshape(()))]
+        x = np.random.RandomState(1).randn(2, 5).astype(np.float32)
+        lr = np.where(x >= 0, x, 0.2 * x)
+        el = np.where(lr >= 0, lr, 0.5 * (np.exp(lr) - 1.0))
+        want = np.clip(el, -0.4, 0.9) ** 2
+        np.testing.assert_allclose(
+            self._run1(nodes, inits, ["x", "lo", "hi", "two"], x), want,
+            rtol=1e-5, atol=1e-5)
+
+    def test_clip_attr_form(self, orca_ctx):
+        nodes = [node("Clip", ["x"], ["y"],
+                      attrs=[attr_float("min", -0.5),
+                             attr_float("max", 0.5)])]
+        x = np.random.RandomState(2).randn(8).astype(np.float32)
+        np.testing.assert_allclose(self._run1(nodes, [], ["x"], x),
+                                   np.clip(x, -0.5, 0.5), atol=1e-6)
+
+    def test_reduce_pad_where_expand(self, orca_ctx):
+        nodes = [
+            node("ReduceMean", ["x"], ["m"],
+                 attrs=[attr_ints("axes", [1]), attr_int("keepdims", 1)]),
+            node("Expand", ["m", "shape"], ["me"]),
+            node("Where", ["cond", "x", "me"], ["w"]),
+            node("Pad", ["w", "pads"], ["p"]),
+            node("ReduceSum", ["p"], ["y"],
+                 attrs=[attr_ints("axes", [0, 1]),
+                        attr_int("keepdims", 0)]),
+        ]
+        rng = np.random.RandomState(3)
+        x = rng.randn(3, 4).astype(np.float32)
+        cond = (rng.rand(3, 4) > 0.5)
+        inits = [tensor_proto("shape", np.asarray([3, 4], np.int64)),
+                 tensor_proto("cond", cond.astype(np.int32)),
+                 tensor_proto("pads", np.asarray([1, 0, 0, 2], np.int64))]
+        m = x.mean(1, keepdims=True)
+        w = np.where(cond, x, np.broadcast_to(m, x.shape))
+        p = np.pad(w, [(1, 0), (0, 2)])
+        want = p.sum()
+        got = self._run1(nodes, inits, ["x", "shape", "cond", "pads"], x)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_cast_and_slice_both_opsets(self, orca_ctx):
+        nodes = [
+            node("Cast", ["x"], ["c"], attrs=[attr_int("to", 6)]),  # int32
+            node("Cast", ["c"], ["f"], attrs=[attr_int("to", 1)]),  # float32
+            node("Slice", ["f", "starts", "ends", "axes", "steps"], ["y"]),
+        ]
+        x = (np.arange(24, dtype=np.float32) + 0.7).reshape(4, 6)
+        inits = [tensor_proto("starts", np.asarray([1, 0], np.int64)),
+                 tensor_proto("ends", np.asarray([4, 6], np.int64)),
+                 tensor_proto("axes", np.asarray([0, 1], np.int64)),
+                 tensor_proto("steps", np.asarray([1, 2], np.int64))]
+        want = np.floor(x).astype(np.float32)[1:4, 0:6:2]
+        got = self._run1(nodes, inits,
+                         ["x", "starts", "ends", "axes", "steps"], x)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+        # attr form (opset<10)
+        nodes = [node("Slice", ["x"], ["y"],
+                      attrs=[attr_ints("starts", [0, 2]),
+                             attr_ints("ends", [2, 5]),
+                             attr_ints("axes", [0, 1])])]
+        np.testing.assert_allclose(self._run1(nodes, [], ["x"], x),
+                                   x[0:2, 2:5], atol=1e-6)
+
+    def test_pad_with_traced_float_value(self, orca_ctx):
+        """A float initializer as Pad's constant value must work under
+        jit (it lands in params and is traced)."""
+        nodes = [node("Pad", ["x", "pads", "cv"], ["y"])]
+        inits = [tensor_proto("pads", np.asarray([0, 1, 0, 1], np.int64)),
+                 tensor_proto("cv", np.float32(-2.5).reshape(()))]
+        x = np.random.RandomState(5).randn(2, 3).astype(np.float32)
+        got = self._run1(nodes, inits, ["x", "pads", "cv"], x)
+        np.testing.assert_allclose(
+            got, np.pad(x, [(0, 0), (1, 1)], constant_values=-2.5),
+            atol=1e-6)
+
+    def test_reduce_sum_noop_with_empty_axes(self, orca_ctx):
+        nodes = [node("ReduceSum", ["x"], ["y"],
+                      attrs=[attr_int("noop_with_empty_axes", 1)])]
+        x = np.random.RandomState(6).randn(3, 2).astype(np.float32)
+        np.testing.assert_allclose(self._run1(nodes, [], ["x"], x), x,
+                                   atol=1e-6)
