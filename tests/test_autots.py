@@ -132,3 +132,37 @@ class TestAutoTS:
         ts = trainer.fit(train, val, recipe=recipe)
         assert ts.config["model"] == "TCN"
         assert ts.predict(val).shape[1] == 2
+
+
+class TestTimeSequencePredictor:
+    def test_fit_predict_evaluate(self, tmp_path, orca_ctx):
+        """(ref regression/time_sequence_predictor.py:23 — same surface
+        over the local engine)"""
+        from analytics_zoo_tpu.zouwu.regression import TimeSequencePredictor
+        df = sine_df(200)
+        df.loc[5, "value"] = np.nan          # drop_missing path
+        train, val = df.iloc[:160], df.iloc[140:].dropna()
+        tsp = TimeSequencePredictor(logs_dir=str(tmp_path),
+                                    future_seq_len=2,
+                                    target_col=["value"])
+        pipe = tsp.fit(train, val, recipe=SmokeRecipe())
+        assert isinstance(pipe, TSPipeline)
+        pred = tsp.predict(val)
+        assert pred.shape[1] == 2
+        res = tsp.evaluate(val, metric=["mse", "smape"])
+        assert set(res) == {"mse", "smape"}
+        with pytest.raises(ValueError, match="single target_col"):
+            TimeSequencePredictor(target_col=["a", "b"])
+
+    def test_predict_before_fit_raises(self, tmp_path):
+        from analytics_zoo_tpu.zouwu.regression import TimeSequencePredictor
+        with pytest.raises(RuntimeError, match="fit first"):
+            TimeSequencePredictor(logs_dir=str(tmp_path)).predict(sine_df(40))
+
+    def test_search_alg_override_does_not_mutate_recipe(self, tmp_path):
+        from analytics_zoo_tpu.zouwu.regression import TimeSequencePredictor
+        recipe = SmokeRecipe()
+        tsp = TimeSequencePredictor(logs_dir=str(tmp_path),
+                                    search_alg="bayes")
+        tsp.fit(sine_df(120), recipe=recipe)
+        assert recipe.search_alg is None  # caller's object untouched
