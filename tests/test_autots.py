@@ -166,3 +166,18 @@ class TestTimeSequencePredictor:
                                     search_alg="bayes")
         tsp.fit(sine_df(120), recipe=recipe)
         assert recipe.search_alg is None  # caller's object untouched
+
+
+def test_time_sequence_pipeline_alias(tmp_path, orca_ctx):
+    """(ref zouwu/pipeline/time_sequence.py:27,211 import-path parity)"""
+    from analytics_zoo_tpu.zouwu.pipeline import (TimeSequencePipeline,
+                                                  load_ts_pipeline)
+    assert TimeSequencePipeline is TSPipeline
+    trainer = AutoTSTrainer(horizon=1, logs_dir=str(tmp_path))
+    df = sine_df(120)
+    ts = trainer.fit(df.iloc[:100], df.iloc[90:], recipe=SmokeRecipe())
+    ts.save(str(tmp_path / "p"))
+    restored = load_ts_pipeline(str(tmp_path / "p"))
+    np.testing.assert_allclose(ts.predict(df.iloc[90:]),
+                               restored.predict(df.iloc[90:]),
+                               rtol=1e-5, atol=1e-5)
