@@ -24,6 +24,10 @@ Top-level subpackages (mirroring the reference layer map, SURVEY.md §1):
 - ``automl``   — hyperparameter search (ref: pyzoo/zoo/automl/)
 - ``zouwu``    — time series: forecasters, AutoTS, anomaly (ref: pyzoo/zoo/zouwu/)
 - ``friesian`` — recsys tabular feature engineering (ref: pyzoo/zoo/friesian/)
+- ``feature``  — image (2D/3D) + text pipelines incl. QA relations (ref:
+  pyzoo/zoo/feature/)
+- ``text``     — BERT encoder + task estimators (ref: pyzoo/zoo/tfpark/text/)
+- ``nnframes`` — ML-pipeline stages over DataFrames (ref: pyzoo/zoo/pipeline/nnframes/)
 - ``serving``  — streaming + batch inference serving (ref: zoo serving/)
 """
 
