@@ -301,3 +301,35 @@ def test_separable_convolution2d_alias():
     from analytics_zoo_tpu.keras.layers import (SeparableConv2D,
                                                 SeparableConvolution2D)
     assert SeparableConvolution2D is SeparableConv2D
+
+
+def test_diverse_layer_save_load_roundtrip(orca_ctx, tmp_path):
+    """Serialization round-trip across one of each major layer family
+    (ref per-layer serialization Specs, SURVEY §4): conv, norm, pooling,
+    separable conv, noise-free dropout, flatten, dense, activations."""
+    from analytics_zoo_tpu.keras.models import KerasNet
+
+    m = Sequential()
+    m.add(zl.Convolution2D(6, 3, 3, border_mode="same",
+                           input_shape=(12, 12, 3)))
+    m.add(zl.BatchNormalization())
+    m.add(zl.Activation("relu"))
+    m.add(zl.SeparableConvolution2D(8, 3, 3, depth_multiplier=2))
+    m.add(zl.MaxPooling2D())
+    m.add(zl.Dropout(0.2))
+    m.add(zl.Flatten())
+    m.add(zl.Dense(16, activation="tanh"))
+    m.add(zl.Highway())
+    m.add(zl.Dense(4, activation="softmax"))
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    rng = np.random.RandomState(1)
+    x = rng.rand(32, 12, 12, 3).astype(np.float32)
+    y = rng.randint(0, 4, 32).astype(np.int32)
+    m.fit(x, y, batch_size=16, nb_epoch=1)
+    want = np.asarray(m.predict(x[:8]))
+
+    p = str(tmp_path / "diverse")
+    m.save(p)
+    loaded = KerasNet.load(p)
+    got = np.asarray(loaded.predict(x[:8]))
+    np.testing.assert_allclose(got, want, atol=1e-5)
