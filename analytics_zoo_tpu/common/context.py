@@ -208,15 +208,23 @@ def init_orca_context(cluster_mode: str = "local",
     import jax
 
     if cluster_mode in ("multihost", "tpu_pod"):
-        if not coordinator_address:
-            raise ValueError(
-                f"cluster_mode={cluster_mode!r} requires coordinator_address "
-                "(host:port of process 0) — otherwise each host would train "
-                "an independent model")
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id)
+        if coordinator_address:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id)
+        else:
+            # On real TPU pods (and other auto-discoverable clusters) JAX
+            # infers the coordinator from the environment; elsewhere this
+            # fails — surface what the caller must provide.
+            try:
+                jax.distributed.initialize()
+            except Exception as e:
+                raise ValueError(
+                    f"cluster_mode={cluster_mode!r}: coordinator "
+                    "auto-discovery failed — outside a TPU pod / managed "
+                    "cluster pass coordinator_address='host0:port', "
+                    f"num_processes and process_id explicitly ({e})") from e
     elif cluster_mode != "local":
         # Accept the reference's mode names so ported scripts still run
         # single-process (ref nncontext.py dispatches yarn/k8s/standalone).

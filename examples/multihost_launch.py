@@ -1,0 +1,197 @@
+"""Multi-host data-parallel training — real multi-process launch.
+
+The reference's core deployment story is multi-node training launched by
+``init_spark_on_yarn`` / ``init_spark_on_k8s`` (ref
+pyzoo/zoo/common/nncontext.py:56,199) or by spawning MPI worker processes
+(ref pyzoo/zoo/orca/learn/mpi/mpi_estimator.py:28).  The TPU-native analog:
+every host of a TPU pod runs the SAME program; ``jax.distributed.initialize``
+(wrapped by ``init_orca_context(cluster_mode="multihost")``) connects the
+processes through the coordinator, and the mesh then spans all hosts'
+devices — collectives ride ICI within a slice and DCN across slices.
+
+Yarn/k8s → TPU pod launch mapping:
+
+    reference (Spark)                      this framework (TPU pod)
+    -------------------------------------  ---------------------------------
+    init_spark_on_yarn(num_executors=N)    gcloud compute tpus tpu-vm ssh
+                                             $TPU --worker=all -- \
+                                             python train.py   (one process
+                                             per host; JAX infers the
+                                             coordinator on real TPU pods,
+                                             so no flags needed)
+    init_spark_on_k8s(...)                 GKE/XPK: one pod per host running
+                                             the same image+command
+    MPIEstimator(hosts=[...])              init_orca_context(
+                                             cluster_mode="multihost",
+                                             coordinator_address=host0:port,
+                                             num_processes=N, process_id=i)
+    spark barrier + JVMGuard cleanup       the coordinator detects dead
+                                             processes; elastic retry in
+                                             JaxEstimator.fit resumes from
+                                             the latest snapshot
+
+This script demonstrates the flow WITHOUT a pod: launcher mode (default)
+spawns ``--num-processes`` local worker processes of this same file, each
+with 4 virtual CPU devices, so the full cross-process path — gloo
+collectives, ``jax.make_array_from_process_local_data``, per-process batch
+slicing in ``ShardedDataset`` — executes for real.
+
+    python examples/multihost_launch.py                # launcher
+    python examples/multihost_launch.py --process-id 0 --num-processes 2 \
+        --coordinator 127.0.0.1:9911                   # one worker (manual)
+
+Each worker feeds ONLY its own shard of the data; per global step the
+processes together consume one global batch (``batch_size`` is global —
+``ShardedDataset.iter_batches`` cuts per-host batches of
+``batch_size // process_count``, mirroring the reference's per-core batch
+slicing contract at pyzoo/zoo/tfpark/tf_dataset.py:117).
+"""
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+
+N_LOCAL_DEVICES = 4  # virtual CPU devices per worker process
+
+
+def make_data(n=256, d=8, seed=7):
+    """Deterministic synthetic regression problem (same on every host)."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype("float32")
+    w = rng.normal(size=(d, 1)).astype("float32")
+    y = x @ w + 0.1 * rng.normal(size=(n, 1)).astype("float32")
+    return x, y
+
+
+def local_rows(n, global_batch, process_id, num_processes):
+    """Row indices this process owns: for every global batch ``k`` process
+    ``p`` holds rows ``[k*B + p*h, k*B + (p+1)*h)`` (h = B/num_processes) —
+    so with shuffle=False the union of all processes' k-th local batches is
+    exactly the single-process k-th global batch."""
+    import numpy as np
+    assert global_batch % num_processes == 0, \
+        f"batch_size {global_batch} must divide over {num_processes} processes"
+    h = global_batch // num_processes
+    n_full = (n // global_batch) * global_batch
+    return np.arange(n_full).reshape(-1, num_processes, h)[:, process_id, :].ravel()
+
+
+def build_estimator(d):
+    """Tiny MLP regressor — shared by the workers and the single-process
+    reference in tests/test_multihost.py so both train the identical model."""
+    import jax.numpy as jnp
+    import numpy as np
+    from analytics_zoo_tpu.learn.estimator import Estimator
+
+    rng = np.random.default_rng(0)
+    params = {"w1": rng.normal(size=(d, 16)).astype("float32") * 0.3,
+              "b1": np.zeros(16, "float32"),
+              "w2": rng.normal(size=(16, 1)).astype("float32") * 0.3,
+              "b2": np.zeros(1, "float32")}
+
+    def apply_fn(p, x):
+        h = jnp.tanh(x @ p["w1"] + p["b1"])
+        return h @ p["w2"] + p["b2"]
+
+    return Estimator.from_fn(apply_fn=apply_fn, params=params, loss="mse",
+                             optimizer="sgd")
+
+
+def run_worker(process_id, num_processes, coordinator, epochs, batch_size):
+    # The virtual-device flag must be set before the XLA CPU backend
+    # initialises (replace, don't append — the parent env may force 8).
+    os.environ["XLA_FLAGS"] = \
+        f"--xla_force_host_platform_device_count={N_LOCAL_DEVICES}"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import analytics_zoo_tpu as zoo
+    ctx = zoo.init_orca_context(
+        cluster_mode="multihost", coordinator_address=coordinator,
+        num_processes=num_processes, process_id=process_id)
+    assert jax.process_count() == num_processes
+    assert len(jax.local_devices()) == N_LOCAL_DEVICES
+
+    x, y = make_data()
+    rows = local_rows(len(x), batch_size, process_id, num_processes)
+    x_local, y_local = x[rows], y[rows]
+
+    est = build_estimator(x.shape[1])
+    history = est.fit((x_local, y_local), epochs=epochs,
+                      batch_size=batch_size, shuffle=False)
+    ev = est.evaluate((x_local, y_local), batch_size=batch_size)
+
+    # Global loss is replicated across processes — every worker sees the
+    # same numbers; process 0 reports.
+    if process_id == 0:
+        print("MULTIHOST_RESULT " + json.dumps(
+            {"process_count": jax.process_count(),
+             "global_devices": len(jax.devices()),
+             "loss": [float(v) for v in history["loss"]],
+             "eval_loss": float(ev["loss"])}), flush=True)
+    return 0
+
+
+def run_launcher(num_processes, epochs, batch_size):
+    with socket.socket() as s:  # grab a free port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = f"127.0.0.1:{port}"
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = \
+        f"--xla_force_host_platform_device_count={N_LOCAL_DEVICES}"
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--process-id", str(i), "--num-processes", str(num_processes),
+         "--coordinator", coordinator, "--epochs", str(epochs),
+         "--batch-size", str(batch_size)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(num_processes)]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=600)[0])
+    except subprocess.TimeoutExpired:
+        # One worker hung (e.g. a peer died at the init barrier): kill the
+        # rest so nothing is orphaned, and keep whatever output we have.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        while len(outs) < len(procs):
+            outs.append(procs[len(outs)].communicate()[0] or "")
+    ok = all(p.returncode == 0 for p in procs)
+    for i, out in enumerate(outs):
+        tag = "ok" if procs[i].returncode == 0 else f"rc={procs[i].returncode}"
+        print(f"--- worker {i} ({tag}) ---")
+        print("\n".join(out.splitlines()[-6:]))
+    if not ok:
+        return 1
+    result = next(line for out in outs for line in out.splitlines()
+                  if line.startswith("MULTIHOST_RESULT "))
+    print(result)
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--process-id", type=int, default=None)
+    ap.add_argument("--num-processes", type=int, default=2)
+    ap.add_argument("--coordinator", default=None)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=32)
+    args = ap.parse_args(argv)
+    if args.process_id is None:
+        return run_launcher(args.num_processes, args.epochs, args.batch_size)
+    return run_worker(args.process_id, args.num_processes, args.coordinator,
+                      args.epochs, args.batch_size)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

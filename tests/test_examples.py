@@ -18,7 +18,7 @@ ALL = ["recommendation_ncf.py", "anomaly_detection.py",
        "sentiment_analysis.py", "vae.py", "fraud_detection.py",
        "image_similarity.py", "wide_and_deep.py", "object_detection.py",
        "image_augmentation.py", "model_inference.py",
-       "automl_hp_search.py", "qa_ranker.py"]
+       "automl_hp_search.py", "qa_ranker.py", "multihost_launch.py"]
 
 
 @pytest.mark.parametrize("script", ALL)
@@ -31,7 +31,8 @@ def test_example_runs(script):
     launcher = (
         "import jax, runpy, sys; "
         "jax.config.update('jax_platforms', 'cpu'); "
-        "runpy.run_path(sys.argv[1], run_name='__main__')")
+        "sys.argv = [sys.argv[1]]; "  # argparse-using examples see no args
+        "runpy.run_path(sys.argv[0], run_name='__main__')")
     proc = subprocess.run(
         [sys.executable, "-c", launcher, os.path.join(EXAMPLES, script)],
         capture_output=True, text=True, timeout=900, env=env)
