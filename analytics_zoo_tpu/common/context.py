@@ -216,9 +216,11 @@ def init_orca_context(cluster_mode: str = "local",
         else:
             # On real TPU pods (and other auto-discoverable clusters) JAX
             # infers the coordinator from the environment; elsewhere this
-            # fails — surface what the caller must provide.
+            # fails — surface what the caller must provide. Explicit
+            # num_processes/process_id still win over auto-detection.
             try:
-                jax.distributed.initialize()
+                jax.distributed.initialize(num_processes=num_processes,
+                                           process_id=process_id)
             except Exception as e:
                 raise ValueError(
                     f"cluster_mode={cluster_mode!r}: coordinator "

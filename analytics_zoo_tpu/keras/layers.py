@@ -29,6 +29,25 @@ class KerasLayer(_KerasLayerBase):
     def __init__(self, name=None, input_shape=None):
         super().__init__(name)
         self.input_shape = tuple(input_shape) if input_shape is not None else None
+        # flax param-collection key ("kernel"/"bias") → Regularizer; the
+        # model assembles these into one penalty added to the training loss
+        # (ref BigDL wRegularizer/bRegularizer on every layer)
+        self.param_regularizers = {}
+
+    def _set_regularizers(self, W_regularizer=None, b_regularizer=None):
+        from analytics_zoo_tpu.keras import regularizers as reg_lib
+        if W_regularizer is not None:
+            self.param_regularizers["kernel"] = reg_lib.get(W_regularizer)
+        if b_regularizer is not None:
+            self.param_regularizers["bias"] = reg_lib.get(b_regularizer)
+
+    def penalty(self, lparams):
+        """Regularization penalty for this layer's parameter subtree."""
+        total = 0.0
+        for key, reg in self.param_regularizers.items():
+            if key in lparams:
+                total += reg(lparams[key])
+        return total
 
 # ---------------- activations ----------------
 
@@ -39,6 +58,11 @@ _ACTIVATIONS = {
     "elu": nn.elu, "selu": nn.selu, "swish": nn.swish, "silu": nn.silu,
     "leaky_relu": nn.leaky_relu, "relu6": lambda x: jnp.clip(x, 0, 6),
     "hard_sigmoid": lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
+    # the keras2 Activation docstring's extra spellings
+    # (ref keras2/layers/core.py:73)
+    "tanh_shrink": lambda x: x - jnp.tanh(x),
+    "softmin": lambda x: nn.softmax(-x),
+    "log_sigmoid": nn.log_sigmoid,
     "linear": lambda x: x, "identity": lambda x: x, None: lambda x: x,
 }
 
@@ -88,6 +112,7 @@ class Dense(KerasLayer):
         self.activation = get_activation(activation)
         self.init = get_init(init)
         self.bias = bias
+        self._set_regularizers(W_regularizer, b_regularizer)
 
     def make_module(self):
         return nn.Dense(self.output_dim, use_bias=self.bias,
@@ -351,6 +376,7 @@ class Conv1D(KerasLayer):
     def __init__(self, nb_filter: int, filter_length: int, activation=None,
                  border_mode: str = "valid", subsample_length: int = 1,
                  init="glorot_uniform", bias: bool = True, dilation_rate: int = 1,
+                 W_regularizer=None, b_regularizer=None,
                  input_shape=None, name=None):
         super().__init__(name, input_shape)
         self.nb_filter, self.filter_length = nb_filter, filter_length
@@ -358,6 +384,7 @@ class Conv1D(KerasLayer):
         self.padding = border_mode.upper()
         self.stride = subsample_length
         self.init = get_init(init)
+        self._set_regularizers(W_regularizer, b_regularizer)
         self.bias = bias
         self.dilation = dilation_rate
 
@@ -380,6 +407,7 @@ class Conv2D(KerasLayer):
     def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
                  activation=None, border_mode: str = "valid",
                  subsample=(1, 1), init="glorot_uniform", bias: bool = True,
+                 W_regularizer=None, b_regularizer=None,
                  input_shape=None, name=None):
         super().__init__(name, input_shape)
         self.nb_filter = nb_filter
@@ -387,6 +415,7 @@ class Conv2D(KerasLayer):
         self.activation = get_activation(activation)
         self.padding = border_mode.upper()
         self.strides = _pair(subsample)
+        self._set_regularizers(W_regularizer, b_regularizer)
         self.init = get_init(init)
         self.bias = bias
 
@@ -1342,12 +1371,14 @@ class LocallyConnected1D(KerasLayer):
 
     def __init__(self, nb_filter: int, filter_length: int, activation=None,
                  subsample_length: int = 1, bias: bool = True,
+                 W_regularizer=None, b_regularizer=None,
                  input_shape=None, name=None):
         super().__init__(name, input_shape)
         self.nb_filter, self.k = nb_filter, filter_length
         self.activation = get_activation(activation)
         self.stride = subsample_length
         self.bias = bias
+        self._set_regularizers(W_regularizer, b_regularizer)
 
     def make_module(self):
         f, k, stride, use_bias = (self.nb_filter, self.k, self.stride,

@@ -186,21 +186,51 @@ class KerasNet:
                     "call compile(optimizer, loss) before fit/evaluate"
                 args = dict(optimizer="adam", loss="mse", metrics=None)
             from analytics_zoo_tpu.learn.estimator import Estimator
+            module = self.to_flax()  # canonicalizes layer names first
             self._estimator = Estimator.from_flax(
-                model=self.to_flax(),
+                model=module,
                 loss=args["loss"],
                 optimizer=args["optimizer"],
                 metrics=args["metrics"],
                 sample_input=self.sample_input(),
                 model_dir=self.model_dir,
                 strategy=self._strategy,
-                param_rules=self._param_rules)
+                param_rules=self._param_rules,
+                param_penalty=self._param_penalty_fn(module.order))
             reuse = getattr(self, "_reuse_adapter", None)
             if reuse is not None:
                 self._estimator.adapter.params = reuse.params
                 self._estimator.adapter.model_state = reuse.model_state
                 self._reuse_adapter = None
         return self._estimator
+
+    def _param_penalty_fn(self, order):
+        """Assemble the layers' W/b regularizers into one pure
+        ``params → scalar`` penalty for the train step (ref BigDL applies
+        w/bRegularizer inside the optimizer; here XLA fuses the penalty
+        into the backward pass). ``order`` is the already-computed,
+        name-canonicalized topo order from ``to_flax``. Returns None when
+        no layer regularizes."""
+        regs, seen = [], set()
+        for node in order:
+            layer = node.layer
+            if layer is None or id(layer) in seen:
+                continue
+            seen.add(id(layer))
+            if getattr(layer, "param_regularizers", None):
+                regs.append(layer)
+        if not regs:
+            return None
+        pairs = [(layer.name, layer) for layer in regs]
+
+        def penalty(params):
+            total = 0.0
+            for name, layer in pairs:
+                if name in params:
+                    total += layer.penalty(params[name])
+            return total
+
+        return penalty
 
     @property
     def estimator(self):

@@ -1,0 +1,63 @@
+"""Weight regularizers — L1/L2 penalties added to the training objective.
+
+The reference threads BigDL ``L1L2Regularizer`` objects through every layer's
+``wRegularizer``/``bRegularizer`` argument and applies them inside the
+optimizer (keras-1 API layers, e.g.
+ref pyzoo/zoo/pipeline/api/keras/layers/core.py Dense(W_regularizer=...);
+keras-2 spellings take ``kernel_regularizer``/``bias_regularizer``,
+ref pyzoo/zoo/pipeline/api/keras2/layers/core.py:26). Here the penalty is a
+pure function of the parameter pytree added to the loss inside the jitted
+train step — XLA fuses it with the backward pass, so it costs one extra
+elementwise reduction, not a separate optimizer pass.
+"""
+
+from __future__ import annotations
+
+
+class Regularizer:
+    """l1·Σ|w| + l2·Σw² (Keras semantics: coefficients multiply the sums)."""
+
+    def __init__(self, l1: float = 0.0, l2: float = 0.0):
+        self.l1 = float(l1)
+        self.l2 = float(l2)
+
+    def __call__(self, w):
+        import jax.numpy as jnp
+        total = 0.0
+        if self.l1:
+            total += self.l1 * jnp.sum(jnp.abs(w))
+        if self.l2:
+            total += self.l2 * jnp.sum(jnp.square(w))
+        return total
+
+    def __repr__(self):
+        return f"Regularizer(l1={self.l1}, l2={self.l2})"
+
+
+# BigDL spelling (ref com.intel.analytics.bigdl.optim.L1L2Regularizer)
+L1L2Regularizer = Regularizer
+L1L2 = Regularizer
+
+
+def l1(l: float = 0.01) -> Regularizer:
+    return Regularizer(l1=l)
+
+
+def l2(l: float = 0.01) -> Regularizer:
+    return Regularizer(l2=l)
+
+
+def l1_l2(l1: float = 0.01, l2: float = 0.01) -> Regularizer:
+    return Regularizer(l1=l1, l2=l2)
+
+
+def get(spec):
+    """None | Regularizer | 'l1' | 'l2' | 'l1_l2' → Regularizer or None."""
+    if spec is None or isinstance(spec, Regularizer):
+        return spec
+    if callable(spec):
+        return spec
+    table = {"l1": l1, "l2": l2, "l1_l2": l1_l2, "l1l2": l1_l2}
+    if isinstance(spec, str) and spec.lower() in table:
+        return table[spec.lower()]()
+    raise ValueError(f"unknown regularizer {spec!r}")

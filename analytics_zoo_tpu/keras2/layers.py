@@ -1,13 +1,23 @@
-"""Keras-2-style layer spellings.
+"""Keras-2-style layer spellings — the COMPLETE reference keras2 surface.
 
-Rebuild of the reference's keras2 subset
-(ref ``pyzoo/zoo/pipeline/api/keras2/layers/`` — 16 classes exposing the
-Keras-2 argument names: ``units``, ``filters``, ``kernel_size``,
-``strides``, ``padding``, ``rate``, ``pool_size`` — over the same
-execution engine as the keras-1 API). Each class here adapts those
-signatures onto the corresponding ``analytics_zoo_tpu.keras.layers``
+The reference's keras2 package (ref ``pyzoo/zoo/pipeline/api/keras2/``)
+defines exactly 17 classes + 3 functional helpers across five modules —
+core.py (Dense, Activation, Dropout, Flatten), convolutional.py (Conv1D,
+Conv2D, Cropping1D), pooling.py (MaxPooling1D, AveragePooling1D,
+GlobalAveragePooling1D, GlobalMaxPooling1D, GlobalAveragePooling2D),
+merge.py (Maximum/maximum, Minimum/minimum, Average/average) and local.py
+(LocallyConnected1D). Its other eight modules (advanced_activations,
+convolutional_recurrent, embeddings, noise, normalization, recurrent,
+wrappers, engine/topology, engine/training) are license-header-only stubs
+with no classes — there is nothing there to port.
+
+Every class here adapts the Keras-2 argument names (``units``,
+``filters``, ``kernel_size``, ``strides``, ``padding``, ``rate``,
+``pool_size``, ``kernel_regularizer``/``bias_regularizer``,
+``input_dim``) onto the corresponding ``analytics_zoo_tpu.keras.layers``
 implementation, so keras-2-flavored user code runs unchanged on the same
-fused GraphModule.
+fused GraphModule; regularizers feed the train-step penalty
+(``keras/regularizers.py``).
 """
 
 from __future__ import annotations
@@ -32,24 +42,33 @@ def _single(v):
 
 
 class Dense(k1.Dense):
-    """keras2: Dense(units, activation=..., use_bias=...)."""
+    """keras2: Dense(units, activation=..., use_bias=...)
+    (ref keras2/layers/core.py:26 — incl. kernel/bias regularizers and the
+    ``input_dim`` shorthand for a 2D first layer)."""
 
     def __init__(self, units: int, activation=None,
                  kernel_initializer="glorot_uniform", use_bias: bool = True,
-                 input_shape=None, name=None, **kw):
+                 kernel_regularizer=None, bias_regularizer=None,
+                 input_dim=None, input_shape=None, name=None, **kw):
+        if input_dim:
+            input_shape = (input_dim,)
         super().__init__(units, activation=activation,
                          init=kernel_initializer, bias=use_bias,
+                         W_regularizer=kernel_regularizer,
+                         b_regularizer=bias_regularizer,
                          input_shape=input_shape, name=name)
 
 
 class Conv1D(k1.Conv1D):
-    """keras2: Conv1D(filters, kernel_size, strides=1, padding='valid')."""
+    """keras2: Conv1D(filters, kernel_size, strides=1, padding='valid')
+    (ref keras2/layers/convolutional.py:24)."""
 
     def __init__(self, filters: int, kernel_size: Union[int, Sequence[int]],
                  strides: Union[int, Sequence[int]] = 1,
                  padding: str = "valid", activation=None,
                  dilation_rate: Union[int, Sequence[int]] = 1,
                  use_bias: bool = True,
+                 kernel_regularizer=None, bias_regularizer=None,
                  kernel_initializer="glorot_uniform", input_shape=None,
                  name=None, **kw):
         super().__init__(filters, _single(kernel_size),
@@ -57,15 +76,19 @@ class Conv1D(k1.Conv1D):
                          subsample_length=_single(strides),
                          init=kernel_initializer, bias=use_bias,
                          dilation_rate=_single(dilation_rate),
+                         W_regularizer=kernel_regularizer,
+                         b_regularizer=bias_regularizer,
                          input_shape=input_shape, name=name)
 
 
 class Conv2D(k1.Conv2D):
-    """keras2: Conv2D(filters, kernel_size, ...)."""
+    """keras2: Conv2D(filters, kernel_size, ...)
+    (ref keras2/layers/convolutional.py:100)."""
 
     def __init__(self, filters: int, kernel_size, strides=(1, 1),
                  padding: str = "valid", activation=None,
                  use_bias: bool = True,
+                 kernel_regularizer=None, bias_regularizer=None,
                  kernel_initializer="glorot_uniform", input_shape=None,
                  name=None, **kw):
         ks = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
@@ -73,6 +96,8 @@ class Conv2D(k1.Conv2D):
         super().__init__(filters, ks[0], ks[1], activation=activation,
                          border_mode=padding, subsample=strides,
                          init=kernel_initializer, bias=use_bias,
+                         W_regularizer=kernel_regularizer,
+                         b_regularizer=bias_regularizer,
                          input_shape=input_shape, name=name)
 
 
@@ -97,14 +122,22 @@ class AveragePooling1D(k1.AveragePooling1D):
 
 
 class LocallyConnected1D(k1.LocallyConnected1D):
-    """keras2: LocallyConnected1D(filters, kernel_size, strides=1)."""
+    """keras2: LocallyConnected1D(filters, kernel_size, strides=1)
+    (ref keras2/layers/local.py:23 — padding='valid' only, as there)."""
 
     def __init__(self, filters: int, kernel_size, strides=1,
-                 activation=None, use_bias: bool = True, input_shape=None,
+                 padding: str = "valid", activation=None,
+                 kernel_regularizer=None, bias_regularizer=None,
+                 use_bias: bool = True, input_shape=None,
                  name=None, **kw):
+        if padding != "valid":
+            raise ValueError("For LocallyConnected1D, only padding='valid' "
+                             "is supported for now")
         super().__init__(filters, _single(kernel_size),
                          activation=activation,
                          subsample_length=_single(strides), bias=use_bias,
+                         W_regularizer=kernel_regularizer,
+                         b_regularizer=bias_regularizer,
                          input_shape=input_shape, name=name)
 
 
@@ -126,3 +159,17 @@ class Maximum(_MergeN):
 
 class Minimum(_MergeN):
     mode = "min"
+
+
+# functional merge interfaces (ref keras2/layers/merge.py:44,82,121)
+def maximum(inputs, **kwargs):
+    """Element-wise maximum of a list of input nodes."""
+    return Maximum(**kwargs)(inputs)
+
+
+def minimum(inputs, **kwargs):
+    return Minimum(**kwargs)(inputs)
+
+
+def average(inputs, **kwargs):
+    return Average(**kwargs)(inputs)

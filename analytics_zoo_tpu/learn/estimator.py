@@ -91,7 +91,9 @@ class FlaxModelAdapter:
         if params is None:
             variables = self._init(rng, sample_input)
             variables = dict(variables)
-            params = variables.pop("params")
+            # a parameterless graph (e.g. a pure merge model) has no
+            # "params" collection at all
+            params = variables.pop("params", {})
             # "aux_loss" is a per-step sown output (e.g. MoE load-balance
             # loss), not persistent state — it is consumed by the train step
             # and must not ride model_state across steps (sow appends, so
@@ -168,7 +170,7 @@ class Estimator:
     def from_flax(*, model, loss, optimizer="adam", metrics=None,
                   sample_input, model_dir: Optional[str] = None,
                   strategy="dp", param_rules=None, seed: int = 0,
-                  aux_loss_weight: float = 0.01,
+                  aux_loss_weight: float = 0.01, param_penalty=None,
                   backend: str = "tpu") -> "JaxEstimator":
         """Build an estimator from a flax.linen module.
 
@@ -183,7 +185,8 @@ class Estimator:
         return JaxEstimator(adapter, loss=loss, optimizer=optimizer,
                             metrics=metrics, model_dir=model_dir,
                             strategy=strategy, param_rules=param_rules,
-                            seed=seed, aux_loss_weight=aux_loss_weight)
+                            seed=seed, aux_loss_weight=aux_loss_weight,
+                            param_penalty=param_penalty)
 
     @staticmethod
     def from_torch(*, model, loss, optimizer="adam", metrics=None,
@@ -282,10 +285,14 @@ class JaxEstimator:
     def __init__(self, adapter: FlaxModelAdapter, loss, optimizer,
                  metrics=None, model_dir: Optional[str] = None,
                  strategy="dp", param_rules=None, seed: int = 0,
-                 aux_loss_weight: float = 0.01):
+                 aux_loss_weight: float = 0.01, param_penalty=None):
         import jax
 
         self.adapter = adapter
+        # optional pure params→scalar regularization penalty added to the
+        # training objective (keras W/b regularizers; ref BigDL applies
+        # these inside the optimizer)
+        self.param_penalty = param_penalty
         self.loss_fn = loss_lib.get(loss)
         self.optimizer = Optimizer.get(optimizer)
         self.metrics = [metric_lib.get(m) for m in (metrics or [])]
@@ -480,6 +487,7 @@ class JaxEstimator:
         tx = self._tx()
         adapter, loss_fn, base_rng = self.adapter, self.loss_fn, self._base_rng
         aux_weight = self.aux_loss_weight
+        penalty_fn = self.param_penalty
 
         def step_fn(state, x, y):
             rng = jax.random.fold_in(base_rng, state["step"])
@@ -489,6 +497,8 @@ class JaxEstimator:
                                                x, True, rng)
                 per = loss_fn(y, preds)
                 loss = per.mean()
+                if penalty_fn is not None:
+                    loss = loss + penalty_fn(params)
                 # consume sown per-step losses (MoE load balance): they add
                 # to the objective and are stripped so model_state keeps its
                 # across-step structure
