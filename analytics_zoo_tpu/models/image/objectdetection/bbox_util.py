@@ -18,19 +18,42 @@ import numpy as np
 VARIANCES = (0.1, 0.1, 0.2, 0.2)
 
 
+def per_layer_ratios(aspect_ratios, n_layers: int):
+    """Normalize ``aspect_ratios`` to one plain-float ratio tuple per
+    feature map: a flat sequence applies to every layer; a sequence of
+    sequences (or a 2-D array) is per-layer (ref: the SSD model configs
+    give each prior-box layer its own ratio set — BboxUtil/PriorBox
+    per-layer minSizes/maxSizes/ratios)."""
+    items = list(aspect_ratios)
+    nested = len(items) > 0 and isinstance(items[0],
+                                           (list, tuple, np.ndarray))
+    if nested:
+        if len(items) != n_layers:
+            raise ValueError(
+                f"per-layer aspect_ratios needs {n_layers} entries, "
+                f"got {len(items)}")
+        return [tuple(float(r) for r in rs) for rs in items]
+    return [tuple(float(r) for r in items)] * n_layers
+
+
+_per_layer_ratios = per_layer_ratios
+
+
 def generate_anchors(feature_map_sizes: Sequence[int],
                      scales: Sequence[float],
-                     aspect_ratios: Sequence[float] = (1.0, 2.0, 0.5)
-                     ) -> np.ndarray:
+                     aspect_ratios=(1.0, 2.0, 0.5)) -> np.ndarray:
     """[A, 4] anchors over square feature maps.
 
     Per cell: one anchor per aspect ratio at ``scales[k]`` plus the extra
     sqrt(s_k * s_{k+1}) ratio-1 anchor (standard SSD; ref
-    ``PriorBox``/``BboxUtil`` prior generation).
+    ``PriorBox``/``BboxUtil`` prior generation). ``aspect_ratios`` may be
+    flat (same ratios every scale) or per-layer (list of lists, like the
+    reference's per-prior-box-layer configs).
     """
     if len(scales) < len(feature_map_sizes) + 1:
         raise ValueError("need len(scales) == len(feature_map_sizes) + 1 "
                          "(the extra scale feeds the sqrt anchor)")
+    ratios = per_layer_ratios(aspect_ratios, len(feature_map_sizes))
     boxes: List[np.ndarray] = []
     for k, fm in enumerate(feature_map_sizes):
         s = scales[k]
@@ -38,7 +61,7 @@ def generate_anchors(feature_map_sizes: Sequence[int],
         centers = (np.arange(fm, dtype=np.float32) + 0.5) / fm
         cx, cy = np.meshgrid(centers, centers)           # [fm, fm]
         cx, cy = cx.reshape(-1), cy.reshape(-1)
-        whs = [(s * np.sqrt(r), s / np.sqrt(r)) for r in aspect_ratios]
+        whs = [(s * np.sqrt(r), s / np.sqrt(r)) for r in ratios[k]]
         whs.append((s_prime, s_prime))
         # cell-major layout (index = cell*A + a) to match the head reshape
         # [b, H, W, A*4] → [b, H*W*A, 4] in object_detector._reshape_head
@@ -54,6 +77,47 @@ def generate_anchors(feature_map_sizes: Sequence[int],
 
 def anchors_per_cell(aspect_ratios: Sequence[float] = (1.0, 2.0, 0.5)) -> int:
     return len(aspect_ratios) + 1
+
+
+# Canonical anchor-pyramid presets, mirroring the reference's per-model
+# prior-box configs (ref objectdetection model configs: VGG SSD 300/512
+# minSizes/maxSizes/aspect ratios per layer). "ssd300_vgg" reproduces the
+# classic 8,732-anchor pyramid.
+ANCHOR_CONFIGS = {
+    "ssd300_vgg": dict(
+        feature_map_sizes=[38, 19, 10, 5, 3, 1],
+        scales=[0.1, 0.2, 0.375, 0.55, 0.725, 0.9, 1.075],
+        aspect_ratios=[(1.0, 2.0, 0.5),
+                       (1.0, 2.0, 0.5, 3.0, 1.0 / 3.0),
+                       (1.0, 2.0, 0.5, 3.0, 1.0 / 3.0),
+                       (1.0, 2.0, 0.5, 3.0, 1.0 / 3.0),
+                       (1.0, 2.0, 0.5),
+                       (1.0, 2.0, 0.5)]),
+    "ssd512_vgg": dict(
+        feature_map_sizes=[64, 32, 16, 8, 4, 2, 1],
+        scales=[0.07, 0.15, 0.30, 0.45, 0.60, 0.75, 0.90, 1.05],
+        aspect_ratios=[(1.0, 2.0, 0.5),
+                       (1.0, 2.0, 0.5, 3.0, 1.0 / 3.0),
+                       (1.0, 2.0, 0.5, 3.0, 1.0 / 3.0),
+                       (1.0, 2.0, 0.5, 3.0, 1.0 / 3.0),
+                       (1.0, 2.0, 0.5, 3.0, 1.0 / 3.0),
+                       (1.0, 2.0, 0.5),
+                       (1.0, 2.0, 0.5)]),
+    "mobilenet_300": dict(
+        feature_map_sizes=[19, 10, 5, 3, 2, 1],
+        scales=[0.2, 0.35, 0.5, 0.65, 0.8, 0.95, 1.1],
+        aspect_ratios=[(1.0, 2.0, 0.5, 3.0, 1.0 / 3.0)] * 6),
+}
+
+
+def anchors_from_config(name: str) -> np.ndarray:
+    """Build the full anchor pyramid for a named preset."""
+    if name not in ANCHOR_CONFIGS:
+        raise ValueError(f"unknown anchor config {name!r}; "
+                         f"have {sorted(ANCHOR_CONFIGS)}")
+    cfg = ANCHOR_CONFIGS[name]
+    return generate_anchors(cfg["feature_map_sizes"], cfg["scales"],
+                            cfg["aspect_ratios"])
 
 
 def _center_size(boxes: np.ndarray) -> np.ndarray:

@@ -38,11 +38,19 @@ class SSDLite(ZooModel):
             raise ValueError("image_size must be a multiple of 32")
         self.class_num = int(class_num)          # object classes (no bg)
         self.image_size = int(image_size)
-        self.aspect_ratios = tuple(float(r) for r in aspect_ratios)
         self.fm_sizes = [image_size // 8, image_size // 16, image_size // 32]
+        # flat (same every scale) or per-layer list of lists (the
+        # reference's per-prior-box-layer ratio configs); normalized once
+        # to plain floats so _config stays JSON-serializable
+        self.ratios_per_layer = bbox_util.per_layer_ratios(
+            aspect_ratios, len(self.fm_sizes))
+        flat_input = not isinstance(
+            list(aspect_ratios)[0], (list, tuple, np.ndarray))
+        self.aspect_ratios = self.ratios_per_layer[0] if flat_input \
+            else [list(r) for r in self.ratios_per_layer]
         self.scales = [0.15, 0.35, 0.6, 0.85]    # len(fm) + 1
         self.anchors = bbox_util.generate_anchors(self.fm_sizes, self.scales,
-                                                  self.aspect_ratios)
+                                                  self.ratios_per_layer)
         self.model = self.build_model()
 
     @property
@@ -50,7 +58,6 @@ class SSDLite(ZooModel):
         return len(self.anchors)
 
     def build_model(self):
-        A = bbox_util.anchors_per_cell(self.aspect_ratios)
         C1 = self.class_num + 1                   # + background
         inp = Input(shape=(self.image_size, self.image_size, 3))
 
@@ -68,7 +75,8 @@ class SSDLite(ZooModel):
         f32 = conv_block(f16, 128, 2)                     # /32
 
         heads: List = []
-        for fm in (f8, f16, f32):
+        for fm, ratios in zip((f8, f16, f32), self.ratios_per_layer):
+            A = bbox_util.anchors_per_cell(ratios)
             loc = zl.Conv2D(A * 4, 3, 3, border_mode="same")(fm)
             conf = zl.Conv2D(A * C1, 3, 3, border_mode="same")(fm)
             loc = zl.Lambda(_reshape_head(4))(loc)        # [b, cells*A, 4]
