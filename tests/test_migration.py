@@ -1,0 +1,154 @@
+"""Pretrained-weight migration recipes (VERDICT r3 missing #5): for each
+model-zoo entry, torch-twin weights load into the zoo model with predict
+parity on a fixture — the honest replacement for the reference's
+``Net.load`` artifact formats (ref Net.scala:446)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from analytics_zoo_tpu.models import (  # noqa: E402
+    NeuralCF, TextClassifier, WideAndDeep,
+)
+from analytics_zoo_tpu.models import migration  # noqa: E402
+from analytics_zoo_tpu.models.recommendation.wide_and_deep import (  # noqa: E402
+    ColumnFeatureInfo,
+)
+
+
+class TestNCFMigration:
+    def test_torch_weights_predict_parity(self, orca_ctx):
+        torch.manual_seed(0)
+        kw = dict(user_count=30, item_count=40, class_num=4, user_embed=6,
+                  item_embed=6, hidden_layers=(16, 8), mf_embed=5)
+        twin = migration.make_torch_ncf(**kw)
+        zoo = NeuralCF(**kw)
+        migration.import_ncf_from_torch(zoo, twin)
+
+        rs = np.random.RandomState(0)
+        x = np.stack([rs.randint(1, 31, 64), rs.randint(1, 41, 64)],
+                     axis=1).astype(np.float32)
+        want = twin(torch.from_numpy(x)).detach().numpy()
+        got = np.asarray(zoo.predict(x, distributed=False))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_no_mf_variant_and_state_dict_input(self, orca_ctx):
+        torch.manual_seed(1)
+        kw = dict(user_count=12, item_count=9, class_num=2, user_embed=4,
+                  item_embed=4, hidden_layers=(8,), include_mf=False,
+                  mf_embed=0)
+        twin = migration.make_torch_ncf(**kw)
+        zoo = NeuralCF(**kw)
+        migration.import_ncf_from_torch(zoo, twin.state_dict())
+        x = np.array([[1, 2], [3, 4], [11, 8]], np.float32)
+        want = twin(torch.from_numpy(x)).detach().numpy()
+        got = np.asarray(zoo.predict(x, distributed=False))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestWideAndDeepMigration:
+    def test_torch_weights_predict_parity(self, orca_ctx):
+        torch.manual_seed(2)
+        info = ColumnFeatureInfo(
+            wide_base_cols=["a", "b"], wide_base_dims=[4, 3],
+            wide_cross_cols=["c"], wide_cross_dims=[6],
+            indicator_cols=["i"], indicator_dims=[3],
+            embed_cols=["e1", "e2"], embed_in_dims=[7, 9],
+            embed_out_dims=[2, 3], continuous_cols=["x", "y"])
+        twin = migration.make_torch_wide_and_deep(2, info,
+                                                  hidden_layers=(12, 6))
+        zoo = WideAndDeep(class_num=2, column_info=info,
+                          hidden_layers=(12, 6))
+        migration.import_wide_and_deep_from_torch(zoo, twin)
+
+        rs = np.random.RandomState(3)
+        b = 32
+        wide = (rs.rand(b, 13) < 0.3).astype(np.float32)
+        ind = (rs.rand(b, 3) < 0.5).astype(np.float32)
+        emb = np.stack([rs.randint(1, 8, b), rs.randint(1, 10, b)],
+                       axis=1).astype(np.float32)
+        con = rs.randn(b, 2).astype(np.float32)
+        want = twin(*[torch.from_numpy(a) for a in (wide, ind, emb, con)]
+                    ).detach().numpy()
+        got = np.asarray(zoo.predict([wide, ind, emb, con],
+                                     distributed=False))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestTextClassifierMigration:
+    def test_torch_weights_predict_parity(self, orca_ctx):
+        torch.manual_seed(4)
+        kw = dict(class_num=3, vocab_size=60, token_length=8,
+                  encoder_output_dim=16)
+        twin = migration.make_torch_text_classifier(**kw)
+        zoo = TextClassifier(sequence_length=20, encoder="cnn", **kw)
+        migration.import_text_classifier_from_torch(zoo, twin)
+        rs = np.random.RandomState(5)
+        ids = rs.randint(1, 61, (10, 20)).astype(np.float32)
+        want = twin(torch.from_numpy(ids)).detach().numpy()
+        got = np.asarray(zoo.predict(ids, distributed=False))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_lstm_encoder_rejected(self, orca_ctx):
+        zoo = TextClassifier(class_num=2, vocab_size=10, token_length=4,
+                             sequence_length=6, encoder="lstm",
+                             encoder_output_dim=4)
+        with pytest.raises(ValueError, match="cnn encoder"):
+            migration.import_text_classifier_from_torch(zoo, {})
+
+
+class TestAssignLayerParams:
+    def test_shape_and_name_validation(self, orca_ctx):
+        zoo = NeuralCF(user_count=5, item_count=5, class_num=2,
+                       user_embed=3, item_embed=3, hidden_layers=(4,),
+                       include_mf=False, mf_embed=0)
+        with pytest.raises(KeyError, match="nope"):
+            migration.assign_layer_params(zoo.model,
+                                          {"nope": {"kernel": np.zeros(1)}})
+        with pytest.raises(ValueError, match="shape"):
+            migration.assign_layer_params(
+                zoo.model, {"dense_1": {"kernel": np.zeros((2, 2))}})
+
+    def test_training_continues_after_import(self, orca_ctx):
+        """Imported weights are a valid starting point for further fit
+        (fine-tune path a migrating user follows)."""
+        torch.manual_seed(6)
+        kw = dict(user_count=15, item_count=15, class_num=2, user_embed=4,
+                  item_embed=4, hidden_layers=(8,), mf_embed=4)
+        twin = migration.make_torch_ncf(**kw)
+        zoo = NeuralCF(**kw)
+        migration.import_ncf_from_torch(zoo, twin)
+        rs = np.random.RandomState(7)
+        x = np.stack([rs.randint(1, 16, 64), rs.randint(1, 16, 64)],
+                     axis=1).astype(np.float32)
+        y = rs.randint(0, 2, 64)
+        zoo.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+        h = zoo.fit(x, y, batch_size=32, nb_epoch=2)
+        assert np.isfinite(h["loss"]).all()
+
+    def test_patch_after_fit_keeps_trained_weights(self, orca_ctx):
+        """assign_layer_params after a fit must sync the TRAINED state
+        first — patching one layer leaves the others' trained values."""
+        import jax
+        torch.manual_seed(8)
+        zoo = NeuralCF(user_count=10, item_count=10, class_num=2,
+                       user_embed=4, item_embed=4, hidden_layers=(8,),
+                       include_mf=False, mf_embed=0)
+        rs = np.random.RandomState(9)
+        x = np.stack([rs.randint(1, 11, 64), rs.randint(1, 11, 64)],
+                     axis=1).astype(np.float32)
+        y = rs.randint(0, 2, 64)
+        zoo.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+        zoo.fit(x, y, batch_size=32, nb_epoch=2)
+        est = zoo.model._ensure_estimator()
+        trained_emb = np.asarray(jax.device_get(
+            est._state["params"]["mlp_user_embed"]["embedding"]))
+        new_head = np.zeros_like(np.asarray(
+            jax.device_get(est._state["params"]["dense_2"]["kernel"])))
+        migration.assign_layer_params(zoo.model,
+                                      {"dense_2": {"kernel": new_head}})
+        params = zoo.model._ensure_estimator().adapter.params
+        np.testing.assert_allclose(params["mlp_user_embed"]["embedding"],
+                                   trained_emb)
+        np.testing.assert_allclose(params["dense_2"]["kernel"], new_head)
