@@ -193,11 +193,55 @@ class BrokerClient:
 
 # ---------------------------------------------------------------- python impl
 class _PyState:
-    def __init__(self):
+    def __init__(self, hash_ttl_ms: int = 600_000):
         self.lock = threading.Lock()
         self.cv = threading.Condition(self.lock)
         self.streams: Dict[str, dict] = {}
         self.hashes: Dict[str, Dict[str, str]] = {}
+        # last-write ms per hash field — uncollected results expire so the
+        # broker's memory stays bounded (native zbroker.cpp does the same;
+        # the reference relied on Redis EXPIRE for this)
+        self.hash_times: Dict[str, Dict[str, float]] = {}
+        self.hash_ttl_ms = int(hash_ttl_ms)
+
+    def evict_expired(self, key: str):
+        """Drop expired fields of one hash key. Caller holds the lock.
+        Monotonic clock: TTL math must not jump with NTP steps."""
+        if self.hash_ttl_ms <= 0:
+            return
+        now_ms = time.monotonic() * 1000
+        times = self.hash_times.get(key)
+        if not times:
+            return
+        h = self.hashes.get(key, {})
+        for field in [f for f, t in times.items()
+                      if now_ms - t >= self.hash_ttl_ms]:
+            times.pop(field, None)
+            h.pop(field, None)
+        if not times:
+            self.hash_times.pop(key, None)
+        if not h:
+            self.hashes.pop(key, None)
+
+    def field_expired(self, key: str, field: str) -> bool:
+        """O(1) single-field expiry check (the HGET hot path must not scan
+        the whole key). Deletes the field when expired. Caller holds the
+        lock."""
+        if self.hash_ttl_ms <= 0:
+            return False
+        t = self.hash_times.get(key, {}).get(field)
+        if t is None or time.monotonic() * 1000 - t < self.hash_ttl_ms:
+            return False
+        self.hash_times.get(key, {}).pop(field, None)
+        self.hashes.get(key, {}).pop(field, None)
+        return True
+
+    def sweep(self):
+        """Evict every key's expired fields (periodic memory bound even
+        when no client touches a key again)."""
+        with self.lock:
+            for key in list(self.hash_times):
+                self.evict_expired(key)
 
     def stream(self, name):
         return self.streams.setdefault(
@@ -248,7 +292,7 @@ class _PyHandler(socketserver.StreamRequestHandler):
                     st = state.stream(stream)
                     gr = state.group(st, group)
                     got = []
-                    now_ms = int(time.time() * 1000)
+                    now_ms = int(time.monotonic() * 1000)
                     for eid, payload in st["entries"]:
                         if eid <= gr["cursor"]:
                             continue
@@ -261,9 +305,9 @@ class _PyHandler(socketserver.StreamRequestHandler):
                 with state.cv:
                     got = deliver()
                     if not got and block_ms > 0:
-                        deadline = time.time() + block_ms / 1000.0
+                        deadline = time.monotonic() + block_ms / 1000.0
                         while not got:
-                            left = deadline - time.time()
+                            left = deadline - time.monotonic()
                             if left <= 0:
                                 break
                             state.cv.wait(left)
@@ -302,7 +346,7 @@ class _PyHandler(socketserver.StreamRequestHandler):
                 with state.lock:
                     st = state.stream(p[1])
                     gr = state.group(st, p[2])
-                    now_ms = int(time.time() * 1000)
+                    now_ms = int(time.monotonic() * 1000)
                     ids = sorted(eid for eid, ts in gr["pending"].items()
                                  if now_ms - ts >= min_idle)[:cnt]
                     payloads = dict(st["entries"])
@@ -321,15 +365,23 @@ class _PyHandler(socketserver.StreamRequestHandler):
                 w.write(f":{n}\n".encode())
             elif cmd == "HSET" and len(p) >= 4:
                 with state.cv:
+                    state.evict_expired(p[1])  # writers pay for cleanup
                     state.hashes.setdefault(p[1], {})[p[2]] = p[3]
+                    if state.hash_ttl_ms > 0:
+                        state.hash_times.setdefault(
+                            p[1], {})[p[2]] = time.monotonic() * 1000
                     state.cv.notify_all()
                 w.write(b"+OK\n")
             elif cmd == "HGET" and len(p) >= 3:
                 with state.lock:
-                    val = state.hashes.get(p[1], {}).get(p[2])
+                    if state.field_expired(p[1], p[2]):
+                        val = None
+                    else:
+                        val = state.hashes.get(p[1], {}).get(p[2])
                 w.write(f"${val}\n".encode() if val is not None else b"$-1\n")
             elif cmd == "HKEYS" and len(p) >= 2:
                 with state.lock:
+                    state.evict_expired(p[1])
                     keys = list(state.hashes.get(p[1], {}).keys())
                 w.write(("".join([f"*{len(keys)}\n"] +
                                  [k + "\n" for k in keys])).encode())
@@ -337,11 +389,13 @@ class _PyHandler(socketserver.StreamRequestHandler):
                 with state.lock:
                     n = 1 if state.hashes.get(p[1], {}).pop(p[2], None) \
                         is not None else 0
+                    state.hash_times.get(p[1], {}).pop(p[2], None)
                 w.write(f":{n}\n".encode())
             elif cmd == "DEL" and len(p) >= 2:
                 with state.lock:
                     state.streams.pop(p[1], None)
                     state.hashes.pop(p[1], None)
+                    state.hash_times.pop(p[1], None)
                 w.write(b"+OK\n")
             else:
                 w.write(b"-ERR unknown command\n")
@@ -399,7 +453,10 @@ class Broker:
         return "native" if self._proc is not None else "python"
 
     @classmethod
-    def launch(cls, port: int = 0, backend: str = "auto") -> "Broker":
+    def launch(cls, port: int = 0, backend: str = "auto",
+               hash_ttl_ms: int = 600_000) -> "Broker":
+        """``hash_ttl_ms``: result-hash fields a client never collects
+        expire after this long, bounding broker memory (0 disables)."""
         if port == 0:
             s = socket.socket()
             s.bind(("127.0.0.1", 0))
@@ -409,7 +466,8 @@ class Broker:
             binary = build_native_broker()
             if binary is not None:
                 proc = subprocess.Popen(
-                    [binary, str(port)], stdout=subprocess.PIPE,
+                    [binary, str(port), str(int(hash_ttl_ms))],
+                    stdout=subprocess.PIPE,
                     stderr=subprocess.DEVNULL, text=True)
                 line = proc.stdout.readline()
                 if line.startswith("READY"):
@@ -418,9 +476,22 @@ class Broker:
             if backend == "native":
                 raise RuntimeError("native broker unavailable")
         server = _PyBrokerServer(("127.0.0.1", port), _PyHandler)
-        server.state = _PyState()  # type: ignore[attr-defined]
+        state = _PyState(hash_ttl_ms)
+        server.state = state  # type: ignore[attr-defined]
         threading.Thread(target=server.serve_forever, daemon=True).start()
-        return cls(port, server=server)
+        broker = cls(port, server=server)
+        if hash_ttl_ms > 0:
+            # periodic sweeper (the native broker's SweeperLoop analog):
+            # abandoned keys expire even if never touched again
+            stop = threading.Event()
+            broker._sweep_stop = stop
+
+            def sweeper():
+                while not stop.wait(max(hash_ttl_ms / 4000.0, 0.05)):
+                    state.sweep()
+
+            threading.Thread(target=sweeper, daemon=True).start()
+        return broker
 
     def client(self, timeout: float = 30.0) -> BrokerClient:
         return BrokerClient(port=self.port, timeout=timeout)
@@ -434,6 +505,8 @@ class Broker:
                 self._proc.kill()
             self._proc = None
         if self._server is not None:
+            if getattr(self, "_sweep_stop", None) is not None:
+                self._sweep_stop.set()
             self._server.shutdown()
             self._server.close_all_connections()
             self._server.server_close()

@@ -37,6 +37,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <chrono>
 #include <condition_variable>
 #include <cstdio>
@@ -64,8 +65,10 @@ struct Group {
 };
 
 long long NowMs() {
+  // steady clock: TTL/idle arithmetic must not jump with NTP steps or
+  // suspend/resume (all uses are relative durations)
   return std::chrono::duration_cast<std::chrono::milliseconds>(
-             std::chrono::system_clock::now().time_since_epoch())
+             std::chrono::steady_clock::now().time_since_epoch())
       .count();
 }
 
@@ -79,8 +82,48 @@ std::mutex g_mu;
 std::condition_variable g_cv;
 std::map<std::string, Stream> g_streams;
 std::map<std::string, std::map<std::string, std::string>> g_hashes;
+// last-write time per hash field: the result hash would otherwise grow
+// forever if a client never collects (TTL eviction bounds broker memory;
+// Redis gets this from EXPIRE, ref serving keeps results in a Redis hash)
+std::map<std::string, std::map<std::string, long long>> g_hash_times;
+long long g_hash_ttl_ms = 600000;  // 0 disables
 bool g_shutdown = false;
 int g_srv_fd = -1;
+
+// drop expired fields of one hash key; caller holds g_mu
+void EvictExpired(const std::string& key, long long now_ms) {
+  if (g_hash_ttl_ms <= 0) return;
+  auto t = g_hash_times.find(key);
+  if (t == g_hash_times.end()) return;
+  auto h = g_hashes.find(key);
+  for (auto it = t->second.begin(); it != t->second.end();) {
+    if (now_ms - it->second >= g_hash_ttl_ms) {
+      if (h != g_hashes.end()) h->second.erase(it->first);
+      it = t->second.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  if (t->second.empty()) g_hash_times.erase(t);
+  if (h != g_hashes.end() && h->second.empty()) g_hashes.erase(h);
+}
+
+// periodic sweep so memory stays bounded even with no client traffic
+void SweeperLoop() {
+  std::unique_lock<std::mutex> lk(g_mu);
+  while (!g_shutdown) {
+    long long wait_ms = g_hash_ttl_ms > 0 ? std::max(g_hash_ttl_ms / 4,
+                                                     1000LL)
+                                          : 60000LL;
+    g_cv.wait_for(lk, std::chrono::milliseconds(wait_ms),
+                  []() { return g_shutdown; });
+    if (g_shutdown) break;
+    long long now_ms = NowMs();
+    std::vector<std::string> keys;
+    for (auto& kv : g_hash_times) keys.push_back(kv.first);
+    for (auto& k : keys) EvictExpired(k, now_ms);
+  }
+}
 
 // Per-connection receive buffer: bulk recv instead of byte-at-a-time
 // syscalls, and leftover bytes carry over so pipelined commands (many
@@ -271,7 +314,10 @@ void HandleConn(int fd) {
     } else if (cmd == "HSET" && p.size() >= 4) {
       {
         std::lock_guard<std::mutex> lk(g_mu);
+        long long now_ms = NowMs();
+        EvictExpired(p[1], now_ms);  // amortized: writers pay for cleanup
         g_hashes[p[1]][p[2]] = p[3];
+        if (g_hash_ttl_ms > 0) g_hash_times[p[1]][p[2]] = now_ms;
       }
       g_cv.notify_all();
       SendAll(fd, "+OK\n");
@@ -285,12 +331,26 @@ void HandleConn(int fd) {
           auto f = h->second.find(p[2]);
           if (f != h->second.end()) { val = f->second; found = true; }
         }
+        if (found && g_hash_ttl_ms > 0) {
+          // only the requested field's clock — O(log n), not a key scan
+          auto t = g_hash_times.find(p[1]);
+          if (t != g_hash_times.end()) {
+            auto ft = t->second.find(p[2]);
+            if (ft != t->second.end() &&
+                NowMs() - ft->second >= g_hash_ttl_ms) {
+              h->second.erase(p[2]);
+              t->second.erase(ft);
+              found = false;
+            }
+          }
+        }
       }
       SendAll(fd, found ? "$" + val + "\n" : "$-1\n");
     } else if (cmd == "HKEYS" && p.size() >= 2) {
       std::ostringstream os;
       {
         std::lock_guard<std::mutex> lk(g_mu);
+        EvictExpired(p[1], NowMs());
         auto h = g_hashes.find(p[1]);
         size_t n = (h == g_hashes.end()) ? 0 : h->second.size();
         os << "*" << n << "\n";
@@ -305,6 +365,8 @@ void HandleConn(int fd) {
         auto h = g_hashes.find(p[1]);
         if (h != g_hashes.end())
           n = static_cast<int>(h->second.erase(p[2]));
+        auto t = g_hash_times.find(p[1]);
+        if (t != g_hash_times.end()) t->second.erase(p[2]);
       }
       SendAll(fd, ":" + std::to_string(n) + "\n");
     } else if (cmd == "DEL" && p.size() >= 2) {
@@ -312,6 +374,7 @@ void HandleConn(int fd) {
         std::lock_guard<std::mutex> lk(g_mu);
         g_streams.erase(p[1]);
         g_hashes.erase(p[1]);
+        g_hash_times.erase(p[1]);
       }
       SendAll(fd, "+OK\n");
     } else {
@@ -329,6 +392,10 @@ void HandleConn(int fd) {
 
 int main(int argc, char** argv) {
   int port = argc > 1 ? atoi(argv[1]) : 6399;
+  if (argc > 2) g_hash_ttl_ms = atoll(argv[2]);
+  // joinable (not detached): a detached sweeper would race static
+  // destruction of g_mu/g_cv at shutdown (UB)
+  std::thread sweeper(SweeperLoop);
   int srv = socket(AF_INET, SOCK_STREAM, 0);
   g_srv_fd = srv;
   int one = 1;
@@ -364,5 +431,11 @@ int main(int argc, char** argv) {
     std::thread(HandleConn, fd).detach();
   }
   close(srv);
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    g_shutdown = true;
+  }
+  g_cv.notify_all();
+  sweeper.join();
   return 0;
 }

@@ -4,6 +4,7 @@ serving specs): broker protocol, wire schema, end-to-end stream → inference
 
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -373,3 +374,118 @@ class TestConfig:
         p.write_text("model:\n  path: m\n")
         cfg = ServingConfig.load(str(p))
         assert cfg.batch_size == 8 and cfg.broker_port == 6399
+
+
+class TestHashTTLAndContention:
+    """Broker hardening (VERDICT r3 weak #8): result-hash TTL bounds
+    memory when clients never collect, and the broker stays correct under
+    multi-client lock contention on both backends."""
+
+    @pytest.mark.parametrize("backend", BACKENDS)
+    def test_hash_ttl_evicts_uncollected_results(self, backend):
+        b = Broker.launch(backend=backend, hash_ttl_ms=300)
+        try:
+            c = b.client()
+            for i in range(20):
+                c.hset("serving_result", f"r{i}", "dmFs")  # b64 "val"
+            assert c.hget("serving_result", "r0") == "dmFs"
+            time.sleep(0.5)
+            # expired: reads return nothing and the key list is empty
+            assert c.hget("serving_result", "r0") is None
+            assert c.hkeys("serving_result") == []
+            # new writes after expiry live again
+            c.hset("serving_result", "fresh", "dmFs")
+            assert c.hget("serving_result", "fresh") == "dmFs"
+        finally:
+            b.stop()
+
+    @pytest.mark.parametrize("backend", BACKENDS)
+    def test_hash_ttl_zero_disables(self, backend):
+        b = Broker.launch(backend=backend, hash_ttl_ms=0)
+        try:
+            c = b.client()
+            c.hset("h", "f", "dmFs")
+            time.sleep(0.3)
+            assert c.hget("h", "f") == "dmFs"
+        finally:
+            b.stop()
+
+    @pytest.mark.parametrize("backend", BACKENDS)
+    def test_ttl_does_not_race_collection(self, backend):
+        """Results collected before the TTL are delivered even while the
+        sweeper is active (writer + reader contention with a short TTL)."""
+        b = Broker.launch(backend=backend, hash_ttl_ms=2000)
+        try:
+            c_w = b.client()
+            c_r = b.client()
+            missing = []
+
+            def write():
+                for i in range(200):
+                    c_w.hset("res", f"k{i}", "dmFs")
+
+            def read():
+                for i in range(200):
+                    for _ in range(200):
+                        if c_r.hget("res", f"k{i}") is not None:
+                            break
+                        time.sleep(0.002)
+                    else:
+                        missing.append(i)
+
+            tw = threading.Thread(target=write)
+            tr = threading.Thread(target=read)
+            tw.start(); tr.start(); tw.join(); tr.join()
+            assert missing == []
+        finally:
+            b.stop()
+
+    def test_multiclient_contention_stress(self, broker):
+        """8 concurrent clients (4 producers, 2 consumers via the engine
+        group, 2 hash pollers) hammer one broker: exactly-once results for
+        every record, no protocol desync on any connection."""
+        im, _ = _make_model()
+        n_per, n_prod = 75, 4
+        with ClusterServing(im, broker.port, batch_size=8).start():
+            errs = []
+            polled = {"n": 0}
+            stop = threading.Event()
+
+            def produce(t):
+                try:
+                    q = InputQueue(port=broker.port)
+                    for i in range(n_per):
+                        q.enqueue(f"s{t}_{i}",
+                                  x=np.full(4, t + i / 100, np.float32))
+                except Exception as e:
+                    errs.append(e)
+
+            def poll_hash():
+                # concurrent HKEYS/HGET readers racing the engine's HSETs
+                # on the ACTUAL result hash the engine writes
+                from analytics_zoo_tpu.serving.client import RESULT_HASH
+                try:
+                    c = broker.client()
+                    while not stop.is_set():
+                        for k in c.hkeys(RESULT_HASH)[:10]:
+                            c.hget(RESULT_HASH, k)
+                        polled["n"] += 1
+                except Exception as e:
+                    errs.append(e)
+
+            pollers = [threading.Thread(target=poll_hash) for _ in range(2)]
+            producers = [threading.Thread(target=produce, args=(t,))
+                         for t in range(n_prod)]
+            [t.start() for t in pollers + producers]
+            [t.join() for t in producers]
+            out_q = OutputQueue(port=broker.port)
+            for t in range(n_prod):
+                for i in range(n_per):
+                    assert out_q.query(f"s{t}_{i}", timeout=60.0) \
+                        is not None, f"lost s{t}_{i}"
+            stop.set()
+            [t.join() for t in pollers]
+            assert not errs
+            assert polled["n"] > 0
+            c = broker.client()
+            assert c.xpending("serving_stream", "serving") == 0
