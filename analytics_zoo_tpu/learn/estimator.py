@@ -41,26 +41,10 @@ from analytics_zoo_tpu.learn import losses as loss_lib
 from analytics_zoo_tpu.learn import metrics as metric_lib
 from analytics_zoo_tpu.learn.optimizers import Optimizer
 from analytics_zoo_tpu.learn.trigger import EveryEpoch, Trigger
+from analytics_zoo_tpu.learn.trigger import fire as _fire_trigger
 from analytics_zoo_tpu.parallel.strategy import ShardingStrategy
 
 logger = logging.getLogger(__name__)
-
-
-def _fire_trigger(trigger, epoch, iteration, loss, score):
-    """Evaluate a checkpoint trigger, passing ``score`` only to triggers
-    whose ``__call__`` accepts it — user subclasses written against the
-    old 3-arg signature keep working."""
-    import inspect
-    try:
-        sig = inspect.signature(trigger.__call__)
-        takes_score = ("score" in sig.parameters or any(
-            p.kind is inspect.Parameter.VAR_KEYWORD
-            for p in sig.parameters.values()))
-    except (TypeError, ValueError):
-        takes_score = False
-    if takes_score:
-        return trigger(epoch, iteration, loss, score=score)
-    return trigger(epoch, iteration, loss)
 
 
 def _trigger_needs_score(trigger) -> bool:
@@ -685,9 +669,10 @@ class JaxEstimator:
                     for k, v in val.items():
                         history.setdefault("val_" + k, []).append(v)
                         self._val_writer.add_scalar(k, v, self._py_step)
-                    # first non-loss validation metric feeds MaxScore
-                    val_score = next((v for k, v in val.items()
-                                      if k != "loss"), None)
+                    # the full metrics dict feeds the triggers: MaxScore
+                    # picks its named metric (or the first non-loss one,
+                    # warning when that is error-style)
+                    val_score = val
                 if checkpoint_trigger and self.model_dir and \
                         _fire_trigger(checkpoint_trigger, self._epoch,
                                       self._py_step, epoch_loss, val_score):

@@ -19,6 +19,31 @@ class Trigger:
         raise TypeError(f"expected Trigger, got {type(t)}")
 
 
+def fire(trigger, epoch, iteration, loss, score=None) -> bool:
+    """Evaluate a trigger, passing ``score`` only when its ``__call__``
+    accepts it — user subclasses written against the old 3-arg signature
+    keep working, at the top level AND nested inside composites.
+
+    ``score`` may be the full validation-metrics dict: MaxScore and the
+    composites consume it directly; any other trigger gets the first
+    non-loss float (the old protocol), so user float-score subclasses
+    keep working."""
+    import inspect
+    if isinstance(score, dict) and \
+            not isinstance(trigger, (MaxScore, TriggerAnd, TriggerOr)):
+        score = next((v for k, v in score.items() if k != "loss"), None)
+    try:
+        sig = inspect.signature(trigger.__call__)
+        takes_score = ("score" in sig.parameters or any(
+            p.kind is inspect.Parameter.VAR_KEYWORD
+            for p in sig.parameters.values()))
+    except (TypeError, ValueError):
+        takes_score = False
+    if takes_score:
+        return trigger(epoch, iteration, loss, score=score)
+    return trigger(epoch, iteration, loss)
+
+
 class EveryEpoch(Trigger):
     """Fires at each epoch boundary (ref trigger.py:19-31): the first observed
     epoch value arms the trigger; every subsequent epoch *change* fires."""
@@ -67,15 +92,51 @@ class MinLoss(Trigger):
         return loss is not None and loss < self.min_loss
 
 
+# validation metrics where LOWER is better — feeding one of these to
+# MaxScore's higher-is-better comparison silently inverts the trigger
+ERROR_STYLE_METRICS = frozenset(
+    {"loss", "mse", "mae", "rmse", "mape", "smape"})
+
+
 class MaxScore(Trigger):
     """Fires when the validation score exceeds ``max`` (ref
     util/triggers.py:111 MaxScore — accuracy-style metrics where higher
-    is better; the estimator passes the first validation metric)."""
+    is better).
 
-    def __init__(self, max: float):
+    ``metric`` names which validation metric to watch (e.g.
+    ``MaxScore(0.9, metric="accuracy")``); without it the estimator's
+    first non-loss validation metric feeds the trigger, with a warning
+    when that metric is error-style (lower-is-better), where this
+    comparison would never fire."""
+
+    def __init__(self, max: float, metric: "str | None" = None):
         self.max = float(max)
+        self.metric = metric
+        self._warned = False
+        if metric in ERROR_STYLE_METRICS:
+            import warnings
+            warnings.warn(
+                f"MaxScore(metric={metric!r}) watches an error-style "
+                "(lower-is-better) metric with a higher-is-better "
+                "comparison — it would fire on the WORST epochs; use an "
+                "accuracy-style metric")
 
     def __call__(self, epoch, iteration, loss, score=None):
+        if isinstance(score, dict):
+            if self.metric is not None:
+                score = score.get(self.metric)
+            else:
+                name, score = next(
+                    ((k, v) for k, v in score.items() if k != "loss"),
+                    (None, None))
+                if name in ERROR_STYLE_METRICS and not self._warned:
+                    import warnings
+                    warnings.warn(
+                        f"MaxScore is watching {name!r}, an error-style "
+                        "(lower-is-better) metric — the trigger can never "
+                        "fire; name an accuracy-style metric with "
+                        "MaxScore(..., metric=...)")
+                    self._warned = True
         return score is not None and score > self.max
 
 
@@ -84,7 +145,9 @@ class TriggerAnd(Trigger):
         self.triggers = triggers
 
     def __call__(self, epoch, iteration, loss, score=None):
-        return all(t(epoch, iteration, loss, score)
+        # fire() inspects each sub-trigger so legacy 3-arg user triggers
+        # work nested, same as at the top level
+        return all(fire(t, epoch, iteration, loss, score)
                    for t in self.triggers)
 
 
@@ -93,5 +156,5 @@ class TriggerOr(Trigger):
         self.triggers = triggers
 
     def __call__(self, epoch, iteration, loss, score=None):
-        return any(t(epoch, iteration, loss, score)
+        return any(fire(t, epoch, iteration, loss, score)
                    for t in self.triggers)

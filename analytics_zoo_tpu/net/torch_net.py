@@ -662,8 +662,42 @@ def torch_to_jax(module) -> Tuple[Callable, Dict[str, Any]]:
             return np.asarray(_np(a))
         return a
 
+    # MultiheadAttention whose weights output is never consumed (every
+    # user is getitem[0]) runs with need_weights=False: the flash-attention
+    # path applies and the (B, H, Tq, Tk) probability matrix is never
+    # materialized — torch defaults need_weights=True, so a traced model
+    # that only keeps output[0] would otherwise silently pay for it.
+    import torch.nn as _tnn
+
+    def _weights_unused(n):
+        """True when only element [0] of the (output, weights) tuple is
+        ever consumed — `out, w = attn(...)` traces a dead getitem[1] for
+        the unused w, which doesn't count as consumption."""
+        if not n.users:
+            return False
+        for u in n.users:
+            if not (u.op == "call_function"
+                    and u.target is operator.getitem and len(u.args) > 1):
+                return False
+            if u.args[1] != 0 and u.users:
+                return False
+        return True
+
+    mha_weightless = {
+        n.name for n in graph_module.graph.nodes
+        if n.op == "call_module"
+        and isinstance(modules.get(n.target), _tnn.MultiheadAttention)
+        # only rewrite the DEFAULT case: an explicit need_weights —
+        # keyword or positional (5th arg, after q/k/v/key_padding_mask)
+        # — is the caller's choice, and injecting a keyword on top of a
+        # positional would collide at replay
+        and "need_weights" not in n.kwargs and len(n.args) <= 4
+        and _weights_unused(n)}
+
     node_recs = [(n.op, n.name, n.target, freeze(tuple(n.args)),
-                  freeze(dict(n.kwargs)))
+                  {**freeze(dict(n.kwargs)),
+                   **({"need_weights": False}
+                      if n.name in mha_weightless else {})})
                  for n in graph_module.graph.nodes]
     for op, name, target, _, _ in node_recs:
         if op == "call_function" and target not in _FN_MAP:

@@ -355,3 +355,72 @@ def test_auc_metric(orca_ctx):
     y_pred = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
     state = auc.update(state, jnp.asarray(y_true), jnp.asarray(y_pred))
     assert abs(auc.result(state) - 0.75) < 0.02
+
+
+def test_legacy_trigger_nested_in_composites():
+    """ADVICE r3: a 3-arg user Trigger subclass works INSIDE TriggerAnd/
+    TriggerOr, same as at the top level."""
+    from analytics_zoo_tpu.learn.trigger import (MaxScore, Trigger,
+                                                 TriggerAnd, TriggerOr)
+
+    class Legacy(Trigger):
+        def __call__(self, epoch, iteration, loss):   # old 3-arg form
+            return epoch >= 2
+
+    assert TriggerAnd(Legacy(), MaxScore(0.5))(3, 0, 0.1, score=0.9)
+    assert not TriggerAnd(Legacy(), MaxScore(0.5))(1, 0, 0.1, score=0.9)
+    assert TriggerOr(Legacy(), MaxScore(0.5))(0, 0, 0.1, score=0.9)
+    assert not TriggerOr(Legacy(), MaxScore(0.5))(0, 0, 0.1, score=0.2)
+
+
+def test_maxscore_named_metric_and_error_style_warning():
+    """ADVICE r3: MaxScore(metric=...) picks its metric from the val dict;
+    unnamed MaxScore warns when the auto-chosen metric is error-style."""
+    import warnings
+    from analytics_zoo_tpu.learn.trigger import MaxScore
+
+    ms = MaxScore(0.8, metric="accuracy")
+    assert ms(1, 1, 0.3, score={"loss": 0.3, "mse": 5.0, "accuracy": 0.9})
+    assert not ms(1, 1, 0.3, score={"loss": 0.3, "accuracy": 0.5})
+    assert not ms(1, 1, 0.3, score={"loss": 0.3})     # metric absent
+
+    auto = MaxScore(0.8)
+    assert auto(1, 1, 0.3, score={"loss": 0.3, "accuracy": 0.95})
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        # a GOOD (low) error metric never exceeds the threshold — the
+        # higher-is-better comparison is inverted for mse, hence the warning
+        assert not MaxScore(0.8)(1, 1, 0.3, score={"loss": 0.3, "mse": 0.2})
+        assert any("error-style" in str(x.message) for x in w)
+    # plain float scores keep working (old protocol)
+    assert MaxScore(0.5)(1, 1, 0.3, score=0.7)
+
+
+def test_user_float_score_trigger_still_gets_float():
+    """A user trigger written against the float-score protocol receives a
+    float even though the estimator now passes the metrics dict."""
+    from analytics_zoo_tpu.learn.trigger import fire, Trigger, TriggerOr
+
+    seen = []
+
+    class UserScore(Trigger):
+        def __call__(self, epoch, iteration, loss, score=None):
+            seen.append(score)
+            return score is not None and score > 0.9
+
+    assert fire(UserScore(), 1, 1, 0.2,
+                score={"loss": 0.2, "accuracy": 0.95})
+    assert seen[-1] == 0.95
+    # nested: the composite receives the dict, the leaf gets the float
+    assert fire(TriggerOr(UserScore()), 1, 1, 0.2,
+                score={"loss": 0.2, "accuracy": 0.95})
+    assert seen[-1] == 0.95
+
+
+def test_maxscore_named_error_metric_warns_at_construction():
+    import warnings
+    from analytics_zoo_tpu.learn.trigger import MaxScore
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        MaxScore(0.1, metric="mse")
+        assert any("WORST" in str(x.message) for x in w)

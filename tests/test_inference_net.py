@@ -613,3 +613,58 @@ class TestTransformerTranslation:
         with pytest.raises(NotImplementedError, match="norm.*frozen state"):
             torch_to_jax(tnn.TransformerEncoder(layer, 1,
                                                 norm=tnn.BatchNorm1d(8)))
+
+
+class TestMhaNeedWeightsRewrite:
+    """Traced models that discard the attention weights (only getitem[0]
+    consumed) are rewritten to need_weights=False, so the full
+    (B,H,Tq,Tk) probability matrix is never materialized (ADVICE r3:
+    torch defaults need_weights=True)."""
+
+    def _block(self, return_weights):
+        class Block(tnn.Module):
+            def __init__(self):
+                super().__init__()
+                self.attn = tnn.MultiheadAttention(8, 2, batch_first=True)
+
+            def forward(self, x):
+                out, w = self.attn(x, x, x)   # torch default: weights True
+                return (out, w) if return_weights else out
+
+        torch.manual_seed(11)
+        return Block()
+
+    def test_discarded_weights_skip_reference_path(self, orca_ctx,
+                                                   monkeypatch):
+        from analytics_zoo_tpu.ops import attention as attn_mod
+        m = self._block(return_weights=False)
+        apply_fn, variables = torch_to_jax(m)
+
+        real = attn_mod._reference_attention
+
+        def spy(*a, **k):
+            # return_probs=True is the materialize-the-weights path; the
+            # plain call is dot_product_attention's small-shape fallback
+            assert not k.get("return_probs"), (
+                "probability-matrix path ran for a model that discards "
+                "the weights")
+            return real(*a, **k)
+
+        monkeypatch.setattr(attn_mod, "_reference_attention", spy)
+        x = np.random.RandomState(2).randn(2, 4, 8).astype(np.float32)
+        got = np.asarray(apply_fn(variables, x))
+        with torch.no_grad():
+            want = m(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_consumed_weights_still_materialize(self, orca_ctx):
+        m = self._block(return_weights=True)
+        apply_fn, variables = torch_to_jax(m)
+        x = np.random.RandomState(3).randn(2, 4, 8).astype(np.float32)
+        out, w = apply_fn(variables, x)
+        with torch.no_grad():
+            t_out, t_w = m(torch.from_numpy(x))
+        np.testing.assert_allclose(np.asarray(out), t_out.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(w), t_w.numpy(),
+                                   rtol=1e-4, atol=1e-5)
