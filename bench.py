@@ -171,16 +171,54 @@ def _measure_step_time(est, x, y, warmup=3, iters=10):
     return dt, flops
 
 
+# BERT bench knobs (smoke tests shrink these)
+BERT_SEQ = 128
+BERT_BATCHES = (32, 64, 128)    # canonical first; sweep amortizes the
+                                # optimizer's flat ~3 GB/step HBM traffic
+BERT_SCAN_STEPS = 8             # optimizer steps fused per dispatch
+BERT_CFG_KW: dict = {}          # test hook: shrink the model
+
+
+def _measure_scan_time(est, x, y, k, warmup=1, iters=3):
+    """k fused optimizer steps per dispatch (fit(steps_per_loop=k) path) —
+    over a remote-tunnel chip the per-dispatch latency amortizes k-fold,
+    which is how real training runs."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = est._ensure_mesh()
+    est._build_train_step()
+    spec_x = P(*([None, "data"] + [None] * (x.ndim - 1)))
+    xs = jax.device_put(np.broadcast_to(x, (k,) + x.shape).copy(),
+                        NamedSharding(mesh, spec_x))
+    ys = jax.device_put(np.broadcast_to(y, (k,) + y.shape).copy(),
+                        NamedSharding(mesh, P(None, "data")))
+    state = est._state
+    for _ in range(warmup):
+        state, losses = est._train_scan(state, (xs, ys))
+    jax.block_until_ready(losses)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, losses = est._train_scan(state, (xs, ys))
+    jax.block_until_ready(losses)
+    dt = (time.perf_counter() - t0) / (iters * k)
+    est._state = state
+    return dt
+
+
 def measure_bert():
-    """BERT-base fine-tune: step time, achieved FLOP/s, MFU."""
+    """BERT-base fine-tune MFU: canonical batch 32 plus a batch sweep
+    (32/64/128) with scan-fused steps. The flash kernel intentionally does
+    NOT engage at seq 128 / head_dim 64 (docs/BERT_MFU.md: the score
+    matrix is ~25 MB and XLA's fused attention wins; the pallas kernel
+    would pad head_dim 64→128 and waste half the MXU lanes)."""
     import jax.numpy as jnp
     import numpy as np
     import flax.linen as nn
     from analytics_zoo_tpu.learn.estimator import Estimator
     from analytics_zoo_tpu.text.bert import BertConfig, BertModule
 
-    SEQ, B = 128, 32
-    cfg = BertConfig(dtype=jnp.bfloat16)
+    cfg = BertConfig(dtype=jnp.bfloat16, **BERT_CFG_KW)
 
     class Classifier(nn.Module):
         @nn.compact
@@ -188,21 +226,52 @@ def measure_bert():
             _, pooled = BertModule(cfg, name="bert")(ids, train=train)
             return nn.Dense(2)(pooled)
 
-    rng = np.random.default_rng(1)
-    x = rng.integers(0, cfg.vocab, (B, SEQ)).astype(np.int32)
-    y = rng.integers(0, 2, B).astype(np.int32)
-    est = Estimator.from_flax(
-        model=Classifier(), loss="sparse_categorical_crossentropy_logits",
-        optimizer="adam", sample_input=x[:2])
-    dt, flops = _measure_step_time(est, x, y)
-    achieved = (flops / dt) if flops else None
     peak = _device_peak_flops()
-    mfu = (achieved / peak) if (achieved and peak) else None
-    return {"bert_step_ms": round(dt * 1e3, 2),
-            "bert_step_tflops": round(flops / 1e12, 3) if flops else None,
-            "bert_achieved_tflops_per_s":
-                round(achieved / 1e12, 2) if achieved else None,
-            "bert_base_mfu": round(mfu, 4) if mfu else None}
+    rng = np.random.default_rng(1)
+    out = {}
+    sweep = {}
+    for b in BERT_BATCHES:
+        # each sweep point is independent: an OOM/wedge at a bigger batch
+        # must not discard the already-measured canonical numbers
+        try:
+            x = rng.integers(0, cfg.vocab, (b, BERT_SEQ)).astype(np.int32)
+            y = rng.integers(0, 2, b).astype(np.int32)
+            est = Estimator.from_flax(
+                model=Classifier(),
+                loss="sparse_categorical_crossentropy_logits",
+                optimizer="adam", sample_input=x[:2])
+            dt, flops = _measure_step_time(est, x, y)
+            dt_scan = _measure_scan_time(est, x, y, BERT_SCAN_STEPS)
+        except Exception as e:
+            sweep[str(b)] = None
+            out.setdefault("bert_sweep_errors", {})[str(b)] = repr(e)[:120]
+            continue
+        # sweep entries use the scan-fused path (how training runs)
+        scan_mfu = (flops / dt_scan / peak) if (flops and peak) else None
+        sweep[str(b)] = round(scan_mfu, 4) if scan_mfu else None
+        if b == BERT_BATCHES[0]:
+            # canonical detail: bert_base_mfu keeps its r1-r3 semantics —
+            # single-dispatch flops/dt — so rounds stay comparable; the
+            # scan-fused number rides under its own key
+            achieved = (flops / dt) if flops else None
+            mfu = (achieved / peak) if (achieved and peak) else None
+            out.update({
+                "bert_step_ms": round(dt * 1e3, 2),
+                "bert_scan_step_ms": round(dt_scan * 1e3, 2),
+                "bert_step_tflops":
+                    round(flops / 1e12, 3) if flops else None,
+                "bert_achieved_tflops_per_s":
+                    round(achieved / 1e12, 2) if achieved else None,
+                "bert_base_mfu": round(mfu, 4) if mfu else None,
+                "bert_scan_mfu":
+                    round(scan_mfu, 4) if scan_mfu else None})
+    valid = {int(k): v for k, v in sweep.items() if v}
+    out["bert_mfu_sweep"] = sweep     # scan-fused MFU per batch size
+    if valid:
+        best_b = max(valid, key=valid.get)
+        out["bert_mfu_best"] = valid[best_b]
+        out["bert_mfu_best_batch"] = best_b
+    return out
 
 
 def _serve_once(im, payloads, tag):

@@ -72,3 +72,22 @@ def test_entry_is_jittable(orca_ctx):
     fn, args = __graft_entry__.entry()
     out = jax.jit(fn)(*args)
     assert jax.tree_util.tree_leaves(out)[0].shape[0] == 8
+
+
+def test_measure_bert_sweep(tiny_bench, orca_ctx, monkeypatch):
+    """measure_bert emits the canonical-batch detail plus the MFU sweep
+    (tiny model/batches so the smoke stays fast on CPU)."""
+    monkeypatch.setattr(tiny_bench, "BERT_SEQ", 16)
+    monkeypatch.setattr(tiny_bench, "BERT_BATCHES", (8, 16))
+    monkeypatch.setattr(tiny_bench, "BERT_SCAN_STEPS", 2)
+    monkeypatch.setattr(tiny_bench, "BERT_CFG_KW",
+                        dict(vocab=100, hidden_size=32, n_block=2,
+                             n_head=2, intermediate_size=64,
+                             max_position_len=32))
+    out = tiny_bench.measure_bert()
+    assert out["bert_step_ms"] > 0
+    assert out["bert_scan_step_ms"] > 0
+    assert set(out["bert_mfu_sweep"]) == {"8", "16"}
+    # no peak table entry for the CPU device → MFU fields None or absent
+    if out.get("bert_base_mfu") is not None:
+        assert 0 < out["bert_base_mfu"] <= 1.5

@@ -183,3 +183,32 @@ class TestEstimators:
         state = est.estimator._state
         qk = state["params"]["bert"]["block_0"]["attention"]["query"]["kernel"]
         assert "model" in str(qk.sharding.spec), qk.sharding.spec
+
+
+def test_remat_forward_and_grad_equivalence(orca_ctx):
+    """BertConfig(remat=True) recomputes activations in backward without
+    changing forward outputs or gradients (docs/BERT_MFU.md)."""
+    import jax
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.text.bert import BertConfig, BertModule
+
+    kw = dict(vocab=100, hidden_size=32, n_block=2, n_head=2,
+              intermediate_size=64, max_position_len=16,
+              hidden_drop=0.0, attn_drop=0.0)
+    ids = np.random.RandomState(0).randint(0, 100, (2, 16)).astype(np.int32)
+    plain = BertModule(BertConfig(**kw))
+    remat = BertModule(BertConfig(**kw, remat=True))
+    variables = plain.init({"params": jax.random.PRNGKey(0),
+                            "dropout": jax.random.PRNGKey(1)}, ids)
+    np.testing.assert_allclose(
+        np.asarray(plain.apply(variables, ids)[1]),
+        np.asarray(remat.apply(variables, ids)[1]), atol=1e-6)
+
+    def loss(module):
+        return lambda v: jnp.sum(module.apply(v, ids)[1] ** 2)
+
+    g1 = jax.grad(loss(plain))(variables)
+    g2 = jax.grad(loss(remat))(variables)
+    for a, b in zip(jax.tree_util.tree_leaves(g1),
+                    jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
