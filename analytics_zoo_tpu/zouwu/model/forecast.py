@@ -124,20 +124,47 @@ class TCNForecaster(Forecaster):
 
 
 class MTNetForecaster(Forecaster):
-    """(ref forecast/MTNetForecaster; input seq len must equal
-    (long_series_num + 1) * series_length)"""
+    """(ref forecast/MTNetForecaster over MTNet_keras.py; input seq len
+    must equal (long_num + 1) * time_step — the ref's [long_input,
+    short_input] pair concatenated along time).
 
-    def __init__(self, future_seq_len: int = 1, long_series_num: int = 4,
-                 series_length: int = 8, cnn_hid_size: int = 32,
-                 rnn_hid_size: int = 32, ar_window: int = 4,
-                 cnn_kernel_size: int = 3, dropout: float = 0.1, **kwargs):
+    Accepts the REFERENCE hyperparameter names (``time_step``,
+    ``long_num``, ``cnn_height``, ``rnn_hid_sizes`` list, ``cnn_dropout``,
+    ``rnn_dropout`` — MTNet_keras.py apply_config defaults) and keeps the
+    earlier aliases (series_length/long_series_num/cnn_kernel_size/
+    rnn_hid_size/dropout) working."""
+
+    def __init__(self, future_seq_len: int = 1,
+                 time_step: Optional[int] = None,
+                 long_num: Optional[int] = None,
+                 cnn_height: Optional[int] = None,
+                 cnn_hid_size: int = 32,
+                 rnn_hid_sizes: Optional[Sequence[int]] = None,
+                 ar_window: int = 4,
+                 cnn_dropout: Optional[float] = None,
+                 rnn_dropout: Optional[float] = None,
+                 # earlier spellings
+                 long_series_num: int = 4, series_length: int = 8,
+                 rnn_hid_size: Optional[int] = None,
+                 cnn_kernel_size: int = 3, dropout: float = 0.1,
+                 **kwargs):
         super().__init__(**kwargs)
-        self.kw = dict(future_seq_len=future_seq_len,
-                       long_series_num=long_series_num,
-                       series_length=series_length,
-                       cnn_hid_size=cnn_hid_size,
-                       rnn_hid_size=rnn_hid_size, ar_window=ar_window,
-                       cnn_kernel_size=cnn_kernel_size, dropout=dropout)
+        if rnn_hid_sizes is None:
+            rnn_hid_sizes = (rnn_hid_size,) if rnn_hid_size else (16, 32)
+        self.kw = dict(
+            output_dim=future_seq_len,
+            long_num=long_num if long_num is not None else long_series_num,
+            time_step=time_step if time_step is not None else series_length,
+            cnn_hid_size=cnn_hid_size,
+            rnn_hid_sizes=tuple(int(h) for h in rnn_hid_sizes),
+            cnn_height=cnn_height if cnn_height is not None
+            else cnn_kernel_size,
+            ar_window=ar_window,
+            # legacy `dropout` was ONE dropout before the GRU — map it to
+            # cnn_dropout only (mapping it to both would stack two layers
+            # and double the effective rate vs earlier rounds)
+            cnn_dropout=cnn_dropout if cnn_dropout is not None else dropout,
+            rnn_dropout=rnn_dropout if rnn_dropout is not None else 0.0)
 
     def _build_module(self, x):
         return MTNetModule(**self.kw)

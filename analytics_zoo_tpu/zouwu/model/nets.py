@@ -98,50 +98,162 @@ class TemporalConvNet(nn.Module):
         return nn.Dense(self.future_seq_len)(x[:, -1, :])
 
 
+class _AttentionGRU(nn.Module):
+    """The reference's ``AttentionRNNWrapper`` around stacked GRU cells
+    (ref MTNet_keras.py:51-231): at every RNN step, additive attention —
+    conditioned on the top cell's state — over ALL input timesteps picks a
+    weighted input summary that is concatenated with the current input and
+    projected before entering the (stacked) GRU.
+
+    Per step t (ref step(), MTNet_keras.py:128-147):
+        e   = tanh(X·W1 + b2 + (h·W2)[:, None]) · V      # [b, T, 1]
+        a   = softmax_T(e)
+        x~  = Σ_t a_t · X_t                               # [b, D]
+        x'  = [x_t ; x~] · W3 + b3                        # [b, D]
+        h, states = stacked_GRU(x', states)
+    Implemented as one ``lax.scan`` over time with X·W1+b2 precomputed
+    (the ref caches the same product in get_constants)."""
+
+    hidden_sizes: Sequence[int]
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        init = nn.initializers.truncated_normal(stddev=0.1)
+        w1 = self.param("W1", init, (d, d))
+        b2 = self.param("b2", init, (d,))
+        states = tuple(jnp.zeros((b, int(h))) for h in self.hidden_sizes)
+        xw1 = x @ w1 + b2                                   # [b, t, d]
+        # carry = recurrent states only; X and X·W1+b2 are loop-invariant
+        # and broadcast; the step owns the attention weights (shared
+        # across steps via variable_broadcast)
+        scan = nn.scan(
+            _AttentionGRUStep, variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=(1, nn.broadcast, nn.broadcast), out_axes=1)
+        _, ys = scan(hidden_sizes=tuple(self.hidden_sizes),
+                     name="steps")(states, x, x, xw1)
+        return ys[:, -1, :]                                 # last output
+
+
+class _AttentionGRUStep(nn.Module):
+    """One attention+stacked-GRU step, scanned over time by
+    ``_AttentionGRU``; params (attention weights + cells) are broadcast so
+    every step shares them."""
+
+    hidden_sizes: Tuple[int, ...]
+
+    @nn.compact
+    def __call__(self, states, x_t, x_all, xw1):
+        d = x_all.shape[-1]
+        h_out = int(self.hidden_sizes[-1])
+        init = nn.initializers.truncated_normal(stddev=0.1)
+        w2 = self.param("W2", init, (h_out, d))
+        w3 = self.param("W3", init, (2 * d, d))
+        b3 = self.param("b3", init, (d,))
+        v = self.param("V", init, (d, 1))
+        h_top = states[-1]
+        e = jnp.tanh(xw1 + (h_top @ w2)[:, None, :]) @ v    # [b, T, 1]
+        a = jax.nn.softmax(e, axis=1)
+        x_weighted = jnp.sum(a * x_all, axis=1)             # [b, D]
+        x_in = jnp.concatenate([x_t, x_weighted], axis=-1) @ w3 + b3
+        new_states = []
+        h = x_in
+        for i, (hsize, st) in enumerate(zip(self.hidden_sizes, states)):
+            st2, h = nn.GRUCell(features=int(hsize),
+                                name=f"gru_{i}")(st, h)
+            new_states.append(st2)
+        return tuple(new_states), h
+
+
 class MTNetModule(nn.Module):
-    """Memory time-series network (ref MTNet_keras.py): input is the long
-    series [b, (n+1)*T, F]; the first n chunks of length T form the memory,
-    the last chunk is the short-term query.
+    """Memory time-series network — the full reference architecture
+    (ref MTNet_keras.py:234-446 MTNetKeras.build/__encoder, 614 LoC):
 
-    enc(chunk) = GRU(CNN(chunk)) → [b, hid]; attention of query encoding
-    over memory encodings; plus an autoregressive highway on the raw target
-    (feature 0) of the last ``ar_window`` steps."""
-    future_seq_len: int = 1
-    long_series_num: int = 4          # n
-    series_length: int = 8            # T
+    - input is the long series [b, (long_num+1)·time_step, F]; the first
+      ``long_num`` chunks of length ``time_step`` are long-term memory,
+      the last chunk is the short-term query (the ref's two inputs,
+      concatenated — ``MTNetForecaster`` feeds this layout);
+    - THREE separate encoders (ref builds memory/context/query encoders
+      with distinct weights): encoder = valid-padding CNN over time with
+      full feature width (Conv2D kernel (cnn_height, F) there ≡ Conv1D
+      kernel cnn_height VALID here) → relu → dropout → attention-GRU
+      stack (``rnn_hid_sizes``); chunks fold into the batch dim so one
+      batched conv/GRU feeds the MXU instead of a per-chunk loop;
+    - attention: prob = memory·queryᵀ softmaxed over the ``long_num``
+      memories, out = context ⊙ prob (the ref code's Softmax(axis=-1)
+      acts on the singleton axis of [b, n, 1] — a no-op that weights all
+      memories equally; we normalize over the memories per the MTNet
+      paper, which subsumes the ref behavior up to a constant);
+    - head: flatten [out ; query] → Dense(output_dim), truncated-normal
+      0.1 / constant 0.1 init (ref build());
+    - AR highway on ALL features of the last ``ar_window`` short-term
+      steps (ref reshape_ar), disabled when ``ar_window == 0``.
+
+    Reference hyperparameter names are the module fields: ``time_step``,
+    ``long_num``, ``cnn_height``, ``cnn_hid_size``, ``rnn_hid_sizes``,
+    ``cnn_dropout``, ``rnn_dropout`` (the ref's rnn_dropout applies inside
+    GRUCell input gates; here it applies to the encoder sequence before
+    the GRU — same regularization role), ``ar_window``, ``output_dim``.
+    """
+
+    output_dim: int = 1               # = future_seq_len
+    long_num: int = 4                 # ref long_num (memory chunks)
+    time_step: int = 8                # ref time_step (chunk length)
     cnn_hid_size: int = 32
-    rnn_hid_size: int = 32
-    cnn_kernel_size: int = 3
+    rnn_hid_sizes: Tuple[int, ...] = (16, 32)
+    cnn_height: int = 3               # conv window over time
     ar_window: int = 4
-    dropout: float = 0.1
+    cnn_dropout: float = 0.1
+    rnn_dropout: float = 0.1
 
-    def _encode(self, chunk, train):
-        y = nn.Conv(self.cnn_hid_size, (self.cnn_kernel_size,),
-                    padding="SAME", name="enc_conv")(chunk)
+    def _encoder(self, chunks, name, train):
+        """[b·num, T, F] → [b·num, last_rnn_size] (ref __encoder)."""
+        init = nn.initializers.truncated_normal(stddev=0.1)
+        y = nn.Conv(self.cnn_hid_size, (self.cnn_height,), padding="VALID",
+                    kernel_init=init,
+                    bias_init=nn.initializers.constant(0.1),
+                    name=f"{name}_conv")(chunks)
         y = nn.relu(y)
-        y = nn.Dropout(rate=self.dropout, deterministic=not train,
-                       name="enc_drop")(y)
-        y = nn.RNN(nn.GRUCell(features=self.rnn_hid_size), name="enc_gru")(y)
-        return y[:, -1, :]                                    # [b, hid]
+        y = nn.Dropout(rate=self.cnn_dropout, deterministic=not train,
+                       name=f"{name}_cnn_drop")(y)
+        if self.rnn_dropout:
+            y = nn.Dropout(rate=self.rnn_dropout, deterministic=not train,
+                           name=f"{name}_rnn_drop")(y)
+        return _AttentionGRU(hidden_sizes=self.rnn_hid_sizes,
+                             name=f"{name}_attgru")(y)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        n, t = self.long_series_num, self.series_length
+        n, t = self.long_num, self.time_step
+        assert t >= self.ar_window, "ar_window must not exceed time_step"
+        assert t >= self.cnn_height, "cnn_height must not exceed time_step"
         b = x.shape[0]
         assert x.shape[1] == (n + 1) * t, \
             f"expected seq len {(n + 1) * t}, got {x.shape[1]}"
-        # shared encoder over memory chunks + query: fold chunks into the
-        # batch dim (one big batched conv/GRU feeds the MXU better than a
-        # per-chunk loop)
-        chunks = x.reshape(b * (n + 1), t, x.shape[-1])
-        enc = self._encode(chunks, train).reshape(b, n + 1, self.rnn_hid_size)
-        mem, query = enc[:, :n, :], enc[:, n, :]
-        att = jnp.einsum("bnh,bh->bn", mem, query) / jnp.sqrt(self.rnn_hid_size)
-        att = jax.nn.softmax(att, axis=-1)
-        ctx = jnp.einsum("bn,bnh->bh", att, mem)
-        hidden = jnp.concatenate([ctx, query], axis=-1)
-        pred = nn.Dense(self.future_seq_len, name="head")(hidden)
-        # autoregressive highway on the raw target channel
-        ar_in = x[:, -self.ar_window:, 0]
-        ar = nn.Dense(self.future_seq_len, name="ar")(ar_in)
-        return pred + ar
+        h_last = int(self.rnn_hid_sizes[-1])
+        long_chunks = x[:, :n * t, :].reshape(b * n, t, x.shape[-1])
+        short = x[:, n * t:, :]                              # [b, T, F]
+
+        memory = self._encoder(long_chunks, "memory",
+                               train).reshape(b, n, h_last)
+        context = self._encoder(long_chunks, "context",
+                                train).reshape(b, n, h_last)
+        query = self._encoder(short, "query", train)         # [b, h]
+
+        prob = jnp.einsum("bnh,bh->bn", memory, query)
+        prob = jax.nn.softmax(prob, axis=-1)                 # over memories
+        out = context * prob[..., None]                      # [b, n, h]
+        pred_x = jnp.concatenate([out, query[:, None, :]],
+                                 axis=1).reshape(b, (n + 1) * h_last)
+        init = nn.initializers.truncated_normal(stddev=0.1)
+        nonlinear = nn.Dense(self.output_dim, kernel_init=init,
+                             bias_init=nn.initializers.constant(0.1),
+                             name="head")(pred_x)
+        if self.ar_window > 0:
+            ar_in = short[:, -self.ar_window:, :].reshape(b, -1)
+            linear = nn.Dense(self.output_dim, kernel_init=init,
+                              bias_init=nn.initializers.constant(0.1),
+                              name="ar")(ar_in)
+            return nonlinear + linear
+        return nonlinear
