@@ -51,6 +51,8 @@ class InferenceModel:
         self._params = None
         self._jitted = None
         self._n_inputs = 1
+        # set by quantize(mode="int8"): {dense path: calibrated |x|max}
+        self._act_ranges = None
 
     # ------------------------------------------------------------- loaders
     def load_zoo(self, model) -> "InferenceModel":
@@ -153,16 +155,29 @@ class InferenceModel:
             self._params = new
         return self
 
-    def quantize(self, min_elems: int = 1024) -> "InferenceModel":
-        """Post-training int8 weight quantization (ref BigDL
-        ``model.quantize()`` int8 inference — SURVEY §6: "2× speedup, 4×
-        model-size reduction"): matmul/conv kernels are stored int8 with
+    def quantize(self, min_elems: int = 1024, mode: str = "weight",
+                 calibration_data=None) -> "InferenceModel":
+        """Post-training int8 quantization (ref BigDL ``model.quantize()``
+        int8 inference — SURVEY §6: "2× speedup, 4× model-size reduction").
+
+        ``mode="weight"`` (default): matmul/conv kernels stored int8 with
         per-channel scales; dequantization runs inside the jitted forward
-        so weights stay int8 in HBM."""
+        so weights stay int8 in HBM (4× smaller).
+
+        ``mode="int8"``: ALSO quantizes activations — a calibration pass
+        over ``calibration_data`` (ndarray / tuple, or list of batches)
+        records per-Dense input ranges (the reference's MKL int8
+        calibration), then every calibrated ``nn.Dense`` executes as an
+        int8×int8→int32 ``dot_general`` — the MXU's int8 path. Covers
+        flax/zoo-keras models; composes with the weight storage
+        quantization (applied first)."""
         from analytics_zoo_tpu.inference.quantize import (
-            dequantize_tree, quantize_tree,
+            calibrate_activations, dequantize_tree, int8_apply,
+            quantize_tree,
         )
 
+        if mode not in ("weight", "int8"):
+            raise ValueError(f"mode must be 'weight' or 'int8', got {mode!r}")
         with self._lock:
             if self._apply is None:
                 raise RuntimeError("load a model before quantize")
@@ -171,6 +186,24 @@ class InferenceModel:
 
         def q_apply(state, *xs):
             return orig_apply(dequantize_tree(state), *xs)
+
+        if mode == "int8":
+            if calibration_data is None:
+                raise ValueError(
+                    "mode='int8' needs calibration_data (a batch or list "
+                    "of batches) for the activation-range pass")
+            batches = calibration_data \
+                if isinstance(calibration_data, list) else [calibration_data]
+            if not batches:
+                raise ValueError(
+                    "mode='int8': calibration_data is empty — pass at "
+                    "least one batch to calibrate activation ranges")
+            act_amax = calibrate_activations(q_apply, qstate, batches)
+            # introspection: per-layer calibrated |x|max ranges
+            self._act_ranges = act_amax
+            self._install(int8_apply(q_apply, act_amax), qstate,
+                          self._n_inputs)
+            return self
 
         self._install(q_apply, qstate, self._n_inputs)
         return self

@@ -85,3 +85,133 @@ def tree_nbytes(params) -> int:
 
     return int(sum(np.asarray(leaf).nbytes
                    for leaf in jax.tree_util.tree_leaves(params)))
+
+
+# ---------------------------------------------------------------------------
+# Static int8 ACTIVATION quantization (calibrated) — the int8-MXU compute
+# path on top of the storage-side weight quantization above. The reference's
+# MKL int8 inference quantizes activations with calibrated ranges; here a
+# flax method interceptor (nn.intercept_methods) swaps every nn.Dense
+# __call__ for an int8×int8→int32 dot_general with per-tensor activation
+# scale and per-output-channel weight scales — no model rewrite needed, and
+# the interception happens at TRACE time so the whole int8 graph jits.
+# ---------------------------------------------------------------------------
+
+def _module_path(mod) -> str:
+    return "/".join(str(p) for p in mod.path)
+
+
+def calibrate_activations(apply_fn, state, batches) -> dict:
+    """Run calibration batches EAGERLY, recording each nn.Dense input's
+    max |x| (per-tensor symmetric range — the reference's calibration
+    pass over sample data). ``batches``: iterable of model inputs
+    (ndarray or tuple for multi-input)."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    amax: dict = {}
+
+    def observer(next_fun, args, kwargs, context):
+        mod = context.module
+        if isinstance(mod, nn.Dense) and args and hasattr(args[0], "shape"):
+            path = _module_path(mod)
+            amax[path] = max(amax.get(path, 0.0),
+                             float(jnp.max(jnp.abs(args[0]))))
+        return next_fun(*args, **kwargs)
+
+    for b in batches:
+        xs = b if isinstance(b, tuple) else (b,)
+        with nn.intercept_methods(observer):
+            apply_fn(state, *xs)
+    if not amax:
+        raise ValueError(
+            "calibration saw no flax nn.Dense layers — activation int8 "
+            "covers flax/zoo-keras models (torch-translated graphs run "
+            "weight-only quantization instead)")
+    return amax
+
+
+def _lookup_quantized_kernel(qparams, path_parts):
+    """Resolve the STORED int8 kernel (QuantizedLeaf) for a module path in
+    the weight-quantized state tree, or None. The tree may nest the flax
+    variables dict one level deeper depending on the loader."""
+    bases, cur = [], qparams
+    for _ in range(3):  # unwrap up to two "params" nesting levels
+        if not isinstance(cur, dict):
+            break
+        bases.append(cur)
+        cur = cur.get("params")
+    for base in bases:
+        node = base
+        for part in path_parts:
+            if not isinstance(node, dict) or part not in node:
+                node = None
+                break
+            node = node[part]
+        if isinstance(node, dict) and isinstance(node.get("kernel"),
+                                                 QuantizedLeaf):
+            return node["kernel"]
+    return None
+
+
+def int8_interceptor(act_amax: dict, qparams=None):
+    """flax method interceptor executing calibrated nn.Dense layers as
+    int8×int8→int32 ``lax.dot_general`` (the MXU int8 path), rescaled by
+    act_scale · per-channel weight scale. Uncalibrated layers and
+    non-Dense modules fall through to float.
+
+    ``qparams``: the weight-quantized state tree — when the layer's kernel
+    is stored as a QuantizedLeaf there, its int8 values/scales are used
+    DIRECTLY (no per-call dequantize→re-quantize round trip); otherwise
+    the kernel is quantized in-trace."""
+    import jax
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    def interceptor(next_fun, args, kwargs, context):
+        mod = context.module
+        if not isinstance(mod, nn.Dense):
+            return next_fun(*args, **kwargs)
+        path = _module_path(mod)
+        if path not in act_amax or not args or args[0].ndim < 1:
+            return next_fun(*args, **kwargs)
+        x = args[0]
+        params = mod.variables["params"]
+        s_in = jnp.float32(max(act_amax[path], 1e-8) / 127.0)
+        xq = jnp.clip(jnp.round(x / s_in), -127, 127).astype(jnp.int8)
+        stored = _lookup_quantized_kernel(qparams, mod.path)
+        if stored is not None:
+            wq = stored.q
+            s_w = jnp.reshape(stored.scale, (-1,))      # (out,)
+        else:
+            kernel = params["kernel"]
+            # no keepdims: a (1, out) scale would add a rank to 1-D
+            # (e.g. vmapped) inputs' outputs
+            w_amax = jnp.max(jnp.abs(kernel), axis=0)
+            s_w = jnp.where(w_amax == 0, 1.0, w_amax / 127.0)
+            wq = jnp.clip(jnp.round(kernel / s_w), -127,
+                          127).astype(jnp.int8)
+        y = jax.lax.dot_general(
+            xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        y = y.astype(jnp.float32) * (s_in * s_w)
+        if mod.use_bias:
+            y = y + params["bias"]
+        return y.astype(x.dtype) if x.dtype != y.dtype else y
+
+    return interceptor
+
+
+def int8_apply(apply_fn, act_amax: dict):
+    """Wrap an ``apply_fn(state, *xs)`` so every calibrated Dense runs
+    int8 (jit-compatible: interception happens while tracing). The
+    call-time state feeds the interceptor so stored int8 kernels are
+    consumed directly."""
+    import flax.linen as nn
+
+    def wrapped(state, *xs):
+        qparams = state if isinstance(state, dict) else None
+        with nn.intercept_methods(int8_interceptor(act_amax, qparams)):
+            return apply_fn(state, *xs)
+
+    return wrapped
