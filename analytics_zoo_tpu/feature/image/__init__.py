@@ -7,4 +7,6 @@ from analytics_zoo_tpu.feature.image.transforms import (  # noqa: F401
     ImageSaturation, ImageHue, ImageColorJitter, ImageExpand, ImageFiller,
     ImageRandomPreprocessing, ImageBytesToArray, ImageSetToSample,
     ImageMatToTensor, ImageMirror, ImageChannelOrder, PerImageNormalize,
+    ImageBytesToMat, ImagePixelBytesToMat, ImagePixelNormalize,
+    ImageFeatureToTensor, ImageFeatureToSample, RowToImageFeature,
 )

@@ -443,3 +443,104 @@ class ImageSetToSample(ImagePreprocessing):
             sample["y"] = ys[0] if len(ys) == 1 else ys
         feature["sample"] = sample
         return feature
+
+
+# ---- remaining reference spellings (ref imagePreprocessing.py) ----
+
+# ref ImageBytesToMat: encoded image file bytes → image (our "Mat" is the
+# HWC ndarray)
+ImageBytesToMat = ImageBytesToArray
+
+
+class ImagePixelBytesToMat(ImagePreprocessing):
+    """Raw PIXEL bytes (not an encoded file) → HWC uint8 array
+    (ref ImagePixelBytesToMat). Needs the target shape — either already
+    present as ``feature['shape']`` (h, w, c) or passed here."""
+
+    def __init__(self, byte_key: str = "bytes",
+                 shape: Optional[Tuple[int, int, int]] = None):
+        self.byte_key = byte_key
+        self.shape = tuple(shape) if shape is not None else None
+
+    def transform(self, feature: dict) -> dict:
+        feature = dict(feature)
+        shape = self.shape or tuple(feature.get("shape", ()))
+        if not shape:
+            raise ValueError(
+                "ImagePixelBytesToMat needs the pixel layout: pass "
+                "shape=(h, w, c) or put it in feature['shape']")
+        buf = np.frombuffer(feature[self.byte_key], dtype=np.uint8)
+        feature["image"] = buf.reshape(shape).copy()
+        return feature
+
+
+class ImagePixelNormalize(ImagePreprocessing):
+    """Pixel-level normalize, data(i) = data(i) - mean(i), with ``means``
+    flat in H*W*C order (ref ImagePixelNormalize — same math as
+    ImagePixelNormalizer, which takes the mean IMAGE instead)."""
+
+    def __init__(self, means: Sequence[float]):
+        self.means = np.asarray(means, np.float32)
+
+    def apply_image(self, img):
+        img = _to_float(img)
+        return img - self.means.reshape(img.shape)
+
+
+class ImageFeatureToTensor(ImagePreprocessing):
+    """ImageFeature → bare image tensor (ref ImageFeatureToTensor: the
+    JVM Sample plumbing collapses to returning the float array)."""
+
+    def transform(self, feature: dict):
+        return _to_float(feature["image"])
+
+
+class ImageFeatureToSample(ImagePreprocessing):
+    """ImageFeature → ``{"x": image, "y": label?}`` sample dict
+    (ref ImageFeatureToSample; equivalent to ImageSetToSample but
+    returning the sample itself)."""
+
+    def __init__(self, input_keys=("image",), target_keys=("label",)):
+        self._pack = ImageSetToSample(input_keys, target_keys)
+
+    def transform(self, feature: dict):
+        return self._pack.transform(feature)["sample"]
+
+
+class RowToImageFeature(ImagePreprocessing):
+    """Tabular row (dict / pandas Series with image bytes) → ImageFeature
+    dict (ref RowToImageFeature converts a Spark Row; the pandas-sharded
+    data layer's rows land here)."""
+
+    def __init__(self, bytes_col: str = "image", uri_col: str = "uri",
+                 label_col: Optional[str] = "label"):
+        self.bytes_col, self.uri_col, self.label_col = \
+            bytes_col, uri_col, label_col
+
+    def transform(self, row) -> dict:
+        get = row.get if hasattr(row, "get") else row.__getitem__
+        data = get(self.bytes_col)
+        if data is None:
+            raise KeyError(
+                f"RowToImageFeature: row has no {self.bytes_col!r} column "
+                f"(available: {list(row.keys()) if hasattr(row, 'keys') else '?'})")
+        feature = {"bytes": data}
+        try:
+            uri = get(self.uri_col)
+            if uri is not None:
+                feature["uri"] = uri
+        except (KeyError, IndexError):
+            pass
+        if self.label_col is not None:
+            try:
+                label = get(self.label_col)
+                if label is not None:
+                    feature["label"] = label
+            except (KeyError, IndexError):
+                pass
+        return feature
+
+
+__all__ += ["ImageBytesToMat", "ImagePixelBytesToMat", "ImagePixelNormalize",
+            "ImageFeatureToTensor", "ImageFeatureToSample",
+            "RowToImageFeature"]

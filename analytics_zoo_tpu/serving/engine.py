@@ -67,9 +67,11 @@ class ClusterServing:
                  input_cols: Optional[List[str]] = None,
                  cipher: schema.Cipher = None,
                  postprocess=None, block_ms: int = 50,
-                 claim_min_idle_ms: int = 30000):
+                 claim_min_idle_ms: int = 30000,
+                 broker_host: str = "127.0.0.1"):
         self.model = model
         self.batch_size = int(batch_size)
+        self.broker_host = broker_host
         self.broker_port = broker_port
         self.stream, self.result_key = stream, result_key
         self.group, self.consumer = group, consumer
@@ -192,7 +194,8 @@ class ClusterServing:
         while not self._stop.is_set():
             try:
                 if client is None:
-                    client = BrokerClient(port=self.broker_port)
+                    client = BrokerClient(host=self.broker_host,
+                                          port=self.broker_port)
                 self._serve_once(client)
             except (ConnectionError, OSError):
                 # broker died or the socket went bad: DROP the client and

@@ -55,7 +55,8 @@ class _Handler(BaseHTTPRequestHandler):
             payload = json.loads(self.rfile.read(n))
             inputs = {k: schema.decode_tensor(v)
                       for k, v in payload["inputs"].items()}
-            in_q = InputQueue(port=srv.broker_port, cipher=srv.cipher)
+            in_q = InputQueue(host=srv.broker_host,
+                              port=srv.broker_port, cipher=srv.cipher)
             uri = in_q.enqueue(payload.get("uri"), **inputs)
         except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
             self._json(400, {"error": f"bad request: {e}"})
@@ -64,7 +65,8 @@ class _Handler(BaseHTTPRequestHandler):
             if in_q is not None:
                 in_q.close()
         try:
-            out_q = OutputQueue(port=srv.broker_port, cipher=srv.cipher)
+            out_q = OutputQueue(host=srv.broker_host,
+                                port=srv.broker_port, cipher=srv.cipher)
             result = out_q.query(uri, timeout=srv.timeout_s, delete=True)
         except schema.ServingError as e:
             self._json(422, {"uri": uri, "error": str(e)})
@@ -83,8 +85,13 @@ class FrontEnd:
     """``FrontEnd(broker_port, engine).start()`` → serving HTTP on ``port``."""
 
     def __init__(self, broker_port: int, engine=None, port: int = 0,
-                 timeout: float = 30.0, cipher: schema.Cipher = None):
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+                 timeout: float = 30.0, cipher: schema.Cipher = None,
+                 host: str = "127.0.0.1",
+                 broker_host: str = "127.0.0.1"):
+        # host="0.0.0.0" for containers (the EXPOSEd port must bind
+        # beyond loopback to be reachable through docker port mapping)
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.broker_host = broker_host       # type: ignore[attr-defined]
         self._httpd.broker_port = broker_port       # type: ignore[attr-defined]
         self._httpd.engine = engine                 # type: ignore[attr-defined]
         self._httpd.timeout_s = timeout             # type: ignore[attr-defined]

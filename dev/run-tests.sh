@@ -33,6 +33,7 @@ case "$lane" in
                 tests/test_onnx.py tests/test_encryption.py ;;
   interop)  run tests/test_inference_net.py tests/test_onnx.py ;;
   examples) run tests/test_examples.py ;;
+  release)  bash "$(dirname "$0")/release.sh" ;;
   all)      run tests/ ;;
   *) echo "unknown lane: $lane" >&2; exit 2 ;;
 esac

@@ -1,0 +1,45 @@
+"""Cluster Serving container entrypoint (ref cluster-serving start scripts:
+boot Redis + Flink job + HTTP frontend; here: native broker + engine +
+frontend from one config.yaml)."""
+
+import os
+import signal
+import sys
+import threading
+
+from analytics_zoo_tpu.inference import InferenceModel
+from analytics_zoo_tpu.serving import (
+    Broker, ClusterServing, FrontEnd, ServingConfig,
+)
+
+
+def main(config_path: str = "config.yaml") -> int:
+    cfg = ServingConfig.load(config_path)
+    model = InferenceModel().load(cfg.model_path)
+    broker = None
+    if cfg.broker_host in ("127.0.0.1", "localhost", "0.0.0.0"):
+        broker = Broker.launch(port=cfg.broker_port)
+        b_host, b_port = "127.0.0.1", broker.port
+    else:
+        # reference Redis semantics: data.src names an EXISTING shared
+        # broker — connect, don't launch a shadow one
+        b_host, b_port = cfg.broker_host, cfg.broker_port
+    serving = ClusterServing(model, b_port, batch_size=cfg.batch_size,
+                             broker_host=b_host).start()
+    front = FrontEnd(broker_port=b_port, broker_host=b_host,
+                     host=os.environ.get("BIND_HOST", "0.0.0.0"),
+                     port=int(os.environ.get("HTTP_PORT", "8080"))).start()
+    print(f"serving up: broker {b_host}:{b_port} http :{front.port}",
+          flush=True)
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    serving.stop()
+    if broker is not None:
+        broker.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(*sys.argv[1:]))

@@ -489,3 +489,86 @@ class TestHashTTLAndContention:
             assert polled["n"] > 0
             c = broker.client()
             assert c.xpending("serving_stream", "serving") == 0
+
+
+class TestContainerEntrypoint:
+    """docker/cluster-serving/start-serving.py boots broker + engine +
+    HTTP frontend from one config.yaml and serves end-to-end (the
+    reference's cluster-serving container flow)."""
+
+    def test_start_serving_script(self, tmp_path):
+        import os
+        import signal
+        import socket
+        import subprocess
+        import sys
+        import time as _time
+
+        from analytics_zoo_tpu.models import NeuralCF
+
+        # a saved zoo model the entrypoint can InferenceModel().load()
+        model_dir = tmp_path / "model"
+        NeuralCF(user_count=5, item_count=5, class_num=2, user_embed=4,
+                 item_embed=4, hidden_layers=(8,),
+                 include_mf=False, mf_embed=0).save_model(str(model_dir))
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            bport = s.getsockname()[1]
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            hport = s.getsockname()[1]
+        cfg = tmp_path / "config.yaml"
+        cfg.write_text(
+            f"model:\n  path: {model_dir}\n"
+            f"data:\n  src: 127.0.0.1:{bport}\n"
+            f"params:\n  batch_size: 4\n")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = os.path.join(repo, "docker", "cluster-serving",
+                              "start-serving.py")
+        env = dict(os.environ, HTTP_PORT=str(hport),
+                   JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+        launcher = ("import jax, runpy, sys; "
+                    "jax.config.update('jax_platforms', 'cpu'); "
+                    "sys.argv = sys.argv[1:]; "
+                    "runpy.run_path(sys.argv[0], run_name='__main__')")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", launcher, script, str(cfg)],
+            env=env, cwd=repo, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        try:
+            # readiness wait with a REAL deadline (readline alone would
+            # block forever if the entrypoint wedges before printing)
+            found = {"line": ""}
+
+            def _wait_ready():
+                while True:
+                    line = proc.stdout.readline()
+                    if not line:
+                        return
+                    if "serving up" in line:
+                        found["line"] = line
+                        return
+
+            waiter = threading.Thread(target=_wait_ready, daemon=True)
+            waiter.start()
+            waiter.join(timeout=300)
+            assert "serving up" in found["line"], \
+                (found["line"], proc.poll())
+
+            x = np.array([1.0, 2.0], np.float32)
+            body = json.dumps(
+                {"inputs": {"x": schema.encode_tensor(x)}}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{hport}/predict", data=body,
+                headers={"Content-Type": "application/json"})
+            resp = json.loads(
+                urllib.request.urlopen(req, timeout=120).read())
+            assert "result" in resp, resp
+            out = schema.decode_tensor(resp["result"])
+            assert out.shape[-1] == 2 and np.isfinite(out).all()
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
