@@ -80,9 +80,11 @@ def local_rows(n, global_batch, process_id, num_processes):
     return np.arange(n_full).reshape(-1, num_processes, h)[:, process_id, :].ravel()
 
 
-def build_estimator(d):
+def build_estimator(d, strategy="dp"):
     """Tiny MLP regressor — shared by the workers and the single-process
-    reference in tests/test_multihost.py so both train the identical model."""
+    reference in tests/test_multihost.py so both train the identical
+    model. ``strategy`` exercises the sharded layouts cross-process
+    (e.g. "dp2,fsdp4": replicas over hosts, parameters sharded)."""
     import jax.numpy as jnp
     import numpy as np
     from analytics_zoo_tpu.learn.estimator import Estimator
@@ -98,10 +100,11 @@ def build_estimator(d):
         return h @ p["w2"] + p["b2"]
 
     return Estimator.from_fn(apply_fn=apply_fn, params=params, loss="mse",
-                             optimizer="sgd")
+                             optimizer="sgd", strategy=strategy)
 
 
-def run_worker(process_id, num_processes, coordinator, epochs, batch_size):
+def run_worker(process_id, num_processes, coordinator, epochs, batch_size,
+               strategy="dp"):
     # The virtual-device flag must be set before the XLA CPU backend
     # initialises (replace, don't append — the parent env may force 8).
     os.environ["XLA_FLAGS"] = \
@@ -121,7 +124,7 @@ def run_worker(process_id, num_processes, coordinator, epochs, batch_size):
     rows = local_rows(len(x), batch_size, process_id, num_processes)
     x_local, y_local = x[rows], y[rows]
 
-    est = build_estimator(x.shape[1])
+    est = build_estimator(x.shape[1], strategy)
     history = est.fit((x_local, y_local), epochs=epochs,
                       batch_size=batch_size, shuffle=False)
     ev = est.evaluate((x_local, y_local), batch_size=batch_size)
@@ -132,12 +135,13 @@ def run_worker(process_id, num_processes, coordinator, epochs, batch_size):
         print("MULTIHOST_RESULT " + json.dumps(
             {"process_count": jax.process_count(),
              "global_devices": len(jax.devices()),
+             "strategy": strategy,
              "loss": [float(v) for v in history["loss"]],
              "eval_loss": float(ev["loss"])}), flush=True)
     return 0
 
 
-def run_launcher(num_processes, epochs, batch_size):
+def run_launcher(num_processes, epochs, batch_size, strategy="dp"):
     with socket.socket() as s:  # grab a free port for the coordinator
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -151,7 +155,7 @@ def run_launcher(num_processes, epochs, batch_size):
         [sys.executable, os.path.abspath(__file__),
          "--process-id", str(i), "--num-processes", str(num_processes),
          "--coordinator", coordinator, "--epochs", str(epochs),
-         "--batch-size", str(batch_size)],
+         "--batch-size", str(batch_size), "--strategy", strategy],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         for i in range(num_processes)]
     outs = []
@@ -186,11 +190,13 @@ def main(argv=None):
     ap.add_argument("--coordinator", default=None)
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--strategy", default="dp")
     args = ap.parse_args(argv)
     if args.process_id is None:
-        return run_launcher(args.num_processes, args.epochs, args.batch_size)
+        return run_launcher(args.num_processes, args.epochs,
+                            args.batch_size, args.strategy)
     return run_worker(args.process_id, args.num_processes, args.coordinator,
-                      args.epochs, args.batch_size)
+                      args.epochs, args.batch_size, args.strategy)
 
 
 if __name__ == "__main__":
