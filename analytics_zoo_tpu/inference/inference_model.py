@@ -106,6 +106,25 @@ class InferenceModel:
         self._install(apply_fn, {"params": params}, len(args))
         return self
 
+    def load_openvino(self, model_path: str, weight_path: str,
+                      batch_size: int = 0) -> "InferenceModel":
+        """Load an OpenVINO IR model (ref
+        pyzoo/zoo/pipeline/inference/inference_model.py:69 load_openvino
+        → native OpenVINO engine; here the IR is parsed and translated to
+        a jitted jax function, net/openvino_net.py, so the same published
+        artifacts serve on TPU). ``batch_size`` is accepted for API parity
+        (batching is dynamic here)."""
+        from analytics_zoo_tpu.net.openvino_net import OpenVINONet
+
+        net = OpenVINONet(model_path, weight_path, jit=False)
+
+        def apply_fn(state, *xs):
+            return net.apply_fn({"params": state["params"]}, *xs)
+
+        self._install(apply_fn, {"params": net.variables["params"]},
+                      net.n_inputs)
+        return self
+
     def load_torch(self, torch_module, sample_input) -> "InferenceModel":
         """Convert a torch nn.Module into a jax forward and load it
         (ref doLoadPyTorch, InferenceModel.scala:249 — there the module runs

@@ -1,5 +1,7 @@
 from analytics_zoo_tpu.net.net import Net
 from analytics_zoo_tpu.net.onnx_net import ONNXNet, onnx_to_jax
+from analytics_zoo_tpu.net.openvino_net import OpenVINONet, openvino_to_jax
 from analytics_zoo_tpu.net.torch_net import TorchNet, torch_to_jax
 
-__all__ = ["Net", "ONNXNet", "TorchNet", "onnx_to_jax", "torch_to_jax"]
+__all__ = ["Net", "ONNXNet", "OpenVINONet", "TorchNet", "onnx_to_jax",
+           "openvino_to_jax", "torch_to_jax"]

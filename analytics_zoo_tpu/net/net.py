@@ -47,3 +47,12 @@ class Net:
         graph to a jitted jax function — see net/onnx_net.py."""
         from analytics_zoo_tpu.net.onnx_net import ONNXNet
         return ONNXNet(path)
+
+    @staticmethod
+    def load_openvino(model_path: str, weight_path: str):
+        """OpenVINO IR import (ref InferenceModel.load_openvino /
+        inferenceModelLoadOpenVINO): parses the IR xml+bin directly (no
+        openvino package) and translates the layer graph to a jitted jax
+        function — see net/openvino_net.py."""
+        from analytics_zoo_tpu.net.openvino_net import OpenVINONet
+        return OpenVINONet(model_path, weight_path)

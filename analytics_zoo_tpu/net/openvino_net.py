@@ -1,0 +1,364 @@
+"""OpenVINO IR importer — run reference-published OpenVINO models on TPU.
+
+The reference's OpenVINO path is a native x86 inference engine loaded via
+``InferenceModel.load_openvino(model_path, weight_path)``
+(ref ``pyzoo/zoo/pipeline/inference/inference_model.py:69`` →
+``inferenceModelLoadOpenVINO``; engine in
+``zoo/src/main/scala/com/intel/analytics/zoo/pipeline/inference/``). The
+engine itself has no TPU analog — but the MODEL FORMAT does not need one:
+this module parses OpenVINO IR directly (the ``.xml`` topology with
+``xml.etree`` + the ``.bin`` weight blob by offset/size, no openvino
+package) and translates the graph to a pure jax function, so IR artifacts
+users already have serve on TPU through the same ``InferenceModel``
+surface.
+
+Covers the opset subset classic CV/MLP IRs use: Parameter/Const/Result,
+Convolution/GroupConvolution (NCHW), MatMul, Add/Multiply/Subtract/Divide/
+Power, ReLU/Sigmoid/Tanh/Elu/Clamp/PReLU, MaxPool/AvgPool/ReduceMean,
+BatchNormInference, SoftMax, Reshape/Squeeze/Unsqueeze/Transpose/Concat/
+Gather, Sqrt/Exp. Unsupported layer types raise ``NotImplementedError``
+naming the type (same contract as ``onnx_net``).
+"""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+_DTYPES = {
+    "f32": np.float32, "FP32": np.float32,
+    "f16": np.float16, "FP16": np.float16,
+    "f64": np.float64,
+    "i64": np.int64, "I64": np.int64,
+    "i32": np.int32, "I32": np.int32,
+    "i8": np.int8, "u8": np.uint8,
+    "boolean": np.bool_, "BOOL": np.bool_,
+}
+
+
+class _Layer:
+    def __init__(self, el):
+        self.id = int(el.get("id"))
+        self.name = el.get("name", f"layer_{self.id}")
+        self.type = el.get("type")
+        self.version = el.get("version", "opset1")
+        data = el.find("data")
+        self.attrs: Dict[str, str] = dict(data.attrib) if data is not None \
+            else {}
+        self.in_ports: List[int] = [
+            int(p.get("id")) for p in el.findall("./input/port")]
+        self.out_ports: List[int] = [
+            int(p.get("id")) for p in el.findall("./output/port")]
+
+    def ints(self, key: str, default=None) -> Optional[Tuple[int, ...]]:
+        v = self.attrs.get(key)
+        if v is None or v == "":
+            return default
+        return tuple(int(x) for x in v.split(","))
+
+    def __repr__(self):
+        return f"<{self.type} {self.name!r}>"
+
+
+def parse_ir(xml_bytes: bytes, bin_bytes: bytes):
+    """IR xml+bin → (layers in topo order, edges, const arrays)."""
+    root = ET.fromstring(xml_bytes)
+    if root.tag != "net":
+        raise ValueError("not an OpenVINO IR file (missing <net> root)")
+    layers = [_Layer(el) for el in root.findall("./layers/layer")]
+    by_id = {l.id: l for l in layers}
+    # edge: (to_layer, to_port) <- (from_layer, from_port)
+    edges: Dict[Tuple[int, int], Tuple[int, int]] = {}
+    for e in root.findall("./edges/edge"):
+        edges[(int(e.get("to-layer")), int(e.get("to-port")))] = (
+            int(e.get("from-layer")), int(e.get("from-port")))
+
+    consts: Dict[int, np.ndarray] = {}
+    for l in layers:
+        if l.type != "Const":
+            continue
+        dt = _DTYPES.get(l.attrs.get("element_type", "f32"))
+        if dt is None:
+            raise NotImplementedError(
+                f"OpenVINO IR element_type "
+                f"{l.attrs.get('element_type')!r} not supported")
+        off = int(l.attrs["offset"])
+        size = int(l.attrs["size"])
+        shape = l.ints("shape", ())
+        arr = np.frombuffer(bin_bytes[off:off + size], dtype=dt)
+        consts[l.id] = arr.reshape(shape if shape else arr.shape).copy()
+
+    # topological order over the edge graph — iterative DFS (deep IRs
+    # easily exceed Python's recursion limit: every Const is a layer)
+    order: List[_Layer] = []
+    seen: set = set()
+
+    def visit(root: int):
+        stack: List[Tuple[int, bool]] = [(root, False)]
+        while stack:
+            lid, expanded = stack.pop()
+            if expanded:
+                order.append(by_id[lid])
+                continue
+            if lid in seen:
+                continue
+            seen.add(lid)
+            stack.append((lid, True))
+            for port in by_id[lid].in_ports:
+                src = edges.get((lid, port))
+                if src is not None and src[0] not in seen:
+                    stack.append((src[0], False))
+
+    for l in layers:
+        if l.type == "Result":
+            visit(l.id)
+    # graphs without Result layers (older IR): visit everything
+    for l in layers:
+        visit(l.id)
+    return order, edges, consts
+
+
+def _auto_pads(l: _Layer, in_spatial, kernel, strides, dilations):
+    """pads from explicit pads_begin/pads_end or auto_pad same_upper/
+    same_lower (ref IR Convolution/Pooling attributes)."""
+    auto = l.attrs.get("auto_pad", "explicit")
+    if auto in ("same_upper", "same_lower"):
+        pads = []
+        for i, k in enumerate(kernel):
+            eff = (k - 1) * dilations[i] + 1
+            out = -(-in_spatial[i] // strides[i])
+            total = max(0, (out - 1) * strides[i] + eff - in_spatial[i])
+            lo = total // 2
+            hi = total - lo
+            pads.append((hi, lo) if auto == "same_lower" else (lo, hi))
+        return pads
+    begin = l.ints("pads_begin", (0,) * len(kernel))
+    end = l.ints("pads_end", (0,) * len(kernel))
+    return list(zip(begin, end))
+
+
+def _conv(x, w, l: _Layer, groups: int):
+    import jax.lax as lax
+    spatial = len(x.shape) - 2
+    strides = l.ints("strides", (1,) * spatial)
+    dilations = l.ints("dilations", (1,) * spatial)
+    kernel = w.shape[-spatial:]
+    pads = _auto_pads(l, x.shape[2:], kernel, strides, dilations)
+    letters = "DHW"[-spatial:]
+    dn = lax.conv_dimension_numbers(
+        x.shape, w.shape,
+        ("NC" + letters, "OI" + letters, "NC" + letters))
+    return lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pads,
+        rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=groups)
+
+
+def _pool(x, l: _Layer, reducer, init, average: bool):
+    import jax.lax as lax
+    import jax.numpy as jnp
+    spatial = len(x.shape) - 2
+    kernel = l.ints("kernel")
+    strides = l.ints("strides", (1,) * spatial)
+    pads = _auto_pads(l, x.shape[2:], kernel, strides,
+                      (1,) * spatial)
+    if l.attrs.get("rounding_type", "floor") == "ceil":
+        raise NotImplementedError("Pooling rounding_type=ceil not supported")
+    dims = (1, 1) + tuple(kernel)
+    strd = (1, 1) + tuple(strides)
+    padding = ((0, 0), (0, 0)) + tuple(pads)
+    out = lax.reduce_window(x, init, reducer, dims, strd, padding)
+    if average:
+        if l.attrs.get("exclude-pad", "true") in ("true", "True", "1"):
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(ones, 0.0, lax.add, dims, strd,
+                                       padding)
+            return out / counts
+        return out / float(np.prod(kernel))
+    return out
+
+
+def _apply_layer(l: _Layer, ins: List[Any]):
+    import jax
+    import jax.numpy as jnp
+
+    t = l.type
+    if t == "Convolution":
+        return _conv(ins[0], ins[1], l, groups=1)
+    if t == "GroupConvolution":
+        # IR weights [G, O/G, I/G, kh, kw] → OIHW with O=G*(O/G)
+        w = ins[1]
+        g = w.shape[0]
+        w = w.reshape((w.shape[0] * w.shape[1],) + w.shape[2:])
+        return _conv(ins[0], w, l, groups=g)
+    if t == "MatMul":
+        a, b = ins
+        if l.attrs.get("transpose_a", "false") == "true":
+            a = jnp.swapaxes(a, -1, -2)
+        if l.attrs.get("transpose_b", "false") == "true":
+            b = jnp.swapaxes(b, -1, -2)
+        return a @ b
+    if t == "Add":
+        return ins[0] + ins[1]
+    if t == "Subtract":
+        return ins[0] - ins[1]
+    if t == "Multiply":
+        return ins[0] * ins[1]
+    if t == "Divide":
+        return ins[0] / ins[1]
+    if t == "Power":
+        return ins[0] ** ins[1]
+    if t == "Sqrt":
+        return jnp.sqrt(ins[0])
+    if t == "Exp":
+        return jnp.exp(ins[0])
+    if t == "ReLU":
+        return jax.nn.relu(ins[0])
+    if t == "PReLU":
+        slope = ins[1]
+        if slope.ndim == 1 and ins[0].ndim > 2:  # per-channel, NCHW
+            slope = slope.reshape((1, -1) + (1,) * (ins[0].ndim - 2))
+        return jnp.where(ins[0] > 0, ins[0], slope * ins[0])
+    if t == "Sigmoid":
+        return jax.nn.sigmoid(ins[0])
+    if t == "Tanh":
+        return jnp.tanh(ins[0])
+    if t == "Elu":
+        return jax.nn.elu(ins[0], alpha=float(l.attrs.get("alpha", 1.0)))
+    if t == "Clamp":
+        return jnp.clip(ins[0], float(l.attrs["min"]), float(l.attrs["max"]))
+    if t in ("SoftMax", "Softmax"):
+        return jax.nn.softmax(ins[0], axis=int(l.attrs.get("axis", 1)))
+    if t == "MaxPool":
+        import jax.lax as lax
+        return _pool(ins[0], l, lax.max, -jnp.inf, average=False)
+    if t == "AvgPool":
+        import jax.lax as lax
+        return _pool(ins[0], l, lax.add, 0.0, average=True)
+    if t == "ReduceMean":
+        axes = tuple(int(a) for a in np.asarray(ins[1]).reshape(-1))
+        keep = l.attrs.get("keep_dims", "true") in ("true", "True", "1")
+        return jnp.mean(ins[0], axis=axes, keepdims=keep)
+    if t == "BatchNormInference":
+        # input order CHANGED across opsets (opset5 release note: data
+        # moved first): opset1 = (gamma, beta, data, mean, variance),
+        # opset5+ = (data, gamma, beta, mean, variance)
+        if l.version in ("opset1", "opset2", "opset3", "opset4"):
+            gamma, beta, x, mean, var = ins
+        else:
+            x, gamma, beta, mean, var = ins
+        eps = float(l.attrs.get("eps", l.attrs.get("epsilon", 1e-5)))
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        return (x - mean.reshape(shape)) * gamma.reshape(shape) \
+            / jnp.sqrt(var.reshape(shape) + eps) + beta.reshape(shape)
+    if t == "Reshape":
+        target = [int(v) for v in np.asarray(ins[1]).reshape(-1)]
+        if l.attrs.get("special_zero", "true") in ("true", "True", "1"):
+            target = [ins[0].shape[i] if v == 0 else v
+                      for i, v in enumerate(target)]
+        return ins[0].reshape(target)
+    if t == "Squeeze":
+        axes = tuple(int(a) for a in np.asarray(ins[1]).reshape(-1)) \
+            if len(ins) > 1 else None
+        return jnp.squeeze(ins[0], axis=axes)
+    if t == "Unsqueeze":
+        out = ins[0]
+        raw = [int(a) for a in np.asarray(ins[1]).reshape(-1)]
+        out_rank = out.ndim + len(raw)
+        # negative axes index the OUTPUT rank, not the intermediate one
+        for a in sorted(a % out_rank for a in raw):
+            out = jnp.expand_dims(out, a)
+        return out
+    if t == "Transpose":
+        return jnp.transpose(ins[0],
+                             [int(v) for v in np.asarray(ins[1]).reshape(-1)])
+    if t == "Concat":
+        return jnp.concatenate(ins, axis=int(l.attrs.get("axis", 0)))
+    if t == "Gather":
+        axis = int(np.asarray(ins[2]).reshape(())) if len(ins) > 2 \
+            else int(l.attrs.get("axis", 0))
+        return jnp.take(ins[0], np.asarray(ins[1]).astype(np.int32),
+                        axis=axis)
+    raise NotImplementedError(
+        f"OpenVINO layer type {t!r} ({l.name}) has no TPU translation")
+
+
+def openvino_to_jax(xml_bytes: bytes, bin_bytes: bytes):
+    """IR → ``(apply_fn, {"params": float consts})``. Integer/bool consts
+    (shape/axis/index operands) stay static so consumers see concrete
+    values under jit — same split as ``onnx_net.onnx_to_jax``."""
+    order, edges, consts = parse_ir(xml_bytes, bin_bytes)
+
+    params: Dict[str, Any] = {}
+    static: Dict[int, np.ndarray] = {}
+    for lid, arr in consts.items():
+        if np.issubdtype(arr.dtype, np.integer) or arr.dtype == np.bool_:
+            static[lid] = arr
+        else:
+            params[str(lid)] = arr.astype(np.float32) \
+                if arr.dtype == np.float16 else arr
+
+    graph_inputs = [l for l in order if l.type == "Parameter"]
+    # the closure must NOT pin the host numpy weights (variables carry the
+    # live copies) — capture only the ids
+    param_ids = list(params)
+
+    def apply_fn(variables, *inputs):
+        import jax.numpy as jnp
+        if len(inputs) != len(graph_inputs):
+            raise ValueError(
+                f"model takes {len(graph_inputs)} inputs "
+                f"({[l.name for l in graph_inputs]}), got {len(inputs)}")
+        env: Dict[Tuple[int, int], Any] = {}
+        for l, x in zip(graph_inputs, inputs):
+            env[(l.id, l.out_ports[0])] = jnp.asarray(x)
+        for lid, arr in static.items():
+            env[(lid, 0)] = arr
+        for lid in param_ids:
+            env[(int(lid), 0)] = variables["params"][lid]
+        outs: List[Any] = []
+        for l in order:
+            if l.type in ("Parameter", "Const"):
+                continue
+            ins = []
+            for port in l.in_ports:
+                src = edges.get((l.id, port))
+                if src is None:
+                    raise ValueError(
+                        f"layer {l.name!r} input port {port} unconnected")
+                ins.append(env[src])
+            if l.type == "Result":
+                outs.append(ins[0])
+                continue
+            out = _apply_layer(l, ins)
+            env[(l.id, l.out_ports[0] if l.out_ports else 0)] = out
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    apply_fn.n_inputs = len(graph_inputs)
+    return apply_fn, {"params": params}
+
+
+class OpenVINONet:
+    """Inference wrapper over a translated IR (the TPU counterpart of the
+    reference's OpenVINO engine handle)."""
+
+    def __init__(self, model_path: str, weight_path: str, jit: bool = True):
+        import jax
+        with open(model_path, "rb") as f:
+            xml_bytes = f.read()
+        with open(weight_path, "rb") as f:
+            bin_bytes = f.read()
+        self.apply_fn, self.variables = openvino_to_jax(xml_bytes, bin_bytes)
+        self.n_inputs = self.apply_fn.n_inputs
+        self._call = jax.jit(self.apply_fn) if jit else self.apply_fn
+
+    @property
+    def params(self):
+        return self.variables["params"]
+
+    def predict(self, *inputs):
+        out = self._call(self.variables, *inputs)
+        import jax
+        return jax.tree_util.tree_map(np.asarray, out)

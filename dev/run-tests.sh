@@ -30,8 +30,10 @@ case "$lane" in
                 tests/test_stats_forecast.py ;;
   automl)   run tests/test_automl.py ;;
   serving)  run tests/test_serving.py tests/test_inference_net.py \
-                tests/test_onnx.py tests/test_encryption.py ;;
-  interop)  run tests/test_inference_net.py tests/test_onnx.py ;;
+                tests/test_onnx.py tests/test_openvino.py \
+                tests/test_encryption.py ;;
+  interop)  run tests/test_inference_net.py tests/test_onnx.py \
+                tests/test_openvino.py ;;
   examples) run tests/test_examples.py ;;
   release)  bash "$(dirname "$0")/release.sh" ;;
   all)      run tests/ ;;

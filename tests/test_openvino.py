@@ -1,0 +1,318 @@
+"""OpenVINO IR importer (the reference's load_openvino path, ref
+pyzoo/zoo/pipeline/inference/inference_model.py:69): IR xml+bin parsed
+directly and translated to jax. Tests hand-build IR files (the same
+strategy as the ONNX wire-format tests) and compare against numpy/torch."""
+
+import os
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from analytics_zoo_tpu.net.openvino_net import (  # noqa: E402
+    OpenVINONet, openvino_to_jax, parse_ir,
+)
+
+
+class _IRBuilder:
+    """Hand-build an IR xml + weight bin."""
+
+    def __init__(self):
+        self.layers = []
+        self.edges = []
+        self.bin = b""
+        self._id = 0
+
+    def _dims(self, shape):
+        return "".join(f"<dim>{d}</dim>" for d in shape)
+
+    def layer(self, type_, attrs=None, n_in=0, out_shape=(),
+              version="opset1"):
+        lid = self._id
+        self._id += 1
+        attr_s = ""
+        if attrs:
+            attr_s = "<data " + " ".join(
+                f'{k}="{v}"' for k, v in attrs.items()) + "/>"
+        in_s = ""
+        if n_in:
+            ports = "".join(
+                f'<port id="{i}">{self._dims(())}</port>'
+                for i in range(n_in))
+            in_s = f"<input>{ports}</input>"
+        out_s = ""
+        if type_ != "Result":
+            out_s = (f'<output><port id="{n_in}" precision="FP32">'
+                     f"{self._dims(out_shape)}</port></output>")
+        self.layers.append(
+            f'<layer id="{lid}" name="l{lid}" type="{type_}" '
+            f'version="{version}">{attr_s}{in_s}{out_s}</layer>')
+        return lid, n_in  # (id, first output port index)
+
+    def const(self, arr):
+        arr = np.ascontiguousarray(arr)
+        off = len(self.bin)
+        self.bin += arr.tobytes()
+        et = {np.dtype(np.float32): "f32", np.dtype(np.int64): "i64",
+              np.dtype(np.int32): "i32"}[arr.dtype]
+        return self.layer(
+            "Const",
+            {"element_type": et, "offset": off, "size": arr.nbytes,
+             "shape": ",".join(str(d) for d in arr.shape)},
+            n_in=0, out_shape=arr.shape)
+
+    def edge(self, src, dst, dst_port):
+        (sid, sport) = src
+        (did, _) = dst
+        self.edges.append(
+            f'<edge from-layer="{sid}" from-port="{sport}" '
+            f'to-layer="{did}" to-port="{dst_port}"/>')
+
+    def build(self):
+        xml = ("<net name=\"t\" version=\"10\"><layers>"
+               + "".join(self.layers) + "</layers><edges>"
+               + "".join(self.edges) + "</edges></net>")
+        return xml.encode(), self.bin
+
+    def write(self, tmp_path, stem="model"):
+        xml, binb = self.build()
+        xp = os.path.join(str(tmp_path), f"{stem}.xml")
+        bp = os.path.join(str(tmp_path), f"{stem}.bin")
+        with open(xp, "wb") as f:
+            f.write(xml)
+        with open(bp, "wb") as f:
+            f.write(binb)
+        return xp, bp
+
+
+def _mlp_ir(w1, b1, w2, b2):
+    """Parameter → MatMul → Add → ReLU → MatMul → Add → SoftMax → Result"""
+    b = _IRBuilder()
+    inp = b.layer("Parameter", {"shape": f"1,{w1.shape[0]}",
+                                "element_type": "f32"},
+                  out_shape=(1, w1.shape[0]))
+    cw1 = b.const(w1)
+    cb1 = b.const(b1)
+    cw2 = b.const(w2)
+    cb2 = b.const(b2)
+    mm1 = b.layer("MatMul", {"transpose_a": "false",
+                             "transpose_b": "false"}, 2, (1, w1.shape[1]))
+    add1 = b.layer("Add", None, 2, (1, w1.shape[1]))
+    relu = b.layer("ReLU", None, 1, (1, w1.shape[1]))
+    mm2 = b.layer("MatMul", None, 2, (1, w2.shape[1]))
+    add2 = b.layer("Add", None, 2, (1, w2.shape[1]))
+    sm = b.layer("SoftMax", {"axis": "1"}, 1, (1, w2.shape[1]))
+    res = b.layer("Result", None, 1)
+    b.edge(inp, mm1, 0)
+    b.edge(cw1, mm1, 1)
+    b.edge(mm1, add1, 0)
+    b.edge(cb1, add1, 1)
+    b.edge(add1, relu, 0)
+    b.edge(relu, mm2, 0)
+    b.edge(cw2, mm2, 1)
+    b.edge(mm2, add2, 0)
+    b.edge(cb2, add2, 1)
+    b.edge(add2, sm, 0)
+    b.edge(sm, res, 0)
+    return b
+
+
+class TestOpenVINOImport:
+    def test_mlp_matches_numpy(self, orca_ctx, tmp_path):
+        rs = np.random.RandomState(0)
+        w1 = rs.randn(6, 8).astype(np.float32)
+        b1 = rs.randn(8).astype(np.float32)
+        w2 = rs.randn(8, 3).astype(np.float32)
+        b2 = rs.randn(3).astype(np.float32)
+        xp, bp = _mlp_ir(w1, b1, w2, b2).write(tmp_path)
+        net = OpenVINONet(xp, bp)
+        x = rs.randn(4, 6).astype(np.float32)
+        got = net.predict(x)
+        h = np.maximum(x @ w1 + b1, 0)
+        logits = h @ w2 + b2
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        np.testing.assert_allclose(got, e / e.sum(1, keepdims=True),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_conv_bn_pool_matches_torch(self, orca_ctx, tmp_path):
+        torch.manual_seed(0)
+        conv = torch.nn.Conv2d(3, 4, 3, stride=1, padding=1)
+        bn = torch.nn.BatchNorm2d(4)
+        bn.train()(conv(torch.randn(8, 3, 8, 8)))  # prime running stats
+        conv.eval()
+        bn.eval()
+
+        b = _IRBuilder()
+        inp = b.layer("Parameter", {"shape": "2,3,8,8",
+                                    "element_type": "f32"},
+                      out_shape=(2, 3, 8, 8))
+        cw = b.const(conv.weight.detach().numpy())
+        cb = b.const(conv.bias.detach().numpy().reshape(1, 4, 1, 1))
+        cv = b.layer("Convolution",
+                     {"strides": "1,1", "pads_begin": "1,1",
+                      "pads_end": "1,1", "dilations": "1,1",
+                      "auto_pad": "explicit"}, 2, (2, 4, 8, 8))
+        addb = b.layer("Add", None, 2, (2, 4, 8, 8))
+        g = b.const(bn.weight.detach().numpy())
+        beta = b.const(bn.bias.detach().numpy())
+        mean = b.const(bn.running_mean.detach().numpy())
+        var = b.const(bn.running_var.detach().numpy())
+        bnl = b.layer("BatchNormInference", {"eps": str(bn.eps)}, 5,
+                      (2, 4, 8, 8), version="opset5")  # data-first order
+        mp = b.layer("MaxPool", {"kernel": "2,2", "strides": "2,2",
+                                 "pads_begin": "0,0", "pads_end": "0,0"},
+                     1, (2, 4, 4, 4))
+        res = b.layer("Result", None, 1)
+        b.edge(inp, cv, 0)
+        b.edge(cw, cv, 1)
+        b.edge(cv, addb, 0)
+        b.edge(cb, addb, 1)
+        b.edge(addb, bnl, 0)
+        b.edge(g, bnl, 1)
+        b.edge(beta, bnl, 2)
+        b.edge(mean, bnl, 3)
+        b.edge(var, bnl, 4)
+        b.edge(bnl, mp, 0)
+        b.edge(mp, res, 0)
+        xp, bp = b.write(tmp_path)
+        net = OpenVINONet(xp, bp)
+
+        x = np.random.RandomState(1).randn(2, 3, 8, 8).astype(np.float32)
+        with torch.no_grad():
+            want = torch.nn.functional.max_pool2d(
+                bn(conv(torch.from_numpy(x))), 2).numpy()
+        np.testing.assert_allclose(net.predict(x), want, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_reshape_reduce_and_static_consts(self, orca_ctx, tmp_path):
+        """Integer consts (Reshape targets, ReduceMean axes) stay static
+        under jit."""
+        b = _IRBuilder()
+        inp = b.layer("Parameter", {"shape": "2,3,4", "element_type": "f32"},
+                      out_shape=(2, 3, 4))
+        axes = b.const(np.array([2], np.int64))
+        rm = b.layer("ReduceMean", {"keep_dims": "false"}, 2, (2, 3))
+        shape = b.const(np.array([3, 2], np.int64))
+        rs_ = b.layer("Reshape", {"special_zero": "false"}, 2, (3, 2))
+        res = b.layer("Result", None, 1)
+        b.edge(inp, rm, 0)
+        b.edge(axes, rm, 1)
+        b.edge(rm, rs_, 0)
+        b.edge(shape, rs_, 1)
+        b.edge(rs_, res, 0)
+        xp, bp = b.write(tmp_path)
+        net = OpenVINONet(xp, bp)
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        np.testing.assert_allclose(net.predict(x),
+                                   x.mean(2).reshape(3, 2), rtol=1e-6)
+
+    def test_unsupported_layer_raises(self, orca_ctx, tmp_path):
+        b = _IRBuilder()
+        inp = b.layer("Parameter", {"shape": "1,4", "element_type": "f32"},
+                      out_shape=(1, 4))
+        bad = b.layer("NonMaxSuppression", None, 1, (1, 4))
+        res = b.layer("Result", None, 1)
+        b.edge(inp, bad, 0)
+        b.edge(bad, res, 0)
+        xp, bp = b.write(tmp_path)
+        net = OpenVINONet(xp, bp, jit=False)
+        with pytest.raises(NotImplementedError, match="NonMaxSuppression"):
+            net.predict(np.zeros((1, 4), np.float32))
+
+    def test_inference_model_load_openvino(self, orca_ctx, tmp_path):
+        """The reference entry point: InferenceModel.load_openvino(xml,
+        bin) then predict (ref inference_model.py:69)."""
+        from analytics_zoo_tpu.inference import InferenceModel
+        rs = np.random.RandomState(2)
+        w1 = rs.randn(5, 7).astype(np.float32)
+        b1 = rs.randn(7).astype(np.float32)
+        w2 = rs.randn(7, 2).astype(np.float32)
+        b2 = rs.randn(2).astype(np.float32)
+        xp, bp = _mlp_ir(w1, b1, w2, b2).write(tmp_path)
+        im = InferenceModel().load_openvino(xp, bp, batch_size=4)
+        x = rs.randn(3, 5).astype(np.float32)
+        out = im.predict(x)
+        assert out.shape == (3, 2)
+        np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-5)
+
+    def test_net_load_openvino_facade(self, orca_ctx, tmp_path):
+        from analytics_zoo_tpu.net import Net
+        rs = np.random.RandomState(3)
+        xp, bp = _mlp_ir(rs.randn(4, 4).astype(np.float32),
+                         np.zeros(4, np.float32),
+                         rs.randn(4, 2).astype(np.float32),
+                         np.zeros(2, np.float32)).write(tmp_path)
+        net = Net.load_openvino(xp, bp)
+        assert net.predict(np.zeros((1, 4), np.float32)).shape == (1, 2)
+
+    def test_batchnorm_opset1_input_order(self, orca_ctx, tmp_path):
+        """opset1 BatchNormInference wires (gamma, beta, data, mean, var)
+        — data is NOT first (the order changed in opset5)."""
+        b = _IRBuilder()
+        inp = b.layer("Parameter", {"shape": "2,3,4,4",
+                                    "element_type": "f32"},
+                      out_shape=(2, 3, 4, 4))
+        rs = np.random.RandomState(4)
+        gamma = rs.rand(3).astype(np.float32) + 0.5
+        beta = rs.randn(3).astype(np.float32)
+        mean = rs.randn(3).astype(np.float32)
+        var = rs.rand(3).astype(np.float32) + 0.5
+        cg, cb2 = b.const(gamma), b.const(beta)
+        cm, cv2 = b.const(mean), b.const(var)
+        bnl = b.layer("BatchNormInference", {"eps": "1e-5"}, 5,
+                      (2, 3, 4, 4), version="opset1")
+        res = b.layer("Result", None, 1)
+        b.edge(cg, bnl, 0)     # opset1: gamma first
+        b.edge(cb2, bnl, 1)
+        b.edge(inp, bnl, 2)    # data third
+        b.edge(cm, bnl, 3)
+        b.edge(cv2, bnl, 4)
+        b.edge(bnl, res, 0)
+        xp, bp = b.write(tmp_path)
+        net = OpenVINONet(xp, bp)
+        x = rs.randn(2, 3, 4, 4).astype(np.float32)
+        sh = (1, 3, 1, 1)
+        want = (x - mean.reshape(sh)) * gamma.reshape(sh) \
+            / np.sqrt(var.reshape(sh) + 1e-5) + beta.reshape(sh)
+        np.testing.assert_allclose(net.predict(x), want, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_multi_input_ir_through_inference_model(self, orca_ctx,
+                                                    tmp_path):
+        """Two Parameter layers: InferenceModel must honor the IR's real
+        input count (tuple inputs reach apply in order)."""
+        from analytics_zoo_tpu.inference import InferenceModel
+        b = _IRBuilder()
+        a = b.layer("Parameter", {"shape": "2,3", "element_type": "f32"},
+                    out_shape=(2, 3))
+        c = b.layer("Parameter", {"shape": "2,3", "element_type": "f32"},
+                    out_shape=(2, 3))
+        add = b.layer("Add", None, 2, (2, 3))
+        res = b.layer("Result", None, 1)
+        b.edge(a, add, 0)
+        b.edge(c, add, 1)
+        b.edge(add, res, 0)
+        xp, bp = b.write(tmp_path)
+        im = InferenceModel().load_openvino(xp, bp)
+        x1 = np.ones((2, 3), np.float32)
+        x2 = np.full((2, 3), 2.0, np.float32)
+        np.testing.assert_allclose(im.predict((x1, x2)),
+                                   np.full((2, 3), 3.0))
+
+    def test_unsqueeze_negative_axes(self, orca_ctx, tmp_path):
+        """Negative Unsqueeze axes index the OUTPUT rank: (3,) with axes
+        [-2,-1] → (3, 1, 1)."""
+        b = _IRBuilder()
+        inp = b.layer("Parameter", {"shape": "3", "element_type": "f32"},
+                      out_shape=(3,))
+        ax = b.const(np.array([-2, -1], np.int64))
+        un = b.layer("Unsqueeze", None, 2, (3, 1, 1))
+        res = b.layer("Result", None, 1)
+        b.edge(inp, un, 0)
+        b.edge(ax, un, 1)
+        b.edge(un, res, 0)
+        xp, bp = b.write(tmp_path)
+        net = OpenVINONet(xp, bp)
+        out = net.predict(np.arange(3, dtype=np.float32))
+        assert out.shape == (3, 1, 1)
