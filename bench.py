@@ -351,10 +351,77 @@ def measure_tcn():
             "tcn_samples_per_sec": round(B / dt, 1)}
 
 
+def _cpu_fallback_line(wedge_note: str):
+    """The wedged backend init holds jax's global backend lock, so no
+    fallback is possible IN-PROCESS — but a fresh subprocess with
+    JAX_PLATFORMS=cpu never touches the accelerator plugin. Run the
+    CPU-feasible benches there so the round's record carries real
+    (clearly labeled) numbers instead of only a 0.0."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_WEDGE_NOTE=wedge_note)
+    # append, don't replace: user-supplied XLA_FLAGS must survive into the
+    # fallback measurement
+    env["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=1").strip()
+    # stdout is reserved for the one JSON line — narrate on stderr so a
+    # harness watching for liveness sees progress during the fallback
+    print("bench: device wedged; running CPU-fallback subprocess "
+          "(bounded at 40 min)...", file=sys.stderr, flush=True)
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--cpu-emit"],
+            capture_output=True, text=True, timeout=2400, env=env)
+        for ln in reversed(r.stdout.strip().splitlines()):
+            if ln.startswith("{"):
+                return ln, None
+        return None, (f"fallback rc={r.returncode}, no JSON line; "
+                      f"stderr tail: {r.stderr[-200:]}")
+    except Exception as e:
+        return None, f"fallback failed: {repr(e)[:200]}"
+
+
+def _assemble_record(out: dict, parts) -> dict:
+    """Shared record assembly: NCF headline fields + secondary parts (one
+    failure must not kill the line) — used by main() and --cpu-emit."""
+    try:
+        res = measure_ncf()
+        out["value"] = round(res["best"], 1)
+        out["vs_baseline"] = round(res["best"] / CPU_BASELINE_SPS, 3)
+        out["ncf_staged_sps"] = round(res["staged"], 1)
+        if res.get("cached"):
+            out["ncf_hbm_cached_sps"] = round(res["cached"], 1)
+    except Exception as e:
+        out["measure_ncf_error"] = repr(e)[:200]
+    for part in parts:
+        try:
+            out.update(part())
+        except Exception as e:
+            out[part.__name__ + "_error"] = repr(e)[:200]
+    return out
+
+
+def _cpu_emit():
+    """--cpu-emit: the watchdog's fallback subprocess. CPU-feasible
+    measurements only (BERT-base per-step time on one CPU core is minutes
+    — skipped with a note)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    note = os.environ.get("BENCH_WEDGE_NOTE", "accelerator unavailable")
+    out = {
+        "metric": "ncf_train_samples_per_sec",
+        "value": 0.0, "unit": "samples/s", "vs_baseline": 0.0,
+        "device": "cpu-fallback",
+        "error": note,
+        "bert_skipped": "BERT-base step takes minutes on one CPU core",
+    }
+    print(json.dumps(_assemble_record(out, (measure_tcn, measure_serving))))
+
+
 def _device_watchdog(timeout_s: float = 180.0):
     """Fail fast if backend init hangs (a wedged axon tunnel makes
-    jax.devices() block forever — better a clear error in the bench record
-    than a driver-side timeout with no output)."""
+    jax.devices() block forever — better a clear record than a driver-side
+    timeout with no output). On a hang, a CPU-fallback subprocess still
+    produces labeled numbers for the record."""
     import threading
     result = {}
 
@@ -371,16 +438,24 @@ def _device_watchdog(timeout_s: float = 180.0):
     if "error" in result:
         raise result["error"]           # fast failure: surface the traceback
     if "devices" not in result:
-        print(json.dumps({
-            "metric": "ncf_train_samples_per_sec", "value": 0.0,
-            "unit": "samples/s", "vs_baseline": 0.0,
-            "error": f"device init did not complete within {timeout_s:.0f}s "
-                     "(accelerator tunnel unresponsive)"}))
+        note = (f"device init did not complete within {timeout_s:.0f}s "
+                "(accelerator tunnel unresponsive); values below are "
+                "CPU-FALLBACK, not chip numbers")
+        line, failure = _cpu_fallback_line(note)
+        if line is None:
+            line = json.dumps({
+                "metric": "ncf_train_samples_per_sec", "value": 0.0,
+                "unit": "samples/s", "vs_baseline": 0.0,
+                "error": f"{note}; {failure}"})
+        print(line)
         sys.stdout.flush()
         os._exit(3)
 
 
 def main():
+    if "--cpu-emit" in sys.argv:
+        _cpu_emit()
+        return
     if "--cpu-baseline" in sys.argv:
         os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "")
         import jax
@@ -399,18 +474,8 @@ def main():
         "vs_baseline": 0.0,
         "device": jax.devices()[0].device_kind,
     }
-    res = measure_ncf()
-    out["value"] = round(res["best"], 1)
-    out["vs_baseline"] = round(res["best"] / CPU_BASELINE_SPS, 3)
-    out["ncf_staged_sps"] = round(res["staged"], 1)
-    if res["cached"]:
-        out["ncf_hbm_cached_sps"] = round(res["cached"], 1)
-    for part in (measure_bert, measure_tcn, measure_serving):
-        try:
-            out.update(part())
-        except Exception as e:  # a secondary bench must not kill the line
-            out[part.__name__ + "_error"] = repr(e)[:200]
-    print(json.dumps(out))
+    print(json.dumps(_assemble_record(
+        out, (measure_bert, measure_tcn, measure_serving))))
 
 
 if __name__ == "__main__":
