@@ -36,9 +36,6 @@ def per_layer_ratios(aspect_ratios, n_layers: int):
     return [tuple(float(r) for r in items)] * n_layers
 
 
-_per_layer_ratios = per_layer_ratios
-
-
 def generate_anchors(feature_map_sizes: Sequence[int],
                      scales: Sequence[float],
                      aspect_ratios=(1.0, 2.0, 0.5)) -> np.ndarray:

@@ -40,12 +40,14 @@ class SSDLite(ZooModel):
         self.image_size = int(image_size)
         self.fm_sizes = [image_size // 8, image_size // 16, image_size // 32]
         # flat (same every scale) or per-layer list of lists (the
-        # reference's per-prior-box-layer ratio configs); normalized once
-        # to plain floats so _config stays JSON-serializable
+        # reference's per-prior-box-layer ratio configs); materialize ONCE
+        # (generators would be consumed) and normalize to plain floats so
+        # _config stays JSON-serializable
+        ratios_in = list(aspect_ratios)
         self.ratios_per_layer = bbox_util.per_layer_ratios(
-            aspect_ratios, len(self.fm_sizes))
-        flat_input = not isinstance(
-            list(aspect_ratios)[0], (list, tuple, np.ndarray))
+            ratios_in, len(self.fm_sizes))
+        flat_input = not (ratios_in and isinstance(
+            ratios_in[0], (list, tuple, np.ndarray)))
         self.aspect_ratios = self.ratios_per_layer[0] if flat_input \
             else [list(r) for r in self.ratios_per_layer]
         self.scales = [0.15, 0.35, 0.6, 0.85]    # len(fm) + 1

@@ -111,12 +111,15 @@ def parse_ir(xml_bytes: bytes, bin_bytes: bytes):
                 if src is not None and src[0] not in seen:
                     stack.append((src[0], False))
 
+    has_results = any(l.type == "Result" for l in layers)
     for l in layers:
         if l.type == "Result":
             visit(l.id)
-    # graphs without Result layers (older IR): visit everything
-    for l in layers:
-        visit(l.id)
+    if not has_results:
+        # graphs without Result layers (older IR): visit everything;
+        # when Results exist, dangling subgraphs stay OUT of the order
+        for l in layers:
+            visit(l.id)
     return order, edges, consts
 
 
@@ -277,6 +280,9 @@ def _apply_layer(l: _Layer, ins: List[Any]):
     if t == "Concat":
         return jnp.concatenate(ins, axis=int(l.attrs.get("axis", 0)))
     if t == "Gather":
+        if int(l.attrs.get("batch_dims", 0)) != 0:
+            raise NotImplementedError(
+                "Gather with batch_dims != 0 not supported")
         axis = int(np.asarray(ins[2]).reshape(())) if len(ins) > 2 \
             else int(l.attrs.get("axis", 0))
         return jnp.take(ins[0], np.asarray(ins[1]).astype(np.int32),

@@ -404,14 +404,19 @@ int main(int argc, char** argv) {
   addr.sin_family = AF_INET;
   addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
   addr.sin_port = htons(static_cast<uint16_t>(port));
-  if (bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
-    perror("bind");
+  auto fail = [&sweeper](const char* what) {
+    perror(what);
+    {
+      std::lock_guard<std::mutex> lk(g_mu);
+      g_shutdown = true;
+    }
+    g_cv.notify_all();
+    sweeper.join();  // a joinable thread's destructor would std::terminate
     return 1;
-  }
-  if (listen(srv, 64) != 0) {
-    perror("listen");
-    return 1;
-  }
+  };
+  if (bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+    return fail("bind");
+  if (listen(srv, 64) != 0) return fail("listen");
   // readiness handshake for the launcher
   fprintf(stdout, "READY %d\n", port);
   fflush(stdout);

@@ -146,11 +146,27 @@ class TCMFForecaster:
             y = y + mini
             self._norm = (m, s, mini)
 
+        # ref fit(val_len=24): the last val_len columns are a holdout —
+        # train without them, score a val_len-step forecast against them
+        holdout = None
+        if val_len:
+            if val_len >= y.shape[1] - 2:
+                raise ValueError(
+                    f"val_len={val_len} leaves too little history "
+                    f"(T={y.shape[1]})")
+            holdout = y[:, -val_len:]
+            y = y[:, :-val_len]
+
         mesh = self._mesh() if distributed else None
         mse = self._run_factorization(y, num_steps, mesh)
         if self.use_local:
             self._fit_local(y, epochs=min(getattr(self, "_local_epochs", 3),
                                           10))
+        if holdout is not None:
+            xf = self._forecast_basis_ar(int(val_len))
+            val_pred = self.F @ xf
+            self.fit_report["val_mse"] = float(
+                np.mean((val_pred - holdout) ** 2))
         return mse
 
     @staticmethod
@@ -413,10 +429,12 @@ class TCMFForecaster:
                  target_covariates=None,
                  num_workers: Optional[int] = None) -> dict:
         """Forecast ``y_true.shape[1]`` steps and score (ref evaluate:
-        target_value's second dim is the horizon)."""
+        target_value's second dim is the horizon; ``target_covariates``
+        are the known future regressors for that window)."""
         from analytics_zoo_tpu.automl.metrics import Evaluator
         y_true, _, _ = _coerce_panel(y_true)
-        pred = self.predict(y_true.shape[1])
+        pred = self.predict(y_true.shape[1],
+                            future_covariates=target_covariates)
         return {m: Evaluator.evaluate(m, y_true, pred) for m in metrics}
 
     def rolling_evaluate(self, y_stream: np.ndarray, horizon: int,

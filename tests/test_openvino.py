@@ -316,3 +316,37 @@ class TestOpenVINOImport:
         net = OpenVINONet(xp, bp)
         out = net.predict(np.arange(3, dtype=np.float32))
         assert out.shape == (3, 1, 1)
+
+    def test_gather_batch_dims_rejected(self, orca_ctx, tmp_path):
+        b = _IRBuilder()
+        inp = b.layer("Parameter", {"shape": "2,4", "element_type": "f32"},
+                      out_shape=(2, 4))
+        idx = b.const(np.array([[0], [1]], np.int64))
+        g = b.layer("Gather", {"batch_dims": "1", "axis": "1"}, 2, (2, 1),
+                    version="opset8")
+        res = b.layer("Result", None, 1)
+        b.edge(inp, g, 0)
+        b.edge(idx, g, 1)
+        b.edge(g, res, 0)
+        xp, bp = b.write(tmp_path)
+        net = OpenVINONet(xp, bp, jit=False)
+        with pytest.raises(NotImplementedError, match="batch_dims"):
+            net.predict(np.zeros((2, 4), np.float32))
+
+    def test_dangling_subgraph_ignored_when_results_exist(self, orca_ctx,
+                                                          tmp_path):
+        """A disconnected unsupported layer must not break a model whose
+        actual outputs are fully supported."""
+        b = _IRBuilder()
+        inp = b.layer("Parameter", {"shape": "2,3", "element_type": "f32"},
+                      out_shape=(2, 3))
+        relu = b.layer("ReLU", None, 1, (2, 3))
+        res = b.layer("Result", None, 1)
+        # dangling: an unsupported layer reachable from NO Result
+        b.layer("NonMaxSuppression", None, 0, (1,))
+        b.edge(inp, relu, 0)
+        b.edge(relu, res, 0)
+        xp, bp = b.write(tmp_path)
+        net = OpenVINONet(xp, bp)
+        x = np.array([[-1.0, 0.0, 2.0]] * 2, np.float32)
+        np.testing.assert_allclose(net.predict(x), np.maximum(x, 0))

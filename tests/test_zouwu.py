@@ -479,3 +479,21 @@ class TestMTNetFidelity:
                             rnn_hid_size=8, cnn_kernel_size=2, dropout=0.1)
         f.fit(xs, ys, epochs=1, batch_size=32)
         assert f.predict(xs[:3]).shape == (3, 2)
+
+
+def test_tcmf_val_len_holdout_and_covariate_evaluate(orca_ctx):
+    """fit(val_len=k) holds the last k columns out of training and scores
+    them (fit_report['val_mse']); evaluate forwards target_covariates to
+    the forecaster."""
+    t_total = 144
+    cov = np.sin(np.arange(t_total) * 2 * np.pi / 12)[None]
+    y = (TestTCMFDistributed._panel(8, t_total, seed=11, k_true=2)
+         + 2.0 * cov).astype(np.float32)
+    m = TCMFForecaster(k=4, ar_order=8)
+    m.fit(y[:, :120], num_steps=300, covariates=cov[:, :120], val_len=24)
+    assert m.X.shape[1] == 96              # holdout removed from training
+    assert np.isfinite(m.fit_report["val_mse"])
+    ev = m.evaluate(y[:, 96:120], target_covariates=cov[:, 96:120])
+    assert np.isfinite(ev["mse"])
+    with pytest.raises(ValueError, match="val_len"):
+        TCMFForecaster(k=2).fit(y[:, :20], val_len=19)
