@@ -4,9 +4,15 @@ Ref: ``pyzoo/zoo/models/image/imageclassification/image_classifier.py``
 (190 LoC) + Scala ``ImageClassifier.scala``/``ImageClassificationConfig``:
 the reference resolves a (model name, dataset) pair to a pretrained BigDL
 graph and a preprocessing config. Here the same surface builds the
-architecture on the TPU keras engine ("lenet", "mobilenet", "resnet-lite",
-"vgg-lite") and trains/predicts through the Estimator; weight loading uses
-the zoo checkpoint format.
+architecture on the TPU keras engine and trains/predicts through the
+Estimator; weight loading uses the zoo checkpoint format.
+
+Two architecture tiers: the FULL reference model set
+(ImageClassificationConfig.scala:33-51 — alexnet, vgg-16/19, resnet-50,
+inception-v1, squeezenet, densenet-121/161, mobilenet-v2; the reference's
+"-quantize"/"-int8" entries are these same graphs executed int8, i.e.
+``InferenceModel.quantize(mode=...)`` here) plus compact "-lite" variants
+(lenet, vgg-lite, mobilenet, resnet-lite) for small inputs.
 """
 
 from __future__ import annotations
@@ -74,8 +80,242 @@ def _resnet_lite(inp, class_num):
     return zl.Dense(class_num, activation="softmax")(h)
 
 
-_ARCHS = {"lenet": _lenet, "vgg-lite": _vgg_lite, "mobilenet": _mobilenet,
-          "resnet-lite": _resnet_lite}
+# ---- full reference topologies (ref ImageClassificationConfig.scala:33-51
+# model set; the "-quantize"/"-int8" variants there are the SAME graphs with
+# int8 execution — here that is InferenceModel.quantize(mode=...), not a
+# separate architecture) ----
+
+def _alexnet(inp, class_num):
+    h = zl.Conv2D(96, 11, 11, subsample=(4, 4), activation="relu",
+                  border_mode="same")(inp)
+    h = zl.LRN2D(alpha=1e-4, beta=0.75, n=5)(h)
+    h = zl.MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(h)
+    h = zl.Conv2D(256, 5, 5, activation="relu", border_mode="same")(h)
+    h = zl.LRN2D(alpha=1e-4, beta=0.75, n=5)(h)
+    h = zl.MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(h)
+    h = zl.Conv2D(384, 3, 3, activation="relu", border_mode="same")(h)
+    h = zl.Conv2D(384, 3, 3, activation="relu", border_mode="same")(h)
+    h = zl.Conv2D(256, 3, 3, activation="relu", border_mode="same")(h)
+    h = zl.MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(h)
+    h = zl.Flatten()(h)
+    h = zl.Dense(4096, activation="relu")(h)
+    h = zl.Dropout(0.5)(h)
+    h = zl.Dense(4096, activation="relu")(h)
+    h = zl.Dropout(0.5)(h)
+    return zl.Dense(class_num, activation="softmax")(h)
+
+
+def _vgg(depth):
+    cfg = {16: (2, 2, 3, 3, 3), 19: (2, 2, 4, 4, 4)}[depth]
+
+    def build(inp, class_num):
+        h = inp
+        for n_convs, filters in zip(cfg, (64, 128, 256, 512, 512)):
+            for _ in range(n_convs):
+                h = zl.Conv2D(filters, 3, 3, activation="relu",
+                              border_mode="same")(h)
+            h = zl.MaxPooling2D((2, 2))(h)
+        h = zl.Flatten()(h)
+        h = zl.Dense(4096, activation="relu")(h)
+        h = zl.Dropout(0.5)(h)
+        h = zl.Dense(4096, activation="relu")(h)
+        h = zl.Dropout(0.5)(h)
+        return zl.Dense(class_num, activation="softmax")(h)
+    return build
+
+
+def _resnet50(inp, class_num):
+    def bottleneck(x, filters, stride, project):
+        y = zl.Conv2D(filters, 1, 1, subsample=(stride, stride),
+                      border_mode="same")(x)
+        y = zl.BatchNormalization()(y)
+        y = zl.Activation("relu")(y)
+        y = zl.Conv2D(filters, 3, 3, border_mode="same")(y)
+        y = zl.BatchNormalization()(y)
+        y = zl.Activation("relu")(y)
+        y = zl.Conv2D(filters * 4, 1, 1, border_mode="same")(y)
+        y = zl.BatchNormalization()(y)
+        shortcut = x
+        if project:
+            shortcut = zl.Conv2D(filters * 4, 1, 1,
+                                 subsample=(stride, stride),
+                                 border_mode="same")(x)
+            shortcut = zl.BatchNormalization()(shortcut)
+        return zl.Activation("relu")(zl.merge([y, shortcut], mode="sum"))
+
+    h = zl.Conv2D(64, 7, 7, subsample=(2, 2), border_mode="same")(inp)
+    h = zl.BatchNormalization()(h)
+    h = zl.Activation("relu")(h)
+    h = zl.MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(h)
+    for stage, (filters, blocks) in enumerate(
+            zip((64, 128, 256, 512), (3, 4, 6, 3))):
+        for i in range(blocks):
+            stride = 2 if (i == 0 and stage > 0) else 1
+            h = bottleneck(h, filters, stride, project=(i == 0))
+    h = zl.GlobalAveragePooling2D()(h)
+    return zl.Dense(class_num, activation="softmax")(h)
+
+
+def _inception_v1(inp, class_num):
+    def module(x, f1, f3r, f3, f5r, f5, pp):
+        b1 = zl.Conv2D(f1, 1, 1, activation="relu", border_mode="same")(x)
+        b3 = zl.Conv2D(f3r, 1, 1, activation="relu", border_mode="same")(x)
+        b3 = zl.Conv2D(f3, 3, 3, activation="relu", border_mode="same")(b3)
+        b5 = zl.Conv2D(f5r, 1, 1, activation="relu", border_mode="same")(x)
+        b5 = zl.Conv2D(f5, 5, 5, activation="relu", border_mode="same")(b5)
+        bp = zl.MaxPooling2D((3, 3), strides=(1, 1),
+                             border_mode="same")(x)
+        bp = zl.Conv2D(pp, 1, 1, activation="relu", border_mode="same")(bp)
+        return zl.merge([b1, b3, b5, bp], mode="concat", concat_axis=-1)
+
+    h = zl.Conv2D(64, 7, 7, subsample=(2, 2), activation="relu",
+                  border_mode="same")(inp)
+    h = zl.MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(h)
+    h = zl.LRN2D()(h)
+    h = zl.Conv2D(64, 1, 1, activation="relu", border_mode="same")(h)
+    h = zl.Conv2D(192, 3, 3, activation="relu", border_mode="same")(h)
+    h = zl.LRN2D()(h)
+    h = zl.MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(h)
+    h = module(h, 64, 96, 128, 16, 32, 32)        # 3a
+    h = module(h, 128, 128, 192, 32, 96, 64)      # 3b
+    h = zl.MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(h)
+    h = module(h, 192, 96, 208, 16, 48, 64)       # 4a
+    h = module(h, 160, 112, 224, 24, 64, 64)      # 4b
+    h = module(h, 128, 128, 256, 24, 64, 64)      # 4c
+    h = module(h, 112, 144, 288, 32, 64, 64)      # 4d
+    h = module(h, 256, 160, 320, 32, 128, 128)    # 4e
+    h = zl.MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(h)
+    h = module(h, 256, 160, 320, 32, 128, 128)    # 5a
+    h = module(h, 384, 192, 384, 48, 128, 128)    # 5b
+    h = zl.GlobalAveragePooling2D()(h)
+    h = zl.Dropout(0.4)(h)
+    return zl.Dense(class_num, activation="softmax")(h)
+
+
+def _squeezenet(inp, class_num):
+    def fire(x, squeeze, expand):
+        s = zl.Conv2D(squeeze, 1, 1, activation="relu",
+                      border_mode="same")(x)
+        e1 = zl.Conv2D(expand, 1, 1, activation="relu",
+                       border_mode="same")(s)
+        e3 = zl.Conv2D(expand, 3, 3, activation="relu",
+                       border_mode="same")(s)
+        return zl.merge([e1, e3], mode="concat", concat_axis=-1)
+
+    h = zl.Conv2D(64, 3, 3, subsample=(2, 2), activation="relu",
+                  border_mode="same")(inp)
+    h = zl.MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(h)
+    h = fire(h, 16, 64)
+    h = fire(h, 16, 64)
+    h = zl.MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(h)
+    h = fire(h, 32, 128)
+    h = fire(h, 32, 128)
+    h = zl.MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(h)
+    h = fire(h, 48, 192)
+    h = fire(h, 48, 192)
+    h = fire(h, 64, 256)
+    h = fire(h, 64, 256)
+    h = zl.Dropout(0.5)(h)
+    h = zl.Conv2D(class_num, 1, 1, activation="relu",
+                  border_mode="same")(h)
+    h = zl.GlobalAveragePooling2D()(h)
+    return zl.Activation("softmax")(h)
+
+
+def _densenet(depth):
+    growth = 48 if depth == 161 else 32
+    blocks = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24)}[depth]
+    init_f = 2 * growth
+
+    def build(inp, class_num):
+        def dense_layer(x):
+            y = zl.BatchNormalization()(x)
+            y = zl.Activation("relu")(y)
+            y = zl.Conv2D(4 * growth, 1, 1, border_mode="same")(y)
+            y = zl.BatchNormalization()(y)
+            y = zl.Activation("relu")(y)
+            y = zl.Conv2D(growth, 3, 3, border_mode="same")(y)
+            return zl.merge([x, y], mode="concat", concat_axis=-1)
+
+        h = zl.Conv2D(init_f, 7, 7, subsample=(2, 2),
+                      border_mode="same")(inp)
+        h = zl.BatchNormalization()(h)
+        h = zl.Activation("relu")(h)
+        h = zl.MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(h)
+        ch = init_f
+        for bi, n_layers in enumerate(blocks):
+            for _ in range(n_layers):
+                h = dense_layer(h)
+                ch += growth
+            if bi < len(blocks) - 1:               # transition, 0.5x
+                ch = ch // 2
+                h = zl.BatchNormalization()(h)
+                h = zl.Activation("relu")(h)
+                h = zl.Conv2D(ch, 1, 1, border_mode="same")(h)
+                h = zl.AveragePooling2D((2, 2))(h)
+        h = zl.BatchNormalization()(h)
+        h = zl.Activation("relu")(h)
+        h = zl.GlobalAveragePooling2D()(h)
+        return zl.Dense(class_num, activation="softmax")(h)
+    return build
+
+
+def _depthwise(ch, stride):
+    """True depthwise 3x3 (no pointwise): flax grouped conv wrapped as a
+    keras layer — SeparableConv2D would fuse a pointwise with no
+    BN/activation between, which is NOT the MobileNetV2 block."""
+    import flax.linen as nn
+    return zl.KerasLayerWrapper(nn.Conv(
+        features=ch, kernel_size=(3, 3), strides=(stride, stride),
+        padding="SAME", feature_group_count=ch))
+
+
+def _mobilenet_v2(inp, class_num):
+    def inverted(x, in_ch, out_ch, stride, expand):
+        hid = in_ch * expand
+        y = x
+        if expand != 1:
+            y = zl.Conv2D(hid, 1, 1, border_mode="same")(y)
+            y = zl.BatchNormalization()(y)
+            y = zl.Activation("relu6")(y)
+        # the canonical block: dw-BN-relu6 then LINEAR 1x1 projection
+        y = _depthwise(hid, stride)(y)
+        y = zl.BatchNormalization()(y)
+        y = zl.Activation("relu6")(y)
+        y = zl.Conv2D(out_ch, 1, 1, border_mode="same")(y)
+        y = zl.BatchNormalization()(y)
+        if stride == 1 and in_ch == out_ch:
+            return zl.merge([x, y], mode="sum")
+        return y
+
+    h = zl.Conv2D(32, 3, 3, subsample=(2, 2), border_mode="same")(inp)
+    h = zl.BatchNormalization()(h)
+    h = zl.Activation("relu6")(h)
+    ch = 32
+    for out_ch, n, stride, expand in ((16, 1, 1, 1), (24, 2, 2, 6),
+                                      (32, 3, 2, 6), (64, 4, 2, 6),
+                                      (96, 3, 1, 6), (160, 3, 2, 6),
+                                      (320, 1, 1, 6)):
+        for i in range(n):
+            h = inverted(h, ch, out_ch, stride if i == 0 else 1, expand)
+            ch = out_ch
+    h = zl.Conv2D(1280, 1, 1, border_mode="same")(h)
+    h = zl.BatchNormalization()(h)
+    h = zl.Activation("relu6")(h)
+    h = zl.GlobalAveragePooling2D()(h)
+    return zl.Dense(class_num, activation="softmax")(h)
+
+
+_ARCHS = {
+    # compact architectures for small inputs
+    "lenet": _lenet, "vgg-lite": _vgg_lite, "mobilenet": _mobilenet,
+    "resnet-lite": _resnet_lite,
+    # the reference model set (ImageClassificationConfig.scala:33-51)
+    "alexnet": _alexnet, "vgg-16": _vgg(16), "vgg-19": _vgg(19),
+    "resnet-50": _resnet50, "inception-v1": _inception_v1,
+    "squeezenet": _squeezenet, "densenet-121": _densenet(121),
+    "densenet-161": _densenet(161), "mobilenet-v2": _mobilenet_v2,
+}
 
 
 @registry.register

@@ -115,9 +115,14 @@ def parse_ir(xml_bytes: bytes, bin_bytes: bytes):
     for l in layers:
         if l.type == "Result":
             visit(l.id)
+    # EVERY declared Parameter stays an input (a Parameter unreachable
+    # from the Results must not change the model's input arity/binding)
+    for l in layers:
+        if l.type == "Parameter":
+            visit(l.id)
     if not has_results:
         # graphs without Result layers (older IR): visit everything;
-        # when Results exist, dangling subgraphs stay OUT of the order
+        # when Results exist, dangling non-Parameter subgraphs stay OUT
         for l in layers:
             visit(l.id)
     return order, edges, consts
@@ -306,7 +311,10 @@ def openvino_to_jax(xml_bytes: bytes, bin_bytes: bytes):
             params[str(lid)] = arr.astype(np.float32) \
                 if arr.dtype == np.float16 else arr
 
-    graph_inputs = [l for l in order if l.type == "Parameter"]
+    # declaration (id) order, not traversal order — positional binding
+    # must follow the IR's declared input order
+    graph_inputs = sorted((l for l in order if l.type == "Parameter"),
+                          key=lambda l: l.id)
     # the closure must NOT pin the host numpy weights (variables carry the
     # live copies) — capture only the ids
     param_ids = list(params)

@@ -138,6 +138,27 @@ class TCMFForecaster:
         self._covariates = (np.asarray(covariates, np.float32)
                             if covariates is not None else None)
 
+        # ref fit(val_len=24): the last val_len columns are a holdout —
+        # split BEFORE normalization (no leakage into the scalers) and
+        # trim the covariates to the training window so the AR design
+        # stays aligned; the held covariates become the validation
+        # forecast's known future regressors
+        holdout = hold_cov = None
+        if val_len:
+            if val_len >= y.shape[1] - 2:
+                raise ValueError(
+                    f"val_len={val_len} leaves too little history "
+                    f"(T={y.shape[1]})")
+            holdout = y[:, -val_len:]
+            y = y[:, :-val_len]
+            if self._covariates is not None:
+                if self._covariates.shape[1] != y.shape[1] + val_len:
+                    raise ValueError(
+                        "covariates must span the same T as the input "
+                        "(incl. the val_len window)")
+                hold_cov = self._covariates[:, -val_len:]
+                self._covariates = self._covariates[:, :-val_len]
+
         if self.normalize:
             m = y.mean(axis=1)
             s = y.std(axis=1) + 1e-8
@@ -146,25 +167,16 @@ class TCMFForecaster:
             y = y + mini
             self._norm = (m, s, mini)
 
-        # ref fit(val_len=24): the last val_len columns are a holdout —
-        # train without them, score a val_len-step forecast against them
-        holdout = None
-        if val_len:
-            if val_len >= y.shape[1] - 2:
-                raise ValueError(
-                    f"val_len={val_len} leaves too little history "
-                    f"(T={y.shape[1]})")
-            holdout = y[:, -val_len:]
-            y = y[:, :-val_len]
-
         mesh = self._mesh() if distributed else None
         mse = self._run_factorization(y, num_steps, mesh)
         if self.use_local:
             self._fit_local(y, epochs=min(getattr(self, "_local_epochs", 3),
                                           10))
         if holdout is not None:
-            xf = self._forecast_basis_ar(int(val_len))
-            val_pred = self.F @ xf
+            # score through predict(): the SAME forecaster configuration
+            # (basis ar/tcn, DeepGLO local residuals, denormalization,
+            # known future covariates) the user will run
+            val_pred = self.predict(int(val_len), future_covariates=hold_cov)
             self.fit_report["val_mse"] = float(
                 np.mean((val_pred - holdout) ** 2))
         return mse

@@ -464,3 +464,78 @@ class TestSSDImageFixture:
         assert sum(len(r) for r in res) >= 3  # 3 gt objects total
         scores = mean_average_precision(res, gtb, gtl, n_classes=1)
         assert scores["mAP"] >= 0.99
+
+
+class TestFullBackbones:
+    """The reference's full image-classification model set
+    (ref ImageClassificationConfig.scala:33-51: alexnet, inception-v1,
+    resnet-50, vgg-16/19, densenet-161, squeezenet, mobilenet(-v2); the
+    -quantize/-int8 variants are the same graphs executed int8 —
+    InferenceModel.quantize here)."""
+
+    NAMES = ["alexnet", "vgg-16", "resnet-50", "inception-v1",
+             "squeezenet", "densenet-121", "mobilenet-v2"]
+
+    @pytest.mark.parametrize("name", NAMES)
+    def test_builds_and_forwards(self, orca_ctx, name):
+        m = ImageClassifier(class_num=7, model_name=name, image_size=64)
+        out = np.asarray(m.predict(np.zeros((2, 64, 64, 3), np.float32),
+                                   distributed=False))
+        assert out.shape == (2, 7)
+        np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)
+
+    def test_resnet50_parameter_count(self, orca_ctx):
+        """Structural sanity: ResNet-50's backbone parameter count is a
+        known quantity (~23.5M + head); a mis-built stage would miss it
+        by millions."""
+        import jax
+        m = ImageClassifier(class_num=10, model_name="resnet-50",
+                            image_size=64)
+        est = m.model._ensure_estimator()
+        n = sum(int(np.prod(np.shape(p)))
+                for p in jax.tree_util.tree_leaves(est.adapter.params))
+        assert 23_000_000 < n < 26_000_000, n
+
+    def test_mobilenet_v2_parameter_count(self, orca_ctx):
+        """The inverted-residual blocks (expand-relu6 -> dw-BN-relu6 ->
+        linear 1x1) must reproduce the canonical ~2.22M backbone params —
+        a fused/activation-less depthwise would miss by hundreds of
+        thousands."""
+        import jax
+        m = ImageClassifier(class_num=5, model_name="mobilenet-v2",
+                            image_size=64)
+        est = m.model._ensure_estimator()
+        n = sum(int(np.prod(np.shape(p)))
+                for p in jax.tree_util.tree_leaves(est.adapter.params))
+        assert 2_100_000 < n < 2_500_000, n
+
+    def test_vgg19_deeper_than_vgg16(self, orca_ctx):
+        import jax
+
+        def count(name):
+            m = ImageClassifier(class_num=5, model_name=name, image_size=64)
+            est = m.model._ensure_estimator()
+            return sum(int(np.prod(np.shape(p)))
+                       for p in jax.tree_util.tree_leaves(est.adapter.params))
+
+        assert count("vgg-19") > count("vgg-16")
+
+    def test_densenet_161_listed(self):
+        from analytics_zoo_tpu.models.image.imageclassification import (
+            image_classifier,
+        )
+        for name in ("densenet-161", "vgg-19"):
+            assert name in image_classifier._ARCHS
+        with pytest.raises(ValueError, match="unknown model_name"):
+            ImageClassifier(class_num=2, model_name="nope")
+
+    def test_save_load_roundtrip_full_arch(self, orca_ctx, tmp_path):
+        m = ImageClassifier(class_num=3, model_name="squeezenet",
+                            image_size=64)
+        x = np.random.RandomState(0).rand(2, 64, 64, 3).astype(np.float32)
+        p1 = np.asarray(m.predict(x, distributed=False))
+        m.save_model(str(tmp_path / "m"))
+        m2 = ZooModel.load_model(str(tmp_path / "m"))
+        np.testing.assert_allclose(
+            np.asarray(m2.predict(x, distributed=False)), p1,
+            rtol=1e-5, atol=1e-6)
