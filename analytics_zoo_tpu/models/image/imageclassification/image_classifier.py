@@ -353,3 +353,79 @@ class ImageClassifier(ZooModel):
     def _config(self):
         return dict(class_num=self.class_num, model_name=self.model_name,
                     image_size=self.image_size, channels=self.channels)
+
+
+# ---- per-model preprocessing configs + labeled output -------------------
+# (ref ImageClassificationConfig.scala ImagenetConfig:62-160: each model
+# name maps to resize→crop→channel-normalize constants; LabelOutput.scala
+# turns predictions into sorted (class name, probability) pairs)
+
+# (resize, crop, mean RGB, scale) per model — the ref's imagenet presets
+PREPROCESS_CONFIGS = {
+    "alexnet": (256, 227, (123.0, 117.0, 104.0), 1.0),
+    "inception-v1": (256, 224, (123.0, 117.0, 104.0), 1.0),
+    "inception-v3": (320, 299, (128.0, 128.0, 128.0), 1.0 / 128.0),
+    "resnet-50": (256, 224, (123.0, 117.0, 104.0), 1.0),
+    "vgg-16": (256, 224, (123.0, 117.0, 104.0), 1.0),
+    "vgg-19": (256, 224, (123.0, 117.0, 104.0), 1.0),
+    "densenet-121": (256, 224, (123.0, 117.0, 104.0), 0.017),
+    "densenet-161": (256, 224, (123.0, 117.0, 104.0), 0.017),
+    "squeezenet": (256, 227, (123.0, 117.0, 104.0), 1.0),
+    "mobilenet": (256, 224, (123.68, 116.78, 103.94), 0.017),
+    "mobilenet-v2": (256, 224, (123.68, 116.78, 103.94), 0.017),
+}
+
+
+def preprocessor(model_name: str):
+    """The reference's per-model imagenet pipeline
+    (ImagenetConfig.commonPreprocessor): resize → center crop →
+    channel-mean subtract (+ scale). Returns a ChainedPreprocessing to run
+    over ImageFeature dicts."""
+    from analytics_zoo_tpu.feature.image import (
+        ChainedPreprocessing, ImageCenterCrop, ImageChannelScaledNormalizer,
+        ImageMatToTensor, ImageResize,
+    )
+    if model_name not in PREPROCESS_CONFIGS:
+        raise ValueError(f"no preprocessing preset for {model_name!r}; "
+                         f"have {sorted(PREPROCESS_CONFIGS)}")
+    resize, crop, mean, scale = PREPROCESS_CONFIGS[model_name]
+    return ChainedPreprocessing([
+        ImageResize(resize, resize),
+        ImageCenterCrop(crop, crop),
+        # (x - mean) * scale — the ref's commonPreprocessor semantics
+        ImageChannelScaledNormalizer(*mean, scale),
+        ImageMatToTensor(),
+    ])
+
+
+class LabelOutput:
+    """Prediction tensor → class names + probabilities, sorted descending
+    (ref LabelOutput.scala: labelMap, clses/probs keys, optional softmax
+    when the output is not already a distribution)."""
+
+    def __init__(self, label_map, clses: str = "classes",
+                 probs: str = "probs", prob_as_output: bool = True):
+        self.label_map = dict(label_map)
+        self.clses, self.probs = clses, probs
+        self.prob_as_output = bool(prob_as_output)
+
+    def __call__(self, predictions: np.ndarray, top_k: int = None):
+        """[b, C] predictions → list of {clses: [names...], probs:
+        [values...]} dicts, sorted by probability descending."""
+        preds = np.asarray(predictions)
+        if preds.ndim == 1:
+            preds = preds[None]
+        if not self.prob_as_output:
+            e = np.exp(preds - preds.max(axis=-1, keepdims=True))
+            preds = e / e.sum(axis=-1, keepdims=True)
+        out = []
+        for row in preds:
+            order = np.argsort(-row)
+            if top_k:
+                order = order[:top_k]
+            out.append({
+                self.clses: [self.label_map.get(int(i), str(int(i)))
+                             for i in order],
+                self.probs: row[order].astype(np.float32),
+            })
+        return out

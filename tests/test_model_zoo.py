@@ -539,3 +539,52 @@ class TestFullBackbones:
         np.testing.assert_allclose(
             np.asarray(m2.predict(x, distributed=False)), p1,
             rtol=1e-5, atol=1e-6)
+
+
+class TestLabelOutputAndPreprocess:
+    """Per-model preprocessing presets + labeled output (ref
+    ImagenetConfig:62-160 + LabelOutput.scala)."""
+
+    def test_preprocessor_pipeline(self):
+        from analytics_zoo_tpu.models.image.imageclassification import (
+            image_classifier as ic,
+        )
+        pipe = ic.preprocessor("resnet-50")
+        img = (np.random.RandomState(0).rand(300, 280, 3) * 255
+               ).astype(np.uint8)
+        out = pipe.transform({"image": img})["image"]
+        assert out.shape == (224, 224, 3)
+        assert out.dtype == np.float32
+        # mean-subtracted: values centered near zero, not 0..255
+        assert abs(float(out.mean())) < 40.0
+        with pytest.raises(ValueError, match="no preprocessing preset"):
+            ic.preprocessor("lenet")
+        # alexnet/squeezenet use the 227 crop (ref Consts)
+        assert ic.preprocessor("alexnet").transform(
+            {"image": img})["image"].shape == (227, 227, 3)
+        # scaled presets MULTIPLY by scale ((x-mean)*0.017 lands ~[-3, 3];
+        # dividing by the scale would be thousands of times larger)
+        dense = ic.preprocessor("densenet-121").transform(
+            {"image": img})["image"]
+        assert float(np.abs(dense).max()) < 5.0
+        iv3 = ic.preprocessor("inception-v3").transform(
+            {"image": img})["image"]
+        assert iv3.shape == (299, 299, 3)
+        assert float(np.abs(iv3).max()) <= 1.01
+
+    def test_label_output_sorting_and_softmax(self):
+        from analytics_zoo_tpu.models.image.imageclassification import (
+            image_classifier as ic,
+        )
+        label_map = {0: "cat", 1: "dog", 2: "fish"}
+        lo = ic.LabelOutput(label_map)
+        res = lo(np.array([[0.2, 0.7, 0.1]]))
+        assert res[0]["classes"] == ["dog", "cat", "fish"]
+        np.testing.assert_allclose(res[0]["probs"], [0.7, 0.2, 0.1])
+        # logits path applies softmax first
+        lo2 = ic.LabelOutput(label_map, prob_as_output=False)
+        res2 = lo2(np.array([[1.0, 3.0, 0.0]]), top_k=2)
+        assert res2[0]["classes"][0] == "dog"
+        assert len(res2[0]["probs"]) == 2
+        assert float(np.sum(lo2(np.array([[1.0, 3.0, 0.0]]))[0]["probs"])) \
+            == pytest.approx(1.0)
