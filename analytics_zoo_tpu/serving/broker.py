@@ -223,6 +223,33 @@ class _PyState:
         if not h:
             self.hashes.pop(key, None)
 
+    def evict_some(self, key: str, limit: int = 8):
+        """Amortized eviction for the HSET hot path: check only the
+        oldest `limit` fields (dict order = write order, so the head of
+        hash_times is the oldest). A full-key scan here would make every
+        write O(live fields) exactly when the consumer is slow — the
+        scenario TTL exists for; the periodic sweeper keeps the overall
+        memory bound. Caller holds the lock."""
+        if self.hash_ttl_ms <= 0:
+            return
+        times = self.hash_times.get(key)
+        if not times:
+            return
+        now_ms = time.monotonic() * 1000
+        h = self.hashes.get(key, {})
+        expired = []
+        for field, t in times.items():
+            if len(expired) >= limit or now_ms - t < self.hash_ttl_ms:
+                break  # ordered by write time: first live field ends it
+            expired.append(field)
+        for field in expired:
+            times.pop(field, None)
+            h.pop(field, None)
+        if not times:
+            self.hash_times.pop(key, None)
+        if not h:
+            self.hashes.pop(key, None)
+
     def field_expired(self, key: str, field: str) -> bool:
         """O(1) single-field expiry check (the HGET hot path must not scan
         the whole key). Deletes the field when expired. Caller holds the
@@ -365,11 +392,19 @@ class _PyHandler(socketserver.StreamRequestHandler):
                 w.write(f":{n}\n".encode())
             elif cmd == "HSET" and len(p) >= 4:
                 with state.cv:
-                    state.evict_expired(p[1])  # writers pay for cleanup
+                    # bounded amortized cleanup (full scan would be O(live
+                    # fields) per write under a slow consumer)
+                    state.evict_some(p[1])
                     state.hashes.setdefault(p[1], {})[p[2]] = p[3]
                     if state.hash_ttl_ms > 0:
-                        state.hash_times.setdefault(
-                            p[1], {})[p[2]] = time.monotonic() * 1000
+                        ht = state.hash_times.setdefault(p[1], {})
+                        # move-to-end on rewrite: evict_some's head scan
+                        # relies on dict order == write order, but a plain
+                        # assignment keeps a rewritten key at its ORIGINAL
+                        # position, where its fresh timestamp would block
+                        # eviction of everything behind it forever
+                        ht.pop(p[2], None)
+                        ht[p[2]] = time.monotonic() * 1000
                     state.cv.notify_all()
                 w.write(b"+OK\n")
             elif cmd == "HGET" and len(p) >= 3:

@@ -42,6 +42,7 @@
 #include <condition_variable>
 #include <cstdio>
 #include <cstring>
+#include <deque>
 #include <map>
 #include <mutex>
 #include <set>
@@ -86,6 +87,12 @@ std::map<std::string, std::map<std::string, std::string>> g_hashes;
 // forever if a client never collects (TTL eviction bounds broker memory;
 // Redis gets this from EXPIRE, ref serving keeps results in a Redis hash)
 std::map<std::string, std::map<std::string, long long>> g_hash_times;
+// write-order FIFO per key: g_hash_times is name-ordered, so bounding the
+// HSET-path eviction to the OLDEST fields needs a separate queue. Entries
+// for fields already evicted (or since rewritten) are skipped on pop via
+// a timestamp match against g_hash_times.
+std::map<std::string,
+         std::deque<std::pair<std::string, long long>>> g_hash_fifo;
 long long g_hash_ttl_ms = 600000;  // 0 disables
 bool g_shutdown = false;
 int g_srv_fd = -1;
@@ -104,7 +111,52 @@ void EvictExpired(const std::string& key, long long now_ms) {
       ++it;
     }
   }
-  if (t->second.empty()) g_hash_times.erase(t);
+  if (t->second.empty()) {
+    g_hash_times.erase(t);
+    g_hash_fifo.erase(key);  // all fields gone -> queue is all stale
+  }
+  if (h != g_hashes.end() && h->second.empty()) g_hashes.erase(h);
+}
+
+// Amortized eviction for the HSET hot path: pop at most `limit` expired
+// entries off the key's write-order FIFO. A full-key scan here is
+// O(live fields) per write exactly when the result consumer is slow —
+// the scenario TTL exists for; the ttl/4 sweeper bounds memory anyway.
+// Caller holds g_mu.
+void EvictSome(const std::string& key, long long now_ms, int limit) {
+  if (g_hash_ttl_ms <= 0) return;
+  auto q = g_hash_fifo.find(key);
+  if (q == g_hash_fifo.end()) return;
+  auto t = g_hash_times.find(key);
+  auto h = g_hashes.find(key);
+  int n = 0;
+  while (!q->second.empty() && n < limit) {
+    auto& front = q->second.front();
+    bool current = false;
+    if (t != g_hash_times.end()) {
+      auto ft = t->second.find(front.first);
+      // the queue entry is the field's CURRENT write only if the
+      // timestamps match — otherwise it's a tombstone (field HDEL'd by
+      // the consumer, or rewritten with a later queue entry covering it)
+      current = ft != t->second.end() && ft->second == front.second;
+    }
+    if (!current) {
+      // tombstones pop regardless of age: under a healthy
+      // write-then-HDEL serving flow nearly every entry becomes one,
+      // and keeping them for the full TTL would hold O(rate x TTL)
+      // memory that the pre-FIFO implementation never did
+      q->second.pop_front();
+      ++n;
+      continue;
+    }
+    if (now_ms - front.second < g_hash_ttl_ms) break;  // oldest is live
+    t->second.erase(front.first);
+    if (h != g_hashes.end()) h->second.erase(front.first);
+    q->second.pop_front();
+    ++n;
+  }
+  if (q->second.empty()) g_hash_fifo.erase(q);
+  if (t != g_hash_times.end() && t->second.empty()) g_hash_times.erase(t);
   if (h != g_hashes.end() && h->second.empty()) g_hashes.erase(h);
 }
 
@@ -315,9 +367,13 @@ void HandleConn(int fd) {
       {
         std::lock_guard<std::mutex> lk(g_mu);
         long long now_ms = NowMs();
-        EvictExpired(p[1], now_ms);  // amortized: writers pay for cleanup
+        EvictSome(p[1], now_ms, 8);  // bounded: full scan is O(live
+                                     // fields) under a slow consumer
         g_hashes[p[1]][p[2]] = p[3];
-        if (g_hash_ttl_ms > 0) g_hash_times[p[1]][p[2]] = now_ms;
+        if (g_hash_ttl_ms > 0) {
+          g_hash_times[p[1]][p[2]] = now_ms;
+          g_hash_fifo[p[1]].emplace_back(p[2], now_ms);
+        }
       }
       g_cv.notify_all();
       SendAll(fd, "+OK\n");
@@ -375,6 +431,7 @@ void HandleConn(int fd) {
         g_streams.erase(p[1]);
         g_hashes.erase(p[1]);
         g_hash_times.erase(p[1]);
+        g_hash_fifo.erase(p[1]);
       }
       SendAll(fd, "+OK\n");
     } else {

@@ -143,14 +143,38 @@ class MTNetForecaster(Forecaster):
                  ar_window: int = 4,
                  cnn_dropout: Optional[float] = None,
                  rnn_dropout: Optional[float] = None,
-                 # earlier spellings
-                 long_series_num: int = 4, series_length: int = 8,
+                 # earlier spellings (None = not passed, so a legacy-alias
+                 # call is detectable)
+                 long_series_num: Optional[int] = None,
+                 series_length: Optional[int] = None,
                  rnn_hid_size: Optional[int] = None,
-                 cnn_kernel_size: int = 3, dropout: float = 0.1,
+                 cnn_kernel_size: Optional[int] = None,
+                 dropout: Optional[float] = None,
                  **kwargs):
         super().__init__(**kwargs)
+        legacy_call = any(v is not None for v in (
+            long_series_num, series_length, cnn_kernel_size, dropout,
+            rnn_hid_size))
         if rnn_hid_sizes is None:
-            rnn_hid_sizes = (rnn_hid_size,) if rnn_hid_size else (16, 32)
+            if rnn_hid_size:
+                rnn_hid_sizes = (rnn_hid_size,)
+            elif legacy_call:
+                # a legacy-alias caller that never chose an RNN size gets
+                # the pre-round-4 single 32-unit GRU: the stacked (16, 32)
+                # default changes the param-tree shape, so old scripts
+                # would silently train a different architecture and old
+                # checkpoints would fail to restore
+                rnn_hid_sizes = (32,)
+            else:
+                rnn_hid_sizes = (16, 32)   # MTNet_keras.py apply_config
+        if long_series_num is None:
+            long_series_num = 4
+        if series_length is None:
+            series_length = 8
+        if cnn_kernel_size is None:
+            cnn_kernel_size = 3
+        if dropout is None:
+            dropout = 0.1
         self.kw = dict(
             output_dim=future_seq_len,
             long_num=long_num if long_num is not None else long_series_num,

@@ -63,6 +63,20 @@ def _coerce_panel(x):
     return np.asarray(x, np.float32), None, False
 
 
+def _time_features(idx) -> np.ndarray:
+    """[4, T] calendar regressors from a DatetimeIndex, each normalized
+    to [-0.5, 0.5] (the ref's use_time path derives hour/weekday/day/
+    month features from dti/start_date+freq for the temporal net)."""
+    import pandas as pd
+    idx = pd.DatetimeIndex(idx)
+    return np.stack([
+        idx.hour.to_numpy() / 23.0 - 0.5,
+        idx.dayofweek.to_numpy() / 6.0 - 0.5,
+        (idx.day.to_numpy() - 1) / 30.0 - 0.5,
+        (idx.month.to_numpy() - 1) / 11.0 - 0.5,
+    ]).astype(np.float32)
+
+
 class TCMFForecaster:
     """fit(x) → predict(horizon) (ref tcmf_forecaster.py TCMFForecaster).
 
@@ -97,6 +111,9 @@ class TCMFForecaster:
         self._local = None
         self._norm = None                      # (mean, std, mini)
         self._covariates = None
+        self._time_feats = None                # [4, T] calendar regressors
+        self._dti_last = None                  # last training timestamp
+        self._dti_freq = None                  # pandas freq string
         self._was_xshards = False
         self.fit_report: dict = {}
 
@@ -113,7 +130,10 @@ class TCMFForecaster:
         ``init_FX_epoch + alt_iters * max_FX_epoch`` (DeepGLO.py train_all:
         initial joint fit, then ``alt_iters`` alternating rounds of
         ``max_FX_epoch`` each); ``y_iters``/``max_TCN_epoch`` set the local
-        residual net's epochs when ``use_local=True``. Unknown kwargs
+        residual net's epochs when ``use_local=True``. ``dti`` (or
+        ``start_date``+``freq``) derives calendar regressors
+        (hour/weekday/day/month) entering the AR basis design; predict
+        extends them into the future automatically. Unknown kwargs
         raise.
         """
         known = {"max_FX_epoch", "init_FX_epoch", "alt_iters", "y_iters",
@@ -138,6 +158,36 @@ class TCMFForecaster:
         self._covariates = (np.asarray(covariates, np.float32)
                             if covariates is not None else None)
 
+        # dti / start_date+freq → calendar regressors entering the AR
+        # basis design (ref DeepGLO use_time: datetime features derived
+        # from the index become temporal-net covariates). Future values
+        # are deterministic, so predict() extends them automatically.
+        # Reset first: a refit without dti must not keep the previous
+        # fit's calendar state (misaligned with the new X).
+        self._time_feats = self._dti_last = self._dti_freq = None
+        dti = ref_kwargs.get("dti")
+        if dti is None and ref_kwargs.get("start_date") is not None:
+            import pandas as pd
+            dti = pd.date_range(ref_kwargs["start_date"],
+                                periods=y.shape[1],
+                                freq=ref_kwargs.get("freq", "D"))
+        if dti is not None:
+            import pandas as pd
+            dti = pd.DatetimeIndex(dti)
+            if len(dti) != y.shape[1]:
+                raise ValueError(
+                    f"dti length {len(dti)} must match T={y.shape[1]}")
+            freq = (dti.freqstr or ref_kwargs.get("freq")
+                    or pd.infer_freq(dti))
+            if freq is None:
+                raise ValueError(
+                    "dti has no inferable frequency (irregular index); "
+                    "pass freq=... so predict() can extend the calendar "
+                    "features correctly")
+            self._dti_freq = freq
+            self._time_feats = _time_features(dti)
+            self._dti_last = dti[-1]
+
         # ref fit(val_len=24): the last val_len columns are a holdout —
         # split BEFORE normalization (no leakage into the scalers) and
         # trim the covariates to the training window so the AR design
@@ -158,6 +208,11 @@ class TCMFForecaster:
                         "(incl. the val_len window)")
                 hold_cov = self._covariates[:, -val_len:]
                 self._covariates = self._covariates[:, :-val_len]
+            if self._time_feats is not None:
+                # predict(val_len) re-derives the holdout stamps from
+                # _dti_last + freq, so only the training slice is kept
+                self._time_feats = self._time_feats[:, :-val_len]
+                self._dti_last = dti[y.shape[1] - 1]
 
         if self.normalize:
             m = y.mean(axis=1)
@@ -326,6 +381,14 @@ class TCMFForecaster:
                     f"got {cov_incr.shape}")
             self._covariates = np.concatenate(
                 [self._covariates, cov_incr], axis=1)
+        if self._time_feats is not None:
+            import pandas as pd
+            new_idx = pd.date_range(self._dti_last,
+                                    periods=y_new.shape[1] + 1,
+                                    freq=self._dti_freq)[1:]
+            self._time_feats = np.concatenate(
+                [self._time_feats, _time_features(new_idx)], axis=1)
+            self._dti_last = new_idx[-1]
         if self._norm is not None:
             m, s, mini = self._norm
             y_new = (y_new - m[:, None]) / s[:, None] + mini
@@ -351,6 +414,9 @@ class TCMFForecaster:
         if self._covariates is not None:
             for cov in self._covariates:
                 cols.append(cov[start:t])
+        if self._time_feats is not None:
+            for tf in self._time_feats:
+                cols.append(tf[start:t])
         cols.append(np.ones(t - start))
         return np.stack(cols, 1), row[start:]
 
@@ -377,6 +443,13 @@ class TCMFForecaster:
                     f"got {fc.shape}")
         else:
             fc = None
+        ftf = None
+        if self._time_feats is not None:
+            import pandas as pd
+            future_idx = pd.date_range(self._dti_last,
+                                       periods=horizon + 1,
+                                       freq=self._dti_freq)[1:]
+            ftf = _time_features(future_idx)
         futures = []
         for row in self.X:
             design, target = self._basis_design(row, p, per)
@@ -392,6 +465,8 @@ class TCMFForecaster:
                         feats.extend(fc[:, h])
                     else:  # future values unknown: hold last observed
                         feats.extend(c[-1] for c in self._covariates)
+                if ftf is not None:
+                    feats.extend(ftf[:, h])
                 feats.append(1.0)
                 nxt = float(np.dot(coef, feats))
                 out.append(nxt)
@@ -450,26 +525,45 @@ class TCMFForecaster:
         return {m: Evaluator.evaluate(m, y_true, pred) for m in metrics}
 
     def rolling_evaluate(self, y_stream: np.ndarray, horizon: int,
-                         metrics=("mse",)) -> list:
+                         metrics=("mse",), covariates=None) -> list:
         """Rolling-origin evaluation over a stream of future observations
         (the scale path the reference runs over Ray workers: repeatedly
         forecast ``horizon`` steps, then absorb the actuals via
         ``fit_incremental`` and roll forward). Returns one metrics dict
-        per origin, each tagged with its start offset."""
+        per origin, each tagged with its start offset.
+
+        ``covariates`` [r, y_stream.shape[1]]: future regressor values
+        aligned with ``y_stream``; required when the model was fitted
+        with covariates (each window is sliced for
+        ``predict(future_covariates=...)`` and
+        ``fit_incremental(covariates_incr=...)``)."""
         from analytics_zoo_tpu.automl.metrics import Evaluator
         y_stream, _, _ = _coerce_panel(y_stream)
         n, total = y_stream.shape
         if self.F is None:
             raise RuntimeError("call fit first")
         assert n == self.F.shape[0], "series count mismatch"
+        if self._covariates is not None and covariates is None:
+            raise ValueError(
+                "model was fitted with covariates; rolling_evaluate needs "
+                "covariates [r, y_stream_len] aligned with y_stream")
+        cov = None
+        if covariates is not None:
+            cov = np.asarray(covariates, np.float32)
+            if cov.shape[1] != total:
+                raise ValueError(
+                    f"covariates second dim {cov.shape[1]} must match "
+                    f"y_stream length {total}")
         results = []
         for start in range(0, total - horizon + 1, horizon):
             chunk = y_stream[:, start:start + horizon]
-            pred = self.predict(horizon)
+            cov_chunk = (cov[:, start:start + horizon]
+                         if cov is not None else None)
+            pred = self.predict(horizon, future_covariates=cov_chunk)
             scores = {m: Evaluator.evaluate(m, chunk, pred) for m in metrics}
             scores["origin"] = start
             results.append(scores)
-            self.fit_incremental(chunk)
+            self.fit_incremental(chunk, covariates_incr=cov_chunk)
         return results
 
     def is_xshards_distributed(self) -> bool:
@@ -486,6 +580,8 @@ class TCMFForecaster:
                           norm_mini=np.float32(self._norm[2]))
         if self._covariates is not None:
             arrays["covariates"] = self._covariates
+        if self._time_feats is not None:
+            arrays["time_feats"] = self._time_feats
         if self.use_local and self._local is not None:
             arrays["resid_hist"] = self._resid_hist
             self._local.save(os.path.join(path, "local_tcn"))
@@ -497,7 +593,10 @@ class TCMFForecaster:
                    local_lookback=self.local_lookback,
                    normalize=self.normalize, svd=self.svd,
                    period=self.period, seed=self.seed,
-                   was_xshards=self._was_xshards)
+                   was_xshards=self._was_xshards,
+                   dti_last=(str(self._dti_last)
+                             if self._dti_last is not None else None),
+                   dti_freq=self._dti_freq)
         with open(os.path.join(path, "tcmf_config.json"), "w") as f:
             json.dump(cfg, f)
 
@@ -507,7 +606,13 @@ class TCMFForecaster:
         with open(os.path.join(path, "tcmf_config.json")) as f:
             cfg = json.load(f)
         was_xshards = cfg.pop("was_xshards", False)
+        dti_last = cfg.pop("dti_last", None)
+        dti_freq = cfg.pop("dti_freq", None)
         model = cls(**cfg)
+        if dti_last is not None:
+            import pandas as pd
+            model._dti_last = pd.Timestamp(dti_last)
+            model._dti_freq = dti_freq
         data = np.load(os.path.join(path, "tcmf_factors.npz"))
         model.F = data["F"]
         model.X = data["X"]
@@ -515,6 +620,8 @@ class TCMFForecaster:
             model._norm = (data["norm_m"], data["norm_s"],
                            float(data["norm_mini"]))
         model._covariates = data["covariates"] if "covariates" in data \
+            else None
+        model._time_feats = data["time_feats"] if "time_feats" in data \
             else None
         if "resid_hist" in data:
             from analytics_zoo_tpu.zouwu.model.forecast import TCNForecaster

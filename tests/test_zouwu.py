@@ -497,3 +497,76 @@ def test_tcmf_val_len_holdout_and_covariate_evaluate(orca_ctx):
     assert np.isfinite(ev["mse"])
     with pytest.raises(ValueError, match="val_len"):
         TCMFForecaster(k=2).fit(y[:, :20], val_len=19)
+
+
+def test_tcmf_rolling_evaluate_with_covariates(orca_ctx):
+    """A covariate-fitted model is usable in rolling_evaluate: each
+    origin's covariate window feeds predict(future_covariates=...) and
+    fit_incremental(covariates_incr=...); omitting covariates raises."""
+    t_total = 168
+    cov = np.sin(np.arange(t_total) * 2 * np.pi / 12)[None]
+    y = (TestTCMFDistributed._panel(8, t_total, seed=3, k_true=2)
+         + 2.0 * cov).astype(np.float32)
+    m = TCMFForecaster(k=4, ar_order=8)
+    m.fit(y[:, :96], num_steps=300, covariates=cov[:, :96])
+    t0 = m.X.shape[1]
+    res = m.rolling_evaluate(y[:, 96:144], horizon=24,
+                             covariates=cov[:, 96:144])
+    assert [r["origin"] for r in res] == [0, 24]
+    assert all(np.isfinite(r["mse"]) for r in res)
+    assert m.X.shape[1] == t0 + 48
+    m2 = TCMFForecaster(k=4, ar_order=8)
+    m2.fit(y[:, :96], num_steps=100, covariates=cov[:, :96])
+    with pytest.raises(ValueError, match="covariates"):
+        m2.rolling_evaluate(y[:, 96:144], horizon=24)
+
+
+def test_tcmf_datetime_features(orca_ctx, tmp_path):
+    """start_date/freq (or dti) derive calendar regressors that improve a
+    weekday-pattern panel; predict extends them automatically, and they
+    survive save/load and fit_incremental."""
+    t_total = 7 * 40                         # 40 weeks daily
+    dow = np.arange(t_total) % 7
+    pattern = np.where(dow >= 5, 3.0, 0.0)   # weekend lift
+    base = TestTCMFDistributed._panel(6, t_total, seed=9, k_true=2)
+    y = (base + pattern[None]).astype(np.float32)
+    m_dt = TCMFForecaster(k=4, ar_order=3, seed=1)
+    m_dt.fit(y[:, :252], num_steps=300, start_date="2020-01-06", freq="D")
+    assert m_dt._time_feats is not None and m_dt._time_feats.shape == (4, 252)
+    m_plain = TCMFForecaster(k=4, ar_order=3, seed=1)
+    m_plain.fit(y[:, :252], num_steps=300)
+    target = y[:, 252:280]
+    mse_dt = float(np.mean((m_dt.predict(28) - target) ** 2))
+    mse_plain = float(np.mean((m_plain.predict(28) - target) ** 2))
+    assert mse_dt < mse_plain, (mse_dt, mse_plain)
+    # save/load keeps the calendar state; fit_incremental extends it
+    p = str(tmp_path / "tcmf_dt")
+    m_dt.save(p)
+    m2 = TCMFForecaster.load(p)
+    np.testing.assert_allclose(m2.predict(28), m_dt.predict(28), rtol=1e-5)
+    m2.fit_incremental(y[:, 252:266])
+    assert m2._time_feats.shape == (4, 266)
+    assert np.isfinite(m2.predict(7)).all()
+    # explicit dti path + length validation
+    import pandas as pd
+    with pytest.raises(ValueError, match="dti length"):
+        TCMFForecaster(k=2).fit(
+            y[:, :50], num_steps=50,
+            dti=pd.date_range("2020-01-06", periods=49, freq="D"))
+
+
+def test_mtnet_legacy_alias_keeps_single_gru(orca_ctx):
+    """Explicit legacy-alias calls default to the pre-round-4 single
+    32-unit GRU (param tree unchanged → old checkpoints restore); pure
+    ref-name or default calls get the ref's stacked (16, 32)."""
+    from analytics_zoo_tpu.zouwu.model.forecast import MTNetForecaster
+    legacy = MTNetForecaster(future_seq_len=1, series_length=6,
+                             long_series_num=3)
+    assert legacy.kw["rnn_hid_sizes"] == (32,)
+    ref_style = MTNetForecaster(future_seq_len=1, time_step=6, long_num=3)
+    assert ref_style.kw["rnn_hid_sizes"] == (16, 32)
+    default = MTNetForecaster(future_seq_len=1)
+    assert default.kw["rnn_hid_sizes"] == (16, 32)
+    explicit = MTNetForecaster(future_seq_len=1, series_length=6,
+                               rnn_hid_size=8)
+    assert explicit.kw["rnn_hid_sizes"] == (8,)
