@@ -370,6 +370,17 @@ def _pair(v):
     return (v, v) if isinstance(v, int) else tuple(v)
 
 
+def _padding_2d(border_mode):
+    """"same"/"valid", or an int / (ph, pw) pair for explicit SYMMETRIC
+    zero padding. Explicit padding matters for torch-weight parity: XLA
+    SAME pads asymmetrically (low side gets less) for stride>1, while
+    torch/Caffe convs pad symmetrically — same shapes, different outputs."""
+    if isinstance(border_mode, str):
+        return border_mode.upper()
+    p = _pair(border_mode)
+    return ((int(p[0]), int(p[0])), (int(p[1]), int(p[1])))
+
+
 class Conv1D(KerasLayer):
     """(ref Convolution1D) input [batch, steps, channels]."""
 
@@ -405,7 +416,7 @@ class Conv2D(KerasLayer):
     """(ref Convolution2D) input [batch, h, w, channels] (channels-last)."""
 
     def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
-                 activation=None, border_mode: str = "valid",
+                 activation=None, border_mode="valid",
                  subsample=(1, 1), init="glorot_uniform", bias: bool = True,
                  W_regularizer=None, b_regularizer=None,
                  input_shape=None, name=None):
@@ -413,7 +424,7 @@ class Conv2D(KerasLayer):
         self.nb_filter = nb_filter
         self.kernel = (nb_row, nb_col)
         self.activation = get_activation(activation)
-        self.padding = border_mode.upper()
+        self.padding = _padding_2d(border_mode)
         self.strides = _pair(subsample)
         self._set_regularizers(W_regularizer, b_regularizer)
         self.init = get_init(init)
@@ -487,7 +498,15 @@ class _Pool(KerasLayer):
         super().__init__(name, input_shape)
         self.pool_size = pool_size
         self.strides = strides or pool_size
-        self.padding = border_mode.upper()
+        if isinstance(border_mode, str):
+            self.padding = border_mode.upper()
+        else:
+            # explicit symmetric padding (reduce_window pads max-pool
+            # windows with -inf, avg-pool with zeros counted in the mean —
+            # torch MaxPool2d / AvgPool2d(count_include_pad=True) parity)
+            p = (border_mode if isinstance(border_mode, (tuple, list))
+                 else (border_mode,) * len(self.pool_size))
+            self.padding = tuple((int(v), int(v)) for v in p)
 
 
 class MaxPooling1D(_Pool):

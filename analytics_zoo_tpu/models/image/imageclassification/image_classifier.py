@@ -13,6 +13,14 @@ inception-v1, squeezenet, densenet-121/161, mobilenet-v2; the reference's
 "-quantize"/"-int8" entries are these same graphs executed int8, i.e.
 ``InferenceModel.quantize(mode=...)`` here) plus compact "-lite" variants
 (lenet, vgg-lite, mobilenet, resnet-lite) for small inputs.
+
+The full-size architectures follow the torchvision layouts exactly
+(explicit symmetric padding, bias-free convs where torchvision's are,
+BN eps 1e-5) so that torchvision-format pretrained ``state_dict``s import
+losslessly via ``models/migration_image.py`` — the TPU-era replacement
+for the ref's downloadable BigDL artifacts (``Net.scala:446`` loadModel;
+per-model pretrained configs in ``ImageClassifier.scala``). Construct with
+``ImageClassifier(..., pretrained=state_dict_or_path)``.
 """
 
 from __future__ import annotations
@@ -86,22 +94,24 @@ def _resnet_lite(inp, class_num):
 # separate architecture) ----
 
 def _alexnet(inp, class_num):
-    h = zl.Conv2D(96, 11, 11, subsample=(4, 4), activation="relu",
-                  border_mode="same")(inp)
-    h = zl.LRN2D(alpha=1e-4, beta=0.75, n=5)(h)
-    h = zl.MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(h)
-    h = zl.Conv2D(256, 5, 5, activation="relu", border_mode="same")(h)
-    h = zl.LRN2D(alpha=1e-4, beta=0.75, n=5)(h)
-    h = zl.MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(h)
-    h = zl.Conv2D(384, 3, 3, activation="relu", border_mode="same")(h)
-    h = zl.Conv2D(384, 3, 3, activation="relu", border_mode="same")(h)
-    h = zl.Conv2D(256, 3, 3, activation="relu", border_mode="same")(h)
-    h = zl.MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(h)
+    # torchvision AlexNet layout (the living pretrained-weight source the
+    # importer in models/migration_image.py maps onto — the ref's Caffe
+    # alexnet artifacts are a dead format, VERDICT missing #5): explicit
+    # symmetric padding, no LRN.
+    h = zl.Conv2D(64, 11, 11, subsample=(4, 4), activation="relu",
+                  border_mode=2)(inp)
+    h = zl.MaxPooling2D((3, 3), strides=(2, 2))(h)
+    h = zl.Conv2D(192, 5, 5, activation="relu", border_mode=2)(h)
+    h = zl.MaxPooling2D((3, 3), strides=(2, 2))(h)
+    h = zl.Conv2D(384, 3, 3, activation="relu", border_mode=1)(h)
+    h = zl.Conv2D(256, 3, 3, activation="relu", border_mode=1)(h)
+    h = zl.Conv2D(256, 3, 3, activation="relu", border_mode=1)(h)
+    h = zl.MaxPooling2D((3, 3), strides=(2, 2))(h)
     h = zl.Flatten()(h)
-    h = zl.Dense(4096, activation="relu")(h)
     h = zl.Dropout(0.5)(h)
     h = zl.Dense(4096, activation="relu")(h)
     h = zl.Dropout(0.5)(h)
+    h = zl.Dense(4096, activation="relu")(h)
     return zl.Dense(class_num, activation="softmax")(h)
 
 
@@ -125,28 +135,33 @@ def _vgg(depth):
 
 
 def _resnet50(inp, class_num):
+    # torchvision ResNet-50 (v1.5: the stride-2 sits on the 3x3 conv2,
+    # not conv1) with explicit symmetric padding — exact weight-import
+    # target for models/migration_image.py.
     def bottleneck(x, filters, stride, project):
-        y = zl.Conv2D(filters, 1, 1, subsample=(stride, stride),
-                      border_mode="same")(x)
-        y = zl.BatchNormalization()(y)
+        y = zl.Conv2D(filters, 1, 1, bias=False)(x)
+        y = zl.BatchNormalization(epsilon=1e-5, momentum=0.9)(y)
         y = zl.Activation("relu")(y)
-        y = zl.Conv2D(filters, 3, 3, border_mode="same")(y)
-        y = zl.BatchNormalization()(y)
+        y = zl.Conv2D(filters, 3, 3, subsample=(stride, stride),
+                      border_mode=1, bias=False)(y)
+        y = zl.BatchNormalization(epsilon=1e-5, momentum=0.9)(y)
         y = zl.Activation("relu")(y)
-        y = zl.Conv2D(filters * 4, 1, 1, border_mode="same")(y)
-        y = zl.BatchNormalization()(y)
+        y = zl.Conv2D(filters * 4, 1, 1, bias=False)(y)
+        y = zl.BatchNormalization(epsilon=1e-5, momentum=0.9)(y)
         shortcut = x
         if project:
             shortcut = zl.Conv2D(filters * 4, 1, 1,
                                  subsample=(stride, stride),
-                                 border_mode="same")(x)
-            shortcut = zl.BatchNormalization()(shortcut)
+                                 bias=False)(x)
+            shortcut = zl.BatchNormalization(epsilon=1e-5,
+                                             momentum=0.9)(shortcut)
         return zl.Activation("relu")(zl.merge([y, shortcut], mode="sum"))
 
-    h = zl.Conv2D(64, 7, 7, subsample=(2, 2), border_mode="same")(inp)
-    h = zl.BatchNormalization()(h)
+    h = zl.Conv2D(64, 7, 7, subsample=(2, 2), border_mode=3,
+                  bias=False)(inp)
+    h = zl.BatchNormalization(epsilon=1e-5, momentum=0.9)(h)
     h = zl.Activation("relu")(h)
-    h = zl.MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(h)
+    h = zl.MaxPooling2D((3, 3), strides=(2, 2), border_mode=1)(h)
     for stage, (filters, blocks) in enumerate(
             zip((64, 128, 256, 512), (3, 4, 6, 3))):
         for i in range(blocks):
@@ -193,31 +208,30 @@ def _inception_v1(inp, class_num):
 
 
 def _squeezenet(inp, class_num):
+    # torchvision SqueezeNet 1.1 (the weight-import target): unpadded
+    # stride-2 stem + valid 3x3 pools, fires at (16,64)x2 / (32,128)x2 /
+    # (48,192)x2 + (64,256)x2, conv classifier head.
     def fire(x, squeeze, expand):
-        s = zl.Conv2D(squeeze, 1, 1, activation="relu",
-                      border_mode="same")(x)
-        e1 = zl.Conv2D(expand, 1, 1, activation="relu",
-                       border_mode="same")(s)
+        s = zl.Conv2D(squeeze, 1, 1, activation="relu")(x)
+        e1 = zl.Conv2D(expand, 1, 1, activation="relu")(s)
         e3 = zl.Conv2D(expand, 3, 3, activation="relu",
-                       border_mode="same")(s)
+                       border_mode=1)(s)
         return zl.merge([e1, e3], mode="concat", concat_axis=-1)
 
-    h = zl.Conv2D(64, 3, 3, subsample=(2, 2), activation="relu",
-                  border_mode="same")(inp)
-    h = zl.MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(h)
+    h = zl.Conv2D(64, 3, 3, subsample=(2, 2), activation="relu")(inp)
+    h = zl.MaxPooling2D((3, 3), strides=(2, 2))(h)
     h = fire(h, 16, 64)
     h = fire(h, 16, 64)
-    h = zl.MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(h)
+    h = zl.MaxPooling2D((3, 3), strides=(2, 2))(h)
     h = fire(h, 32, 128)
     h = fire(h, 32, 128)
-    h = zl.MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(h)
+    h = zl.MaxPooling2D((3, 3), strides=(2, 2))(h)
     h = fire(h, 48, 192)
     h = fire(h, 48, 192)
     h = fire(h, 64, 256)
     h = fire(h, 64, 256)
     h = zl.Dropout(0.5)(h)
-    h = zl.Conv2D(class_num, 1, 1, activation="relu",
-                  border_mode="same")(h)
+    h = zl.Conv2D(class_num, 1, 1, activation="relu")(h)
     h = zl.GlobalAveragePooling2D()(h)
     return zl.Activation("softmax")(h)
 
@@ -228,20 +242,25 @@ def _densenet(depth):
     init_f = 2 * growth
 
     def build(inp, class_num):
+        # torchvision DenseNet layout (weight-import target): BN eps 1e-5,
+        # bias-free convs, explicit symmetric stem padding.
+        def bn(x):
+            return zl.BatchNormalization(epsilon=1e-5, momentum=0.9)(x)
+
         def dense_layer(x):
-            y = zl.BatchNormalization()(x)
+            y = bn(x)
             y = zl.Activation("relu")(y)
-            y = zl.Conv2D(4 * growth, 1, 1, border_mode="same")(y)
-            y = zl.BatchNormalization()(y)
+            y = zl.Conv2D(4 * growth, 1, 1, bias=False)(y)
+            y = bn(y)
             y = zl.Activation("relu")(y)
-            y = zl.Conv2D(growth, 3, 3, border_mode="same")(y)
+            y = zl.Conv2D(growth, 3, 3, border_mode=1, bias=False)(y)
             return zl.merge([x, y], mode="concat", concat_axis=-1)
 
-        h = zl.Conv2D(init_f, 7, 7, subsample=(2, 2),
-                      border_mode="same")(inp)
-        h = zl.BatchNormalization()(h)
+        h = zl.Conv2D(init_f, 7, 7, subsample=(2, 2), border_mode=3,
+                      bias=False)(inp)
+        h = bn(h)
         h = zl.Activation("relu")(h)
-        h = zl.MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(h)
+        h = zl.MaxPooling2D((3, 3), strides=(2, 2), border_mode=1)(h)
         ch = init_f
         for bi, n_layers in enumerate(blocks):
             for _ in range(n_layers):
@@ -249,11 +268,11 @@ def _densenet(depth):
                 ch += growth
             if bi < len(blocks) - 1:               # transition, 0.5x
                 ch = ch // 2
-                h = zl.BatchNormalization()(h)
+                h = bn(h)
                 h = zl.Activation("relu")(h)
-                h = zl.Conv2D(ch, 1, 1, border_mode="same")(h)
+                h = zl.Conv2D(ch, 1, 1, bias=False)(h)
                 h = zl.AveragePooling2D((2, 2))(h)
-        h = zl.BatchNormalization()(h)
+        h = bn(h)
         h = zl.Activation("relu")(h)
         h = zl.GlobalAveragePooling2D()(h)
         return zl.Dense(class_num, activation="softmax")(h)
@@ -263,33 +282,40 @@ def _densenet(depth):
 def _depthwise(ch, stride):
     """True depthwise 3x3 (no pointwise): flax grouped conv wrapped as a
     keras layer — SeparableConv2D would fuse a pointwise with no
-    BN/activation between, which is NOT the MobileNetV2 block."""
+    BN/activation between, which is NOT the MobileNetV2 block. Explicit
+    pad 1 (not SAME) for torch-weight parity at stride 2."""
     import flax.linen as nn
     return zl.KerasLayerWrapper(nn.Conv(
         features=ch, kernel_size=(3, 3), strides=(stride, stride),
-        padding="SAME", feature_group_count=ch))
+        padding=((1, 1), (1, 1)), feature_group_count=ch, use_bias=False))
 
 
 def _mobilenet_v2(inp, class_num):
+    # torchvision MobileNetV2 (weight-import target): bias-free convs +
+    # BN eps 1e-5, explicit pad 1 on spatial convs, dropout-0.2 head.
+    def bn(x):
+        return zl.BatchNormalization(epsilon=1e-5, momentum=0.9)(x)
+
     def inverted(x, in_ch, out_ch, stride, expand):
         hid = in_ch * expand
         y = x
         if expand != 1:
-            y = zl.Conv2D(hid, 1, 1, border_mode="same")(y)
-            y = zl.BatchNormalization()(y)
+            y = zl.Conv2D(hid, 1, 1, bias=False)(y)
+            y = bn(y)
             y = zl.Activation("relu6")(y)
         # the canonical block: dw-BN-relu6 then LINEAR 1x1 projection
         y = _depthwise(hid, stride)(y)
-        y = zl.BatchNormalization()(y)
+        y = bn(y)
         y = zl.Activation("relu6")(y)
-        y = zl.Conv2D(out_ch, 1, 1, border_mode="same")(y)
-        y = zl.BatchNormalization()(y)
+        y = zl.Conv2D(out_ch, 1, 1, bias=False)(y)
+        y = bn(y)
         if stride == 1 and in_ch == out_ch:
             return zl.merge([x, y], mode="sum")
         return y
 
-    h = zl.Conv2D(32, 3, 3, subsample=(2, 2), border_mode="same")(inp)
-    h = zl.BatchNormalization()(h)
+    h = zl.Conv2D(32, 3, 3, subsample=(2, 2), border_mode=1,
+                  bias=False)(inp)
+    h = bn(h)
     h = zl.Activation("relu6")(h)
     ch = 32
     for out_ch, n, stride, expand in ((16, 1, 1, 1), (24, 2, 2, 6),
@@ -299,10 +325,11 @@ def _mobilenet_v2(inp, class_num):
         for i in range(n):
             h = inverted(h, ch, out_ch, stride if i == 0 else 1, expand)
             ch = out_ch
-    h = zl.Conv2D(1280, 1, 1, border_mode="same")(h)
-    h = zl.BatchNormalization()(h)
+    h = zl.Conv2D(1280, 1, 1, bias=False)(h)
+    h = bn(h)
     h = zl.Activation("relu6")(h)
     h = zl.GlobalAveragePooling2D()(h)
+    h = zl.Dropout(0.2)(h)
     return zl.Dense(class_num, activation="softmax")(h)
 
 
@@ -324,7 +351,8 @@ class ImageClassifier(ZooModel):
     predict over arrays or an ImageSet)"""
 
     def __init__(self, class_num: int, model_name: str = "resnet-lite",
-                 image_size: int = 224, channels: int = 3):
+                 image_size: int = 224, channels: int = 3,
+                 pretrained=None):
         super().__init__()
         if model_name not in _ARCHS:
             raise ValueError(
@@ -334,6 +362,14 @@ class ImageClassifier(ZooModel):
         self.image_size = int(image_size)
         self.channels = int(channels)
         self.model = self.build_model()
+        if pretrained is not None:
+            # torchvision-format state_dict (dict, torch module, or path
+            # to a torch.save file) — the TPU-era replacement for the
+            # ref's downloadable BigDL artifacts (Net.scala:446)
+            from analytics_zoo_tpu.models.migration_image import (
+                import_image_classifier_from_torch,
+            )
+            import_image_classifier_from_torch(self, pretrained)
 
     def build_model(self):
         inp = Input(shape=(self.image_size, self.image_size, self.channels))
@@ -376,18 +412,42 @@ PREPROCESS_CONFIGS = {
 }
 
 
-def preprocessor(model_name: str):
+def preprocessor(model_name: str, source: str = "imagenet"):
     """The reference's per-model imagenet pipeline
     (ImagenetConfig.commonPreprocessor): resize → center crop →
     channel-mean subtract (+ scale). Returns a ChainedPreprocessing to run
-    over ImageFeature dicts."""
+    over ImageFeature dicts.
+
+    ``source="torchvision"``: the normalization trained into torchvision
+    checkpoints (x/255, then per-channel mean (0.485, 0.456, 0.406) / std
+    (0.229, 0.224, 0.225)) — use this with
+    ``ImageClassifier(pretrained=...)`` weights."""
     from analytics_zoo_tpu.feature.image import (
-        ChainedPreprocessing, ImageCenterCrop, ImageChannelScaledNormalizer,
+        ChainedPreprocessing, ImageAspectScale, ImageCenterCrop,
+        ImageChannelNormalize, ImageChannelScaledNormalizer,
         ImageMatToTensor, ImageResize,
     )
+    if source not in ("imagenet", "torchvision"):
+        raise ValueError(f"unknown preprocessing source {source!r}; "
+                         f"use 'imagenet' or 'torchvision'")
     if model_name not in PREPROCESS_CONFIGS:
         raise ValueError(f"no preprocessing preset for {model_name!r}; "
                          f"have {sorted(PREPROCESS_CONFIGS)}")
+    if source == "torchvision":
+        crop = 224
+        # torchvision eval pipeline: SHORT EDGE to 256 keeping aspect
+        # (a square resize would distort non-square photos and break
+        # checkpoint parity), center crop 224, then the normalization
+        # trained into the checkpoints: (x - 255*m) / (255*s) is
+        # normalize(x/255)
+        norm = ImageChannelNormalize(
+            255 * 0.485, 255 * 0.456, 255 * 0.406,
+            255 * 0.229, 255 * 0.224, 255 * 0.225)
+        return ChainedPreprocessing([
+            ImageAspectScale(256, max_size=10_000),
+            ImageCenterCrop(crop, crop),
+            norm, ImageMatToTensor(),
+        ])
     resize, crop, mean, scale = PREPROCESS_CONFIGS[model_name]
     return ChainedPreprocessing([
         ImageResize(resize, resize),

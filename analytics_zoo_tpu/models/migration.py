@@ -27,13 +27,19 @@ def _np(t):
                       else t, np.float32)
 
 
-def assign_layer_params(net, updates: Dict[str, Dict[str, np.ndarray]]):
+def assign_layer_params(net, updates: Dict[str, Dict[str, np.ndarray]],
+                        state_updates: Dict[str, Dict[str, np.ndarray]]
+                        = None):
     """Overwrite named entries of a KerasNet's parameter tree.
 
     ``updates``: {layer_name: {param_key: array}} — layer names are the
     model's canonical names (user-chosen or ``type_index`` in topo order),
     param keys are the flax collection keys ("kernel"/"bias"/"embedding").
     Shapes must match the initialized tree exactly.
+
+    ``state_updates``: same structure for the ``batch_stats`` collection
+    ("mean"/"var") — how pretrained BatchNorm running statistics land
+    (they live in the model state, not the trainable params).
     """
     est = net._ensure_estimator()
     if est._state is not None:
@@ -59,6 +65,25 @@ def assign_layer_params(net, updates: Dict[str, Dict[str, np.ndarray]]):
                     f"{lname}/{key}: shape {arr.shape} != model {cur}")
             params[lname][key] = arr
     est.adapter.params = params
+    if state_updates:
+        stats = {k: dict(v) for k, v in
+                 est.adapter.model_state.get("batch_stats", {}).items()}
+        for lname, entries in state_updates.items():
+            if lname not in stats:
+                raise KeyError(f"layer {lname!r} has no batch_stats "
+                               f"(have {sorted(stats)})")
+            for key, arr in entries.items():
+                if key not in stats[lname]:
+                    raise KeyError(f"{lname} batch_stats has no {key!r} "
+                                   f"(have {sorted(stats[lname])})")
+                cur = np.shape(stats[lname][key])
+                arr = np.asarray(arr, np.float32)
+                if tuple(cur) != arr.shape:
+                    raise ValueError(f"{lname}/batch_stats/{key}: shape "
+                                     f"{arr.shape} != model {cur}")
+                stats[lname][key] = arr
+        est.adapter.model_state = {**est.adapter.model_state,
+                                   "batch_stats": stats}
     est._state = None  # re-materialize device state from the new params
     est._predict_fn = None
     return net
