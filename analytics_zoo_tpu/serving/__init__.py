@@ -1,8 +1,8 @@
 from analytics_zoo_tpu.serving.broker import Broker, BrokerClient
 from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
 from analytics_zoo_tpu.serving.config import ServingConfig
-from analytics_zoo_tpu.serving.engine import ClusterServing
+from analytics_zoo_tpu.serving.engine import ClusterServing, image_pipeline
 from analytics_zoo_tpu.serving.frontend import FrontEnd
 
 __all__ = ["Broker", "BrokerClient", "InputQueue", "OutputQueue",
-           "ServingConfig", "ClusterServing", "FrontEnd"]
+           "ServingConfig", "ClusterServing", "FrontEnd", "image_pipeline"]

@@ -29,20 +29,56 @@ class InputQueue:
         self.stream = stream
         self.cipher = cipher
 
+    @staticmethod
+    def _coerce(v):
+        """ndarray (incl. string tensors) passes through; raw encoded
+        image bytes become an ImageBytes entry — decoded and preprocessed
+        ENGINE-side, like the reference client's image enqueue
+        (client.py:144 b64-encodes the file's bytes; the server decodes in
+        PreProcessing.scala:67-90). File paths go through
+        ``enqueue_image`` — a blanket str->open() here would break string
+        tensors and read arbitrary local files."""
+        if isinstance(v, schema.ImageBytes):
+            return v
+        if isinstance(v, (bytes, bytearray)):
+            return schema.ImageBytes(bytes(v))
+        return np.asarray(v)
+
     def _encode(self, uri: Optional[str], inputs: Dict) -> "tuple[str, str]":
         if not inputs:
             raise ValueError("enqueue needs at least one named tensor")
         uri = schema.validate_uri(uri or uuid.uuid4().hex)
         payload = schema.encode_record(
-            uri, {k: np.asarray(v) for k, v in inputs.items()}, self.cipher)
+            uri, {k: self._coerce(v) for k, v in inputs.items()},
+            self.cipher)
         return uri, payload
 
     def enqueue(self, uri: Optional[str] = None, **inputs) -> str:
         """``enqueue("img1", x=ndarray)``; returns the uri (generated when
-        not given). Multi-input models pass several named tensors."""
+        not given). Multi-input models pass several named tensors.
+        ``enqueue("img1", image=jpeg_bytes)`` sends the raw encoded image
+        for engine-side decode + preprocessing (``enqueue_image`` for
+        file paths)."""
         uri, payload = self._encode(uri, inputs)
         self._client.xadd(self.stream, payload)
         return uri
+
+    def enqueue_image(self, uri: Optional[str] = None, image=None,
+                      key: str = "image") -> str:
+        """Enqueue one raw encoded image — bytes or a path to a
+        jpeg/png file (the reference client's image enqueue takes local
+        file uris, client.py:144). The ENGINE decodes and runs the
+        configured preprocessing chain."""
+        if isinstance(image, str):
+            with open(image, "rb") as f:
+                image = f.read()
+        if not isinstance(image, (bytes, bytearray, schema.ImageBytes)):
+            raise TypeError("enqueue_image takes encoded image bytes or "
+                            "a file path")
+        return self.enqueue(uri, **{key: schema.ImageBytes(bytes(image))
+                                    if not isinstance(image,
+                                                      schema.ImageBytes)
+                                    else image})
 
     def enqueue_batch(self, records) -> "list[str]":
         """Enqueue many records in pipelined socket writes — the high-

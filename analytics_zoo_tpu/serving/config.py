@@ -71,6 +71,15 @@ class ServingConfig:
     record_encrypted: bool = False
     stream: str = "serving_stream"
     result_key: str = "result"
+    # engine-side raw-image preprocessing (ref PreProcessing.scala is
+    # driven by the serving config the same way): either a model-zoo
+    # preset name, or explicit resize/crop/mean/scale
+    image_preset: Optional[str] = None
+    image_source: str = "imagenet"
+    image_resize: Optional[int] = None
+    image_crop: Optional[int] = None
+    image_mean: Optional[tuple] = None
+    image_scale: float = 1.0
 
     @classmethod
     def load(cls, path: str) -> "ServingConfig":
@@ -78,9 +87,13 @@ class ServingConfig:
         model = raw.get("model", {}) or {}
         data = raw.get("data", {}) or {}
         params = raw.get("params", {}) or {}
+        pre = raw.get("preprocessing", {}) or {}
         src = (data.get("src") or
                f"{cls.broker_host}:{cls.broker_port}")
         host, _, port = str(src).partition(":")
+        mean = pre.get("mean")
+        if isinstance(mean, str):
+            mean = tuple(float(v) for v in mean.split(","))
         return cls(
             model_path=model.get("path", "") or "",
             broker_host=host or "127.0.0.1",
@@ -88,4 +101,38 @@ class ServingConfig:
             batch_size=int(params.get("batch_size", 8) or 8),
             record_encrypted=bool(data.get("record_encrypted", False)),
             stream=data.get("stream", "serving_stream") or "serving_stream",
-            result_key=data.get("result_key", "result") or "result")
+            result_key=data.get("result_key", "result") or "result",
+            image_preset=pre.get("preset") or None,
+            image_source=pre.get("source", "imagenet") or "imagenet",
+            image_resize=(int(pre["resize"]) if pre.get("resize")
+                          else None),
+            image_crop=int(pre["crop"]) if pre.get("crop") else None,
+            image_mean=mean,
+            image_scale=float(pre.get("scale", 1.0) or 1.0))
+
+    def build_image_preprocess(self):
+        """The engine's raw-image chain from this config, or None when no
+        ``preprocessing:`` section was given."""
+        if self.image_preset:
+            from analytics_zoo_tpu.serving.engine import image_pipeline
+            return image_pipeline(self.image_preset,
+                                  source=self.image_source)
+        if not (self.image_resize or self.image_crop or self.image_mean
+                or self.image_scale != 1.0):
+            return None
+        from analytics_zoo_tpu.feature.image import (
+            ChainedPreprocessing, ImageCenterCrop,
+            ImageChannelScaledNormalizer, ImageMatToTensor, ImageResize,
+        )
+        steps = []
+        if self.image_resize:
+            steps.append(ImageResize(self.image_resize, self.image_resize))
+        if self.image_crop:
+            steps.append(ImageCenterCrop(self.image_crop, self.image_crop))
+        if self.image_mean or self.image_scale != 1.0:
+            mean = self.image_mean or (0.0, 0.0, 0.0)
+            steps.append(ImageChannelScaledNormalizer(
+                *mean, self.image_scale))
+        steps.append(ImageMatToTensor())
+        from analytics_zoo_tpu.serving.engine import ndarray_chain
+        return ndarray_chain(ChainedPreprocessing(steps))

@@ -53,12 +53,41 @@ class StageTimer:
             return out
 
 
+def ndarray_chain(pipe):
+    """Wrap a ChainedPreprocessing over ImageFeature dicts as a plain
+    ndarray -> ndarray callable (the engine's ``image_preprocess``
+    contract). One definition — the config-driven and preset-driven paths
+    must not drift."""
+    def run(arr):
+        return pipe.transform({"image": np.asarray(arr, np.float32)}
+                              )["image"]
+    return run
+
+
+def image_pipeline(model_name: str, source: str = "imagenet"):
+    """ndarray -> ndarray preprocessing chain from the model zoo's
+    per-model presets (ref ImagenetConfig preprocessors feeding
+    PreProcessing.scala) — pass as ``ClusterServing(image_preprocess=)``.
+    ``source="torchvision"`` selects the normalization trained into
+    torchvision checkpoints (use with ``ImageClassifier(pretrained=)``)."""
+    from analytics_zoo_tpu.models.image.imageclassification. \
+        image_classifier import preprocessor
+    return ndarray_chain(preprocessor(model_name, source=source))
+
+
 class ClusterServing:
     """The serving job (ref ClusterServing.scala:31).
 
     ``model``: an InferenceModel (already loaded). ``input_cols``: the order
     in which record tensors feed the model's inputs (single-input models
     take the record's only tensor).
+
+    ``image_preprocess``: ndarray -> ndarray chain applied to records that
+    arrive as raw encoded images (``InputQueue.enqueue(uri, image=bytes)``)
+    after the engine decodes them — the reference's server-side
+    decode-and-preprocess flow (PreProcessing.scala:36,67-90). Build one
+    from a preset with ``image_pipeline("resnet-50", source=...)`` or wire
+    it from config.yaml's ``preprocessing:`` section.
     """
 
     def __init__(self, model, broker_port: int, batch_size: int = 8,
@@ -68,7 +97,8 @@ class ClusterServing:
                  cipher: schema.Cipher = None,
                  postprocess=None, block_ms: int = 50,
                  claim_min_idle_ms: int = 30000,
-                 broker_host: str = "127.0.0.1"):
+                 broker_host: str = "127.0.0.1",
+                 image_preprocess=None):
         self.model = model
         self.batch_size = int(batch_size)
         self.broker_host = broker_host
@@ -78,6 +108,7 @@ class ClusterServing:
         self.input_cols = input_cols
         self.cipher = cipher
         self.postprocess = postprocess
+        self.image_preprocess = image_preprocess
         self.block_ms = block_ms
         self.claim_min_idle_ms = int(claim_min_idle_ms)
         # claim at most ~1/s — recovery is a rare path, the hot read loop
@@ -88,6 +119,25 @@ class ClusterServing:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.records_out = 0
+
+    def _decode_images(self, inputs):
+        """Decode any raw-image entries and run the preprocessing chain
+        (ref PreProcessing.scala:67-90: bytes -> mat -> configured
+        resize/crop/normalize -> tensor)."""
+        out = {}
+        for k, v in inputs.items():
+            if isinstance(v, schema.ImageBytes):
+                import io
+
+                from PIL import Image
+                arr = np.asarray(
+                    Image.open(io.BytesIO(v.data)).convert("RGB"),
+                    np.float32)
+                if self.image_preprocess is not None:
+                    arr = self.image_preprocess(arr)
+                v = np.asarray(arr, np.float32)
+            out[k] = v
+        return out
 
     # --------------------------------------------------------------- loop
     def _serve_once(self, client: BrokerClient) -> int:
@@ -119,11 +169,21 @@ class ClusterServing:
             try:
                 uri, inputs = schema.decode_record(payload, self.cipher)
                 schema.validate_uri(uri)
-                uris.append(uri)
-                rows.append(inputs)
             except Exception as e:
                 logger.warning("dropping undecodable record %s: %s", eid, e)
                 client.xack(self.stream, self.group, eid)
+                continue
+            try:
+                inputs = self._decode_images(inputs)
+            except Exception as e:
+                # the uri is known: the client gets a real error result
+                # (ref stores per-record errors the same way)
+                client.hset(self.result_key, uri,
+                            schema.encode_error(
+                                f"image decode failed: {e}", self.cipher))
+                continue
+            uris.append(uri)
+            rows.append(inputs)
         if rows:
             # batch by the MAJORITY shape signature — a single malformed
             # leading record must not reject the whole batch

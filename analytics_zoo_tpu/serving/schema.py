@@ -34,6 +34,18 @@ class ServingError(RuntimeError):
     """An error result stored in place of a prediction."""
 
 
+class ImageBytes:
+    """Raw encoded image (JPEG/PNG) riding a record — decoded and run
+    through the engine-side preprocessing chain, exactly the reference's
+    serving flow (client.py:144 enqueues b64 image bytes; the JVM decodes
+    and preprocesses in PreProcessing.scala:67-90)."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: bytes):
+        self.data = bytes(data)
+
+
 def validate_uri(uri: str) -> str:
     if not _URI_RE.match(uri or ""):
         raise ValueError(
@@ -41,13 +53,17 @@ def validate_uri(uri: str) -> str:
     return uri
 
 
-def encode_tensor(arr: np.ndarray) -> dict:
+def encode_tensor(arr) -> dict:
+    if isinstance(arr, ImageBytes):
+        return {"image": base64.b64encode(arr.data).decode()}
     arr = np.ascontiguousarray(arr)
     return {"dtype": arr.dtype.str, "shape": list(arr.shape),
             "data": base64.b64encode(arr.tobytes()).decode()}
 
 
-def decode_tensor(obj: dict) -> np.ndarray:
+def decode_tensor(obj: dict):
+    if "image" in obj:
+        return ImageBytes(base64.b64decode(obj["image"]))
     raw = base64.b64decode(obj["data"])
     return np.frombuffer(raw, dtype=np.dtype(obj["dtype"])).reshape(
         obj["shape"]).copy()
@@ -57,7 +73,8 @@ def encode_record(uri: str, inputs: Dict[str, np.ndarray],
                   cipher: Cipher = None) -> str:
     body = json.dumps(
         {"uri": uri,
-         "inputs": {k: encode_tensor(np.asarray(v))
+         "inputs": {k: encode_tensor(v if isinstance(v, ImageBytes)
+                                     else np.asarray(v))
                     for k, v in inputs.items()}}).encode()
     if cipher is not None:
         body = cipher[0](body)

@@ -24,8 +24,9 @@ def main(config_path: str = "config.yaml") -> int:
         # reference Redis semantics: data.src names an EXISTING shared
         # broker — connect, don't launch a shadow one
         b_host, b_port = cfg.broker_host, cfg.broker_port
-    serving = ClusterServing(model, b_port, batch_size=cfg.batch_size,
-                             broker_host=b_host).start()
+    serving = ClusterServing(
+        model, b_port, batch_size=cfg.batch_size, broker_host=b_host,
+        image_preprocess=cfg.build_image_preprocess()).start()
     front = FrontEnd(broker_port=b_port, broker_host=b_host,
                      host=os.environ.get("BIND_HOST", "0.0.0.0"),
                      port=int(os.environ.get("HTTP_PORT", "8080"))).start()

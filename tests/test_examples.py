@@ -18,7 +18,8 @@ ALL = ["recommendation_ncf.py", "anomaly_detection.py",
        "sentiment_analysis.py", "vae.py", "fraud_detection.py",
        "image_similarity.py", "wide_and_deep.py", "object_detection.py",
        "image_augmentation.py", "model_inference.py",
-       "automl_hp_search.py", "qa_ranker.py", "multihost_launch.py"]
+       "automl_hp_search.py", "qa_ranker.py", "multihost_launch.py",
+       "image_classification_serving.py"]
 
 
 @pytest.mark.parametrize("script", ALL)

@@ -572,3 +572,135 @@ class TestContainerEntrypoint:
                 proc.wait(timeout=30)
             except subprocess.TimeoutExpired:
                 proc.kill()
+
+
+class TestImageInput:
+    """Raw-image serving path (ref PreProcessing.scala:36,67-90 +
+    client.py:144: the client enqueues encoded image bytes, the SERVER
+    decodes and runs the configured preprocessing chain)."""
+
+    def _image_model(self, size=32):
+        """Tiny conv classifier taking [b, size, size, 3]."""
+        import torch
+        import torch.nn as tnn
+
+        from analytics_zoo_tpu.inference import InferenceModel
+
+        torch.manual_seed(0)
+
+        class Net(tnn.Module):
+            def __init__(self):
+                super().__init__()
+                self.conv = tnn.Conv2d(3, 4, 3, 2)
+                self.fc = tnn.Linear(4, 3)
+
+            def forward(self, x):          # [b, h, w, 3] channels-last
+                y = self.conv(x.permute(0, 3, 1, 2)).mean((2, 3))
+                return torch.nn.functional.softmax(self.fc(y), dim=-1)
+
+        m = Net()
+        return (InferenceModel().load_torch(
+            m, np.zeros((1, size, size, 3), np.float32)), m)
+
+    def test_jpeg_bytes_through_engine(self, broker, tmp_path):
+        """JPEG bytes -> broker -> engine decode + preprocess -> result,
+        numerically equal to client-side decode + the same chain."""
+        import io
+
+        from PIL import Image
+
+        im, torch_m = self._image_model(32)
+        rng = np.random.RandomState(0)
+        raw = (rng.rand(48, 40, 3) * 255).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(raw).save(buf, format="PNG")  # lossless: exact
+        png_bytes = buf.getvalue()
+
+        def pre(arr):                       # resize->crop->scale chain
+            from analytics_zoo_tpu.feature.image import (
+                ChainedPreprocessing, ImageCenterCrop,
+                ImageChannelScaledNormalizer, ImageMatToTensor,
+                ImageResize,
+            )
+            pipe = ChainedPreprocessing([
+                ImageResize(36, 36), ImageCenterCrop(32, 32),
+                ImageChannelScaledNormalizer(128.0, 128.0, 128.0,
+                                             1.0 / 128.0),
+                ImageMatToTensor()])
+            return pipe.transform({"image": arr})["image"]
+
+        with ClusterServing(im, broker.port, batch_size=2,
+                            image_preprocess=pre).start():
+            in_q = InputQueue(port=broker.port)
+            out_q = OutputQueue(port=broker.port)
+            in_q.enqueue("img_bytes", image=png_bytes)
+            # path flavor too (ref client enqueues local file uris)
+            p = str(tmp_path / "img.png")
+            with open(p, "wb") as f:
+                f.write(png_bytes)
+            in_q.enqueue_image("img_path", p)
+            r1 = out_q.query("img_bytes", timeout=60.0)
+            r2 = out_q.query("img_path", timeout=60.0)
+        assert r1 is not None and r2 is not None
+        expect = pre(np.asarray(raw, np.float32))[None]
+        import torch
+        want = torch_m(torch.tensor(expect)).detach().numpy()[0]
+        np.testing.assert_allclose(r1, want, atol=1e-5)
+        np.testing.assert_allclose(r2, want, atol=1e-5)
+
+    def test_undecodable_image_gets_error_result(self, broker):
+        im, _ = self._image_model(32)
+        with ClusterServing(im, broker.port, batch_size=2).start():
+            in_q = InputQueue(port=broker.port)
+            out_q = OutputQueue(port=broker.port)
+            in_q.enqueue("badimg", image=b"not an image at all")
+            with pytest.raises(schema.ServingError, match="image decode"):
+                out_q.query("badimg", timeout=60.0)
+
+    def test_config_preprocessing_section(self, tmp_path):
+        """config.yaml preprocessing: -> a working engine-side chain."""
+        p = tmp_path / "config.yaml"
+        p.write_text("""
+model:
+  path: /nonexistent
+data:
+  src: 127.0.0.1:6399
+preprocessing:
+  resize: 36
+  crop: 32
+  mean: "128.0,128.0,128.0"
+  scale: 0.0078125
+""")
+        cfg = ServingConfig.load(str(p))
+        assert cfg.image_resize == 36 and cfg.image_crop == 32
+        assert cfg.image_mean == (128.0, 128.0, 128.0)
+        chain = cfg.build_image_preprocess()
+        out = chain(np.full((48, 40, 3), 192.0, np.float32))
+        assert out.shape == (32, 32, 3)
+        np.testing.assert_allclose(out, (192 - 128) / 128, rtol=1e-5)
+        # preset flavor
+        p2 = tmp_path / "config2.yaml"
+        p2.write_text("""
+model:
+  path: /nonexistent
+preprocessing:
+  preset: resnet-50
+  source: torchvision
+""")
+        cfg2 = ServingConfig.load(str(p2))
+        chain2 = cfg2.build_image_preprocess()
+        out2 = chain2(np.full((300, 300, 3), 128.0, np.float32))
+        assert out2.shape == (224, 224, 3)
+        # no section -> None
+        p3 = tmp_path / "config3.yaml"
+        p3.write_text("model:\n  path: /x\n")
+        assert ServingConfig.load(str(p3)).build_image_preprocess() is None
+
+    def test_string_tensors_still_roundtrip(self, broker):
+        """A str value is a TENSOR, not a file path (a blanket str->open
+        would break string inputs and read arbitrary local files)."""
+        uri, inputs = schema.decode_record(
+            schema.encode_record("r1", {
+                "text": InputQueue._coerce("hello world")}))
+        assert uri == "r1"
+        assert inputs["text"].reshape(-1)[0] == "hello world"
