@@ -135,21 +135,36 @@ class ShardedDataset:
             return self.n // per_host
         return math.ceil(self.n / per_host)
 
+    @staticmethod
+    def _per_host(batch_size: int, process_fraction: Optional[float]) -> int:
+        """Host-local rows per global batch. Default (None): the batch
+        divides evenly over processes (the data-parallel feed). A strategy
+        with a process-replicated batch (pure tp/pp) passes 1.0 so every
+        host feeds the FULL batch — see
+        ``ShardingStrategy.batch_feed_fraction``."""
+        import jax
+        if jax.process_count() <= 1:
+            return batch_size
+        frac = (1.0 / jax.process_count() if process_fraction is None
+                else process_fraction)
+        per_host = int(round(batch_size * frac))
+        if abs(per_host - batch_size * frac) > 1e-9 or per_host < 1:
+            raise ValueError(
+                f"global batch {batch_size} does not divide over the "
+                f"process feed fraction {frac}")
+        return per_host
+
     def iter_batches(self, batch_size: int, shuffle: bool = False,
                      seed: int = 0, epoch: int = 0,
-                     drop_remainder: bool = True
+                     drop_remainder: bool = True,
+                     process_fraction: Optional[float] = None
                      ) -> Iterator[Tuple[Any, Any, Optional[np.ndarray]]]:
         """Yield (x, y, mask) host-local numpy batches of fixed shape.
 
         mask is None for full batches; for a padded final batch it is a
         float32 {0,1} vector of valid rows.
         """
-        import jax
-        per_host = batch_size
-        if jax.process_count() > 1:
-            assert batch_size % jax.process_count() == 0, \
-                "global batch must divide over processes"
-            per_host = batch_size // jax.process_count()
+        per_host = self._per_host(batch_size, process_fraction)
         if per_host > self.n and drop_remainder:
             raise ValueError(f"batch_size {per_host} > dataset size {self.n} "
                              "(with drop_remainder=True no batch can be formed)")
@@ -196,7 +211,10 @@ class ShardedDataset:
                     tree, mesh, lambda a: strategy.batch_spec(np.ndim(a)))
             return put(x), put(y), put(mask)
 
-        it = self.iter_batches(batch_size, shuffle, seed, epoch, drop_remainder)
+        it = self.iter_batches(batch_size, shuffle, seed, epoch,
+                               drop_remainder,
+                               process_fraction=strategy
+                               .batch_feed_fraction(mesh))
         prev = None
         for b in it:
             cur = place(b)  # async transfer starts immediately
@@ -211,17 +229,14 @@ class ShardedDataset:
         """Fixed-shape constraint (ref tf_dataset.py:117: batch_size must
         be divisible by the total core count): the per-host batch must
         divide over the mesh's batch axes."""
-        import jax
         divisor = 1
         for ax in strategy.batch_axes():
             divisor *= dict(zip(mesh.axis_names,
                                 mesh.devices.shape)).get(ax, 1)
-        per_host = batch_size // max(1, jax.process_count())
-        if divisor and per_host % divisor:
+        if divisor and batch_size % divisor:
             raise ValueError(
-                f"batch_size {batch_size} (per-host {per_host}) must be "
-                f"divisible by the mesh batch-axis size {divisor} "
-                f"(axes {strategy.batch_axes()})")
+                f"batch_size {batch_size} must be divisible by the mesh "
+                f"batch-axis size {divisor} (axes {strategy.batch_axes()})")
 
     def device_scan_iterator(self, mesh, strategy, batch_size: int,
                              steps_per_loop: int, shuffle: bool = False,
@@ -254,8 +269,9 @@ class ShardedDataset:
 
         group = []
         prev = None
-        for x, y, _ in self.iter_batches(batch_size, shuffle, seed, epoch,
-                                         drop_remainder=True):
+        for x, y, _ in self.iter_batches(
+                batch_size, shuffle, seed, epoch, drop_remainder=True,
+                process_fraction=strategy.batch_feed_fraction(mesh)):
             group.append((x, y))
             if len(group) == steps_per_loop:
                 cur = place(group)
@@ -323,16 +339,13 @@ class StreamingShardedDataset(ShardedDataset):
 
     def iter_batches(self, batch_size: int, shuffle: bool = False,
                      seed: int = 0, epoch: int = 0,
-                     drop_remainder: bool = True
+                     drop_remainder: bool = True,
+                     process_fraction: Optional[float] = None
                      ) -> Iterator[Tuple[Any, Any, Optional[np.ndarray]]]:
         import jax
         from concurrent.futures import ThreadPoolExecutor
 
-        per_host = batch_size
-        if jax.process_count() > 1:
-            assert batch_size % jax.process_count() == 0, \
-                "global batch must divide over processes"
-            per_host = batch_size // jax.process_count()
+        per_host = self._per_host(batch_size, process_fraction)
         if per_host > self.n and drop_remainder:
             raise ValueError(f"batch_size {per_host} > dataset size {self.n} "
                              "(with drop_remainder=True no batch can be "

@@ -99,6 +99,49 @@ class ShardingStrategy:
         lead = axes if len(axes) != 1 else axes[0]
         return P(lead, *([None] * (ndim - 1))) if axes else P()
 
+    def batch_feed_fraction(self, mesh) -> float:
+        """Fraction of each GLOBAL batch this process must supply to
+        ``make_array_from_process_local_data`` under this strategy's batch
+        sharding: ``1/process_count`` when the batch axes span the
+        processes (the standard data-parallel feed, each host provides its
+        contiguous block), ``1.0`` when the batch is replicated across
+        processes (pure tp/pp layouts — every host must feed the FULL
+        global batch, so callers give every process the full dataset)."""
+        import jax
+        if jax.process_count() == 1:
+            return 1.0
+        from jax.sharding import NamedSharding
+        n = 1
+        for ax in self.batch_axes():
+            n *= mesh_lib.mesh_axis_size(mesh, ax)
+        if n <= 1:
+            return 1.0          # batch replicated: everyone feeds all rows
+        sh = NamedSharding(mesh, self.batch_spec(1))
+        imap = sh.addressable_devices_indices_map((n,))
+        starts = sorted({(s[0].start or 0) for s in imap.values()})
+        if len(starts) == n:
+            # The batch IS sharded but every index is process-local (e.g.
+            # "tp2,dp4" on 2 hosts: the model axis spans the processes, so
+            # each host's devices cover all data indices). Feeding each
+            # host's LOCAL data slice here would give the cross-process
+            # replicas of every batch shard DIFFERENT rows — silently
+            # wrong gradients. Refuse instead of guessing.
+            raise ValueError(
+                f"strategy {self}: the batch axes {self.batch_axes()} do "
+                f"not span the processes (every batch index is local to "
+                f"each host) — put the batch axes first in the strategy "
+                f"(process-major, e.g. 'dp2,tp4' not 'tp4,dp2') so each "
+                f"host feeds its own contiguous block")
+        pc, pid = jax.process_count(), jax.process_index()
+        h = n // pc
+        if starts != list(range(pid * h, (pid + 1) * h)):
+            raise ValueError(
+                f"strategy {self}: batch rows owned by process {pid} are "
+                f"{starts}, not the contiguous block the per-host feed "
+                f"contract requires — reorder the mesh axes so the batch "
+                f"axes are process-major (e.g. dp first)")
+        return 1.0 / pc
+
     def param_spec(self, path: str, shape: Sequence[int], mesh):
         """PartitionSpec for one parameter. A rule whose sharded dims don't
         divide by the mesh axis size is dropped for that parameter, which

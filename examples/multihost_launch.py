@@ -54,7 +54,8 @@ import socket
 import subprocess
 import sys
 
-N_LOCAL_DEVICES = 4  # virtual CPU devices per worker process
+N_LOCAL_DEVICES = 4  # virtual CPU devices per worker process (default;
+#                      --local-devices overrides, e.g. 2 for 4 processes)
 
 
 def make_data(n=256, d=8, seed=7):
@@ -84,7 +85,9 @@ def build_estimator(d, strategy="dp"):
     """Tiny MLP regressor — shared by the workers and the single-process
     reference in tests/test_multihost.py so both train the identical
     model. ``strategy`` exercises the sharded layouts cross-process
-    (e.g. "dp2,fsdp4": replicas over hosts, parameters sharded)."""
+    (e.g. "dp2,fsdp4": replicas over hosts, parameters sharded;
+    "tp<N>": Megatron-style column+row parameter shards whose model-axis
+    groups span the process boundary)."""
     import jax.numpy as jnp
     import numpy as np
     from analytics_zoo_tpu.learn.estimator import Estimator
@@ -99,16 +102,47 @@ def build_estimator(d, strategy="dp"):
         h = jnp.tanh(x @ p["w1"] + p["b1"])
         return h @ p["w2"] + p["b2"]
 
+    param_rules = None
+    if "tp" in strategy:
+        # Megatron MLP sharding: w1 column-parallel, w2 row-parallel —
+        # GSPMD inserts the reduce over the model axis for w2's matmul
+        param_rules = [("w1", (None, "model")), ("b1", ("model",)),
+                       ("w2", ("model", None))]
     return Estimator.from_fn(apply_fn=apply_fn, params=params, loss="mse",
-                             optimizer="sgd", strategy=strategy)
+                             optimizer="sgd", strategy=strategy,
+                             param_rules=param_rules)
+
+
+def build_pipeline_estimator(d, n_devices):
+    """Pipeline-parallel flavor: ``PipelinedMLP`` with one stage per
+    device over a pure ``pp<n_devices>`` mesh — with multiple processes
+    the stage->stage activation handoff in the middle of the pipeline
+    crosses the process boundary (the reference's whole multi-node story,
+    Topology.scala:1145-1550, had no pipeline analog at all)."""
+    import jax
+    import numpy as np
+    from analytics_zoo_tpu.learn.estimator import Estimator
+    from analytics_zoo_tpu.parallel import mesh as mesh_lib
+    from analytics_zoo_tpu.parallel.pipeline import PipelinedMLP
+
+    pmesh = mesh_lib.build_mesh(axes=(mesh_lib.PIPE_AXIS,),
+                                shape=[n_devices])
+    model = PipelinedMLP(hidden=16, out_dim=1, n_stages=n_devices,
+                         n_microbatches=2, mesh=pmesh)
+    x0 = np.zeros((2, d), np.float32)
+    params = model.init(jax.random.PRNGKey(0), x0)
+    return Estimator.from_fn(
+        apply_fn=model.apply, params=params, loss="mse", optimizer="sgd",
+        strategy=f"pp{n_devices}", param_rules=model.param_rules())
 
 
 def run_worker(process_id, num_processes, coordinator, epochs, batch_size,
-               strategy="dp"):
+               strategy="dp", local_devices=N_LOCAL_DEVICES,
+               data_mode="array"):
     # The virtual-device flag must be set before the XLA CPU backend
     # initialises (replace, don't append — the parent env may force 8).
     os.environ["XLA_FLAGS"] = \
-        f"--xla_force_host_platform_device_count={N_LOCAL_DEVICES}"
+        f"--xla_force_host_platform_device_count={local_devices}"
     import jax
     jax.config.update("jax_platforms", "cpu")
 
@@ -118,15 +152,45 @@ def run_worker(process_id, num_processes, coordinator, epochs, batch_size,
         cluster_mode="multihost", coordinator_address=coordinator,
         num_processes=num_processes, process_id=process_id)
     assert jax.process_count() == num_processes
-    assert len(jax.local_devices()) == N_LOCAL_DEVICES
+    assert len(jax.local_devices()) == local_devices
+    n_global = len(jax.devices())
 
     x, y = make_data()
-    rows = local_rows(len(x), batch_size, process_id, num_processes)
+    # pure tp/pp layouts replicate the batch across processes: EVERY host
+    # feeds the full global batch (ShardingStrategy.batch_feed_fraction
+    # == 1.0), so the local shard is the whole dataset
+    batch_replicated = not any(t in strategy for t in ("dp", "fsdp"))
+    if batch_replicated:
+        import numpy as np
+        rows = np.arange(len(x))
+    else:
+        rows = local_rows(len(x), batch_size, process_id, num_processes)
     x_local, y_local = x[rows], y[rows]
 
-    est = build_estimator(x.shape[1], strategy)
-    history = est.fit((x_local, y_local), epochs=epochs,
-                      batch_size=batch_size, shuffle=False)
+    if strategy == "pp":
+        est = build_pipeline_estimator(x.shape[1], n_global)
+    else:
+        est = build_estimator(x.shape[1], strategy)
+
+    if data_mode == "streaming":
+        # feed through the tiered out-of-core store: the multihost flavor
+        # of the DiskFeatureSet path (FeatureSet.scala:556) — each worker
+        # streams ITS OWN shards window-by-window
+        from analytics_zoo_tpu.common.context import OrcaContext
+        from analytics_zoo_tpu.data.dataset import to_sharded_dataset
+        from analytics_zoo_tpu.data.shard import HostXShards
+        OrcaContext.train_data_store = "DISK_2"
+        shards = HostXShards.partition(
+            {"x": x_local, "y": y_local}, num_shards=4)
+        data = to_sharded_dataset(shards, feature_cols=["x"],
+                                  label_cols=["y"])
+        from analytics_zoo_tpu.data.dataset import StreamingShardedDataset
+        assert isinstance(data, StreamingShardedDataset), type(data)
+    else:
+        data = (x_local, y_local)
+
+    history = est.fit(data, epochs=epochs, batch_size=batch_size,
+                      shuffle=False)
     ev = est.evaluate((x_local, y_local), batch_size=batch_size)
 
     # Global loss is replicated across processes — every worker sees the
@@ -134,14 +198,16 @@ def run_worker(process_id, num_processes, coordinator, epochs, batch_size,
     if process_id == 0:
         print("MULTIHOST_RESULT " + json.dumps(
             {"process_count": jax.process_count(),
-             "global_devices": len(jax.devices()),
+             "global_devices": n_global,
              "strategy": strategy,
+             "data_mode": data_mode,
              "loss": [float(v) for v in history["loss"]],
              "eval_loss": float(ev["loss"])}), flush=True)
     return 0
 
 
-def run_launcher(num_processes, epochs, batch_size, strategy="dp"):
+def run_launcher(num_processes, epochs, batch_size, strategy="dp",
+                 local_devices=N_LOCAL_DEVICES, data_mode="array"):
     with socket.socket() as s:  # grab a free port for the coordinator
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -149,13 +215,14 @@ def run_launcher(num_processes, epochs, batch_size, strategy="dp"):
 
     env = dict(os.environ)
     env["XLA_FLAGS"] = \
-        f"--xla_force_host_platform_device_count={N_LOCAL_DEVICES}"
+        f"--xla_force_host_platform_device_count={local_devices}"
     env["JAX_PLATFORMS"] = "cpu"
     procs = [subprocess.Popen(
         [sys.executable, os.path.abspath(__file__),
          "--process-id", str(i), "--num-processes", str(num_processes),
          "--coordinator", coordinator, "--epochs", str(epochs),
-         "--batch-size", str(batch_size), "--strategy", strategy],
+         "--batch-size", str(batch_size), "--strategy", strategy,
+         "--local-devices", str(local_devices), "--data", data_mode],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         for i in range(num_processes)]
     outs = []
@@ -191,12 +258,17 @@ def main(argv=None):
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--strategy", default="dp")
+    ap.add_argument("--local-devices", type=int, default=N_LOCAL_DEVICES)
+    ap.add_argument("--data", default="array",
+                    choices=["array", "streaming"])
     args = ap.parse_args(argv)
     if args.process_id is None:
         return run_launcher(args.num_processes, args.epochs,
-                            args.batch_size, args.strategy)
+                            args.batch_size, args.strategy,
+                            args.local_devices, args.data)
     return run_worker(args.process_id, args.num_processes, args.coordinator,
-                      args.epochs, args.batch_size, args.strategy)
+                      args.epochs, args.batch_size, args.strategy,
+                      args.local_devices, args.data)
 
 
 if __name__ == "__main__":
