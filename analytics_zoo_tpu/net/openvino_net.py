@@ -13,11 +13,20 @@ users already have serve on TPU through the same ``InferenceModel``
 surface.
 
 Covers the opset subset classic CV/MLP IRs use: Parameter/Const/Result,
-Convolution/GroupConvolution (NCHW), MatMul, Add/Multiply/Subtract/Divide/
-Power, ReLU/Sigmoid/Tanh/Elu/Clamp/PReLU, MaxPool/AvgPool/ReduceMean,
-BatchNormInference, SoftMax, Reshape/Squeeze/Unsqueeze/Transpose/Concat/
-Gather, Sqrt/Exp. Unsupported layer types raise ``NotImplementedError``
-naming the type (same contract as ``onnx_net``).
+Convolution/GroupConvolution (NCHW, explicit pads + auto_pad same_upper/
+same_lower), MatMul, Add/Multiply/Subtract/Divide/Power,
+ReLU/Sigmoid/Tanh/Elu/Clamp/PReLU, MaxPool/AvgPool (floor AND ceil
+rounding, exclude-pad) /ReduceMean, BatchNormInference, SoftMax,
+Reshape/Squeeze/Unsqueeze/Transpose/Concat/Gather (incl. batch_dims),
+Sqrt/Exp. Unsupported layer types raise ``NotImplementedError`` naming
+the type (same contract as ``onnx_net``).
+
+Validation caveat: this environment has no network egress and no openvino
+distribution, so the test IRs are built in-repo to the published IR-v10+
+schema (attribute spellings as model-optimizer emits them — ceil-mode
+pools, auto_pad variants, opset8 Gather) and checked numerically against
+torch; no model-optimizer-exported artifact has run through this parser
+yet. FakeQuantize/int8 IRs are not supported.
 """
 
 from __future__ import annotations
@@ -172,8 +181,26 @@ def _pool(x, l: _Layer, reducer, init, average: bool):
     strides = l.ints("strides", (1,) * spatial)
     pads = _auto_pads(l, x.shape[2:], kernel, strides,
                       (1,) * spatial)
+    ceil_ext = [0] * spatial
     if l.attrs.get("rounding_type", "floor") == "ceil":
-        raise NotImplementedError("Pooling rounding_type=ceil not supported")
+        # ceil output size == floor after extending the end padding so the
+        # last (partial) window fits: out = ceil((in+pb+pe-k)/s)+1
+        # (IR MaxPool/AvgPool rounding_type attribute; torch exporters emit
+        # ceil_mode pools for squeezenet/googlenet-family models)
+        pads = list(pads)
+        for i, k in enumerate(kernel):
+            pb, pe = pads[i]
+            span = x.shape[2 + i] + pb + pe - k
+            out_ceil = -(-span // strides[i]) + 1
+            # Caffe/torch clamp: a window starting ENTIRELY in the end
+            # padding is dropped (else MaxPool grows a -inf column and
+            # exclude-pad AvgPool a 0/0 NaN one)
+            if (out_ceil - 1) * strides[i] >= x.shape[2 + i] + pb:
+                out_ceil -= 1
+            extra = max(0, (out_ceil - 1) * strides[i] + k
+                        - (x.shape[2 + i] + pb + pe))
+            ceil_ext[i] = extra
+            pads[i] = (pb, pe + extra)
     dims = (1, 1) + tuple(kernel)
     strd = (1, 1) + tuple(strides)
     padding = ((0, 0), (0, 0)) + tuple(pads)
@@ -183,6 +210,20 @@ def _pool(x, l: _Layer, reducer, init, average: bool):
             ones = jnp.ones_like(x)
             counts = lax.reduce_window(ones, 0.0, lax.add, dims, strd,
                                        padding)
+            return out / counts
+        if any(ceil_ext):
+            # include-pad divisor counts the window clipped to input +
+            # EXPLICIT pads — the ceil extension is not real padding
+            # (torch avg_pool2d count_include_pad=True semantics)
+            ones = jnp.ones_like(x)
+            expl = ((0, 0), (0, 0)) + tuple(
+                (pads[i][0], pads[i][1] - ceil_ext[i])
+                for i in range(spatial))
+            ones = jnp.pad(ones, expl, constant_values=1.0)
+            ext_pad = ((0, 0), (0, 0)) + tuple(
+                (0, ceil_ext[i]) for i in range(spatial))
+            counts = lax.reduce_window(ones, 0.0, lax.add, dims, strd,
+                                       ext_pad)
             return out / counts
         return out / float(np.prod(kernel))
     return out
@@ -285,13 +326,25 @@ def _apply_layer(l: _Layer, ins: List[Any]):
     if t == "Concat":
         return jnp.concatenate(ins, axis=int(l.attrs.get("axis", 0)))
     if t == "Gather":
-        if int(l.attrs.get("batch_dims", 0)) != 0:
-            raise NotImplementedError(
-                "Gather with batch_dims != 0 not supported")
+        bd = int(l.attrs.get("batch_dims", 0))
         axis = int(np.asarray(ins[2]).reshape(())) if len(ins) > 2 \
             else int(l.attrs.get("axis", 0))
-        return jnp.take(ins[0], np.asarray(ins[1]).astype(np.int32),
-                        axis=axis)
+        data = ins[0]
+        idx = jnp.asarray(ins[1]).astype(jnp.int32)
+        if bd < 0:
+            bd += idx.ndim
+        if axis < 0:
+            axis += data.ndim
+        if bd == 0:
+            return jnp.take(data, idx, axis=axis)
+        # batch_dims > 0: vmap one shared leading dim at a time (IR
+        # Gather-7/8 semantics — per-batch index tables, e.g. embedding
+        # lookups exported with a batch of sequences)
+        def g(d, i, rem):
+            if rem == 0:
+                return jnp.take(d, i, axis=axis - bd)
+            return jax.vmap(lambda dd, ii: g(dd, ii, rem - 1))(d, i)
+        return g(data, idx, bd)
     raise NotImplementedError(
         f"OpenVINO layer type {t!r} ({l.name}) has no TPU translation")
 

@@ -24,10 +24,15 @@ RESULT_HASH = "result"
 
 class InputQueue:
     def __init__(self, host: str = "127.0.0.1", port: int = 6399,
-                 stream: str = INPUT_STREAM, cipher: schema.Cipher = None):
+                 stream: str = INPUT_STREAM, cipher: schema.Cipher = None,
+                 arrow: bool = False):
+        """``arrow=True`` encodes records in the REFERENCE client's Arrow
+        wire format (ref client.py:149 data_to_b64) instead of the native
+        JSON tensors — the engine auto-detects either."""
         self._client = BrokerClient(host, port)
         self.stream = stream
         self.cipher = cipher
+        self.arrow = bool(arrow)
 
     @staticmethod
     def _coerce(v):
@@ -48,9 +53,10 @@ class InputQueue:
         if not inputs:
             raise ValueError("enqueue needs at least one named tensor")
         uri = schema.validate_uri(uri or uuid.uuid4().hex)
-        payload = schema.encode_record(
-            uri, {k: self._coerce(v) for k, v in inputs.items()},
-            self.cipher)
+        coerced = {k: self._coerce(v) for k, v in inputs.items()}
+        enc = (schema.encode_record_arrow if self.arrow
+               else schema.encode_record)
+        payload = enc(uri, coerced, self.cipher)
         return uri, payload
 
     def enqueue(self, uri: Optional[str] = None, **inputs) -> str:

@@ -87,8 +87,106 @@ def decode_record(payload_b64: str, cipher: Cipher = None
     if cipher is not None:
         body = cipher[1](body)
     obj = json.loads(body)
+    if "data" in obj and "inputs" not in obj:
+        # reference-client record shape: {"uri", "data": b64(arrow)}
+        # (ref client.py:144-147 enqueue)
+        return obj["uri"], decode_arrow_inputs(obj["data"])
     return obj["uri"], {k: decode_tensor(v)
                         for k, v in obj["inputs"].items()}
+
+
+# ------------------------- reference Arrow wire encoding ----------------
+# The reference client serializes records as ONE Arrow RecordBatch stream,
+# b64-wrapped (ref pyzoo/zoo/serving/client.py:149 data_to_b64 over
+# schema.py get_field_and_data): a dense tensor is a
+# struct{indiceData:list<i32>, indiceShape:list<i32>, data:list<f32>,
+# shape:list<i32>} column holding 4 one-field rows; a string column is
+# either b64 image bytes or '|'-joined string values. Producing/consuming
+# that exact layout lets reference-client record payloads ride this
+# broker (the TRANSPORT still differs: zbroker line protocol, not Redis
+# RESP — see PARITY.md).
+
+def encode_record_arrow(uri: str, inputs: Dict[str, Any],
+                        cipher: Cipher = None) -> str:
+    import pyarrow as pa
+    fields, arrays = [], []
+    for key, value in inputs.items():
+        if isinstance(value, ImageBytes):
+            fields.append(pa.field(key, pa.string()))
+            arrays.append(pa.array(
+                [base64.b64encode(value.data).decode()]))
+            continue
+        if isinstance(value, (list, tuple)) and value and \
+                isinstance(value[0], str):
+            fields.append(pa.field(key, pa.string()))
+            arrays.append(pa.array(["|".join(value)]))
+            continue
+        arr = np.asarray(value)
+        if arr.dtype.kind in ("U", "S"):      # string tensor -> '|' join
+            fields.append(pa.field(key, pa.string()))
+            arrays.append(pa.array(
+                ["|".join(str(v) for v in arr.ravel())]))
+            continue
+        t = pa.struct([pa.field("indiceData", pa.list_(pa.int32())),
+                       pa.field("indiceShape", pa.list_(pa.int32())),
+                       pa.field("data", pa.list_(pa.float32())),
+                       pa.field("shape", pa.list_(pa.int32()))])
+        arrays.append(pa.array(
+            [{"indiceData": []}, {"indiceShape": []},
+             {"data": arr.astype("float32").ravel()},
+             {"shape": np.asarray(arr.shape)}], type=t))
+        fields.append(pa.field(key, t))
+    sink = pa.BufferOutputStream()
+    batch = pa.RecordBatch.from_arrays(arrays, schema=pa.schema(fields))
+    with pa.RecordBatchStreamWriter(sink, batch.schema) as w:
+        w.write_batch(batch)
+    arrow_b64 = base64.b64encode(sink.getvalue().to_pybytes()).decode()
+    body = json.dumps({"uri": uri, "data": arrow_b64}).encode()
+    if cipher is not None:
+        body = cipher[0](body)
+    return base64.b64encode(body).decode()
+
+
+_IMAGE_MAGIC = (b"\xff\xd8\xff", b"\x89PNG", b"BM", b"GIF8",
+                b"RIFF", b"II*\x00", b"MM\x00*")
+
+
+def decode_arrow_inputs(arrow_b64: str) -> Dict[str, Any]:
+    import pyarrow as pa
+    buf = base64.b64decode(arrow_b64)
+    with pa.ipc.open_stream(pa.py_buffer(buf)) as reader:
+        batch = reader.read_next_batch()
+    out: Dict[str, Any] = {}
+    for name, col in zip(batch.schema.names, batch.columns):
+        if pa.types.is_string(col.type):
+            s = col[0].as_py()
+            try:
+                raw = base64.b64decode(s, validate=True)
+            except Exception:
+                raw = None
+            if raw is not None and raw.startswith(_IMAGE_MAGIC):
+                out[name] = ImageBytes(raw)       # ref encode_image
+            else:
+                out[name] = np.asarray(s.split("|"))
+            continue
+        rows = col.to_pylist()                    # 4 one-field rows
+        merged: Dict[str, Any] = {}
+        for row in rows:
+            for k, v in (row or {}).items():
+                if v not in (None, []):
+                    merged.setdefault(k, v)
+        data = np.asarray(merged.get("data", []), np.float32)
+        shape = [int(v) for v in merged.get("shape", [])]
+        if merged.get("indiceData"):
+            # sparse: indices [nnz, ndim] + values + dense shape
+            idx = np.asarray(merged["indiceData"], np.int64).reshape(
+                [int(v) for v in merged["indiceShape"]])
+            dense = np.zeros(shape, np.float32)
+            dense[tuple(idx.T)] = data
+            out[name] = dense
+        else:
+            out[name] = data.reshape(shape)
+    return out
 
 
 def encode_result(arr: np.ndarray, cipher: Cipher = None) -> str:

@@ -704,3 +704,44 @@ preprocessing:
                 "text": InputQueue._coerce("hello world")}))
         assert uri == "r1"
         assert inputs["text"].reshape(-1)[0] == "hello world"
+
+
+class TestArrowWireFormat:
+    """Reference-client Arrow record encoding (ref client.py:149
+    data_to_b64 + schema.py get_field_and_data): InputQueue(arrow=True)
+    produces it, the engine auto-detects and serves it."""
+
+    def test_arrow_roundtrip_dense(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        payload = schema.encode_record_arrow("r1", {"x": x})
+        uri, inputs = schema.decode_record(payload)
+        assert uri == "r1"
+        np.testing.assert_allclose(inputs["x"], x)
+
+    def test_arrow_image_and_strings(self):
+        import io
+
+        from PIL import Image
+        buf = io.BytesIO()
+        Image.fromarray(np.zeros((4, 4, 3), np.uint8)).save(buf,
+                                                            format="PNG")
+        payload = schema.encode_record_arrow(
+            "r2", {"img": schema.ImageBytes(buf.getvalue()),
+                   "words": ["a", "b", "c"]})
+        uri, inputs = schema.decode_record(payload)
+        assert isinstance(inputs["img"], schema.ImageBytes)
+        assert inputs["img"].data == buf.getvalue()
+        assert list(inputs["words"]) == ["a", "b", "c"]
+
+    def test_arrow_client_end_to_end(self, broker):
+        im, torch_m = _make_model()
+        with ClusterServing(im, broker.port, batch_size=4).start():
+            in_q = InputQueue(port=broker.port, arrow=True)
+            out_q = OutputQueue(port=broker.port)
+            x = np.random.RandomState(0).randn(4).astype(np.float32)
+            in_q.enqueue("arrow-1", x=x)
+            r = out_q.query("arrow-1", timeout=60.0)
+        assert r is not None
+        import torch
+        want = torch_m(torch.from_numpy(x[None])).detach().numpy()[0]
+        np.testing.assert_allclose(r, want, atol=1e-5)
