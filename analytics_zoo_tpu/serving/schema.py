@@ -18,7 +18,7 @@ from __future__ import annotations
 import base64
 import json
 import re
-from typing import Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -136,6 +136,13 @@ def encode_record_arrow(uri: str, inputs: Dict[str, Any],
              {"data": arr.astype("float32").ravel()},
              {"shape": np.asarray(arr.shape)}], type=t))
         fields.append(pa.field(key, t))
+    # string/image columns are 1 row, tensor struct columns are 4 rows
+    # (the reference's quirky layout) — RecordBatch requires EQUAL column
+    # lengths, so short columns are null-padded; decoders read row 0
+    n_rows = max(len(a) for a in arrays)
+    arrays = [a if len(a) == n_rows else
+              pa.concat_arrays([a, pa.nulls(n_rows - len(a), a.type)])
+              for a in arrays]
     sink = pa.BufferOutputStream()
     batch = pa.RecordBatch.from_arrays(arrays, schema=pa.schema(fields))
     with pa.RecordBatchStreamWriter(sink, batch.schema) as w:
@@ -147,8 +154,17 @@ def encode_record_arrow(uri: str, inputs: Dict[str, Any],
     return base64.b64encode(body).decode()
 
 
-_IMAGE_MAGIC = (b"\xff\xd8\xff", b"\x89PNG", b"BM", b"GIF8",
-                b"RIFF", b"II*\x00", b"MM\x00*")
+# STRONG magics only: a '|'-joined string tensor that happens to be valid
+# b64 must not be misread as an image, so short/ambiguous prefixes (BM,
+# bare RIFF) are excluded
+_IMAGE_MAGIC = (b"\xff\xd8\xff", b"\x89PNG\r\n\x1a\n",
+                b"GIF87a", b"GIF89a", b"II*\x00", b"MM\x00*")
+
+
+def _looks_like_image(raw: bytes) -> bool:
+    if raw.startswith(_IMAGE_MAGIC):
+        return True
+    return raw[:4] == b"RIFF" and raw[8:12] == b"WEBP"
 
 
 def decode_arrow_inputs(arrow_b64: str) -> Dict[str, Any]:
@@ -164,7 +180,7 @@ def decode_arrow_inputs(arrow_b64: str) -> Dict[str, Any]:
                 raw = base64.b64decode(s, validate=True)
             except Exception:
                 raw = None
-            if raw is not None and raw.startswith(_IMAGE_MAGIC):
+            if raw is not None and _looks_like_image(raw):
                 out[name] = ImageBytes(raw)       # ref encode_image
             else:
                 out[name] = np.asarray(s.split("|"))

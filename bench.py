@@ -351,6 +351,105 @@ def measure_tcn():
             "tcn_samples_per_sec": round(B / dt, 1)}
 
 
+# flash-attention payoff shapes (shrunk by the smoke tests)
+FA_BATCH, FA_SEQ, FA_HEADS, FA_DIM = 4, 2048, 8, 64
+FA_ITERS = 20
+
+
+def measure_flash_attention():
+    """Pallas flash-attention payoff vs the blockwise-jax fallback
+    (VERDICT r4 weak #2/next #8: the kernel needs a demonstrated win).
+    Long-sequence forward timing — seq 2048, where HBM traffic for the
+    full score matrix dominates and the fused kernel should lead."""
+    import jax
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.ops.flash_attention import (
+        blockwise_attention, flash_attention,
+    )
+
+    B, S, H, D = FA_BATCH, FA_SEQ, FA_HEADS, FA_DIM
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, S, H, D), jnp.bfloat16)
+
+    def timed(fn):
+        f = jax.jit(fn)
+        f(q, k, v).block_until_ready()          # compile
+        t0 = time.perf_counter()
+        for _ in range(FA_ITERS):
+            out = f(q, k, v)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / FA_ITERS
+
+    dt_block = timed(lambda q, k, v: blockwise_attention(q, k, v,
+                                                         causal=True))
+    out = {"blockwise_attn_seq_ms": round(dt_block * 1e3, 3),
+           "flash_attn_seq": S}
+    try:
+        bq = min(128, S)
+        dt_flash = timed(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, block_q=bq, block_k=bq))
+        out["flash_attn_seq_ms"] = round(dt_flash * 1e3, 3)
+        out["flash_vs_blockwise_speedup"] = round(dt_block / dt_flash, 3)
+    except Exception as e:
+        # pallas is TPU-only: on the CPU fallback (or a kernel break)
+        # record the blockwise number + the reason instead of losing both
+        out["flash_attn_error"] = repr(e)[:160]
+    return out
+
+
+# int8-ratio shapes (shrunk by the smoke tests)
+INT8_MODEL, INT8_IMAGE, INT8_BATCH, INT8_CLASSES = "resnet-50", 224, 32, 1000
+INT8_ITERS = 10
+
+
+def measure_int8_predict():
+    """fp32 vs int8 batch-predict latency at resnet-50 scale + NCF scale
+    (VERDICT next #7: the reference claims 'up to 2x inference speedup'
+    for int8, BASELINE.md:12 — measure the ratio on this hardware; the
+    ceiling analysis lives in docs/INT8_CEILING.md)."""
+    import numpy as np
+    from analytics_zoo_tpu.inference import InferenceModel
+    from analytics_zoo_tpu.models.image.imageclassification import (
+        ImageClassifier,
+    )
+
+    def timed_predict(im, x, iters=INT8_ITERS):
+        im.predict(x)                            # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = im.predict(x)
+        np.asarray(out)
+        return (time.perf_counter() - t0) / iters
+
+    out = {}
+    # resnet-50 @ 224, batch 32 — conv/matmul dominated, the MXU int8 case
+    clf = ImageClassifier(class_num=INT8_CLASSES, model_name=INT8_MODEL,
+                          image_size=INT8_IMAGE)
+    x = np.random.default_rng(0).standard_normal(
+        (INT8_BATCH, INT8_IMAGE, INT8_IMAGE, 3)).astype(np.float32)
+    im = InferenceModel().load_zoo(clf.model)
+    dt32 = timed_predict(im, x)
+    im.quantize(min_elems=1024, mode="int8", calibration_data=x[:8])
+    dt8 = timed_predict(im, x)
+    out["resnet50_fp32_ms_per_batch32"] = round(dt32 * 1e3, 2)
+    out["resnet50_int8_ms_per_batch32"] = round(dt8 * 1e3, 2)
+    out["resnet50_int8_speedup"] = round(dt32 / dt8, 3)
+
+    # NCF scale — embedding + small MLP, the memory-bound counter-case
+    ncf, xn, _ = build_ncf()
+    ids = xn[:4096]
+    im2 = InferenceModel().load_zoo(ncf.model)
+    d32 = timed_predict(im2, ids)
+    im2.quantize(min_elems=1024, mode="int8",
+                 calibration_data=ids[:256])
+    d8 = timed_predict(im2, ids)
+    out["ncf_int8_speedup"] = round(d32 / d8, 3)
+    return out
+
+
 def _cpu_fallback_line(wedge_note: str):
     """The wedged backend init holds jax's global backend lock, so no
     fallback is possible IN-PROCESS — but a fresh subprocess with
@@ -475,7 +574,8 @@ def main():
         "device": jax.devices()[0].device_kind,
     }
     print(json.dumps(_assemble_record(
-        out, (measure_bert, measure_tcn, measure_serving))))
+        out, (measure_bert, measure_tcn, measure_serving,
+              measure_flash_attention, measure_int8_predict))))
 
 
 if __name__ == "__main__":

@@ -91,3 +91,30 @@ def test_measure_bert_sweep(tiny_bench, orca_ctx, monkeypatch):
     # no peak table entry for the CPU device → MFU fields None or absent
     if out.get("bert_base_mfu") is not None:
         assert 0 < out["bert_base_mfu"] <= 1.5
+
+
+def test_measure_flash_attention(tiny_bench, orca_ctx, monkeypatch):
+    bench = tiny_bench
+    monkeypatch.setattr(bench, "FA_BATCH", 1)
+    monkeypatch.setattr(bench, "FA_SEQ", 128)
+    monkeypatch.setattr(bench, "FA_HEADS", 2)
+    monkeypatch.setattr(bench, "FA_DIM", 32)
+    monkeypatch.setattr(bench, "FA_ITERS", 2)
+    out = bench.measure_flash_attention()
+    assert out["blockwise_attn_seq_ms"] > 0
+    # on the CPU mesh pallas is unavailable: the fn must still return the
+    # blockwise number plus the reason (on chip this key is the speedup)
+    assert "flash_vs_blockwise_speedup" in out or "flash_attn_error" in out
+
+
+def test_measure_int8_predict(tiny_bench, orca_ctx, monkeypatch):
+    bench = tiny_bench
+    monkeypatch.setattr(bench, "INT8_MODEL", "resnet-lite")
+    monkeypatch.setattr(bench, "INT8_IMAGE", 32)
+    monkeypatch.setattr(bench, "INT8_BATCH", 4)
+    monkeypatch.setattr(bench, "INT8_CLASSES", 5)
+    monkeypatch.setattr(bench, "INT8_ITERS", 2)
+    out = bench.measure_int8_predict()
+    assert out["resnet50_fp32_ms_per_batch32"] > 0
+    assert out["resnet50_int8_speedup"] > 0
+    assert out["ncf_int8_speedup"] > 0

@@ -745,3 +745,28 @@ class TestArrowWireFormat:
         import torch
         want = torch_m(torch.from_numpy(x[None])).detach().numpy()[0]
         np.testing.assert_allclose(r, want, atol=1e-5)
+
+    def test_arrow_mixed_image_and_tensor_record(self):
+        """Mixed string/image (1-row) and tensor (4-row) columns must
+        encode: short columns null-pad to the batch length."""
+        import io
+
+        from PIL import Image
+        buf = io.BytesIO()
+        Image.fromarray(np.zeros((2, 2, 3), np.uint8)).save(buf,
+                                                            format="PNG")
+        payload = schema.encode_record_arrow(
+            "r3", {"img": schema.ImageBytes(buf.getvalue()),
+                   "meta": np.arange(4, dtype=np.float32)})
+        uri, inputs = schema.decode_record(payload)
+        assert isinstance(inputs["img"], schema.ImageBytes)
+        np.testing.assert_allclose(inputs["meta"], np.arange(4))
+
+    def test_arrow_b64_looking_string_stays_string(self):
+        """A string value that is valid b64 of bytes with a weak magic
+        ('BM...') must NOT be misread as an image."""
+        payload = schema.encode_record_arrow(
+            "r4", {"words": ["Qk1hcmtldA=="]})   # b64("BMarket")
+        _, inputs = schema.decode_record(payload)
+        assert not isinstance(inputs["words"], schema.ImageBytes)
+        assert list(inputs["words"]) == ["Qk1hcmtldA=="]
