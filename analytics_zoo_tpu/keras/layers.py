@@ -371,13 +371,17 @@ def _pair(v):
 
 
 def _padding_2d(border_mode):
-    """"same"/"valid", or an int / (ph, pw) pair for explicit SYMMETRIC
-    zero padding. Explicit padding matters for torch-weight parity: XLA
-    SAME pads asymmetrically (low side gets less) for stride>1, while
-    torch/Caffe convs pad symmetrically — same shapes, different outputs."""
+    """"same"/"valid", an int / (ph, pw) pair for explicit SYMMETRIC zero
+    padding, or ((top, bottom), (left, right)) for asymmetric (e.g.
+    ceil-mode pooling parity). Explicit padding matters for torch-weight
+    parity: XLA SAME pads asymmetrically (low side gets less) for
+    stride>1, while torch/Caffe convs pad symmetrically — same shapes,
+    different outputs."""
     if isinstance(border_mode, str):
         return border_mode.upper()
     p = _pair(border_mode)
+    if isinstance(p[0], (tuple, list)):
+        return tuple((int(lo), int(hi)) for lo, hi in p)
     return ((int(p[0]), int(p[0])), (int(p[1]), int(p[1])))
 
 
@@ -501,12 +505,15 @@ class _Pool(KerasLayer):
         if isinstance(border_mode, str):
             self.padding = border_mode.upper()
         else:
-            # explicit symmetric padding (reduce_window pads max-pool
+            # explicit symmetric padding, or ((lo, hi), ...) pairs for
+            # asymmetric (ceil-mode) pooling (reduce_window pads max-pool
             # windows with -inf, avg-pool with zeros counted in the mean —
             # torch MaxPool2d / AvgPool2d(count_include_pad=True) parity)
             p = (border_mode if isinstance(border_mode, (tuple, list))
                  else (border_mode,) * len(self.pool_size))
-            self.padding = tuple((int(v), int(v)) for v in p)
+            self.padding = tuple(
+                (int(v[0]), int(v[1])) if isinstance(v, (tuple, list))
+                else (int(v), int(v)) for v in p)
 
 
 class MaxPooling1D(_Pool):
@@ -1337,7 +1344,7 @@ class AtrousConvolution2D(KerasLayer):
         self.nb_filter, self.kernel = nb_filter, (nb_row, nb_col)
         self.rate = _pair(atrous_rate)
         self.activation = get_activation(activation)
-        self.padding = border_mode.upper()
+        self.padding = _padding_2d(border_mode)
         self.strides = _pair(subsample)
         self.init = get_init(init)
         self.bias = bias

@@ -3,7 +3,7 @@
 from analytics_zoo_tpu.models.anomalydetection import AnomalyDetector
 from analytics_zoo_tpu.models.common import ZooModel, registry
 from analytics_zoo_tpu.models.image import ImageClassifier, ObjectDetector
-from analytics_zoo_tpu.models.image.objectdetection import SSDLite
+from analytics_zoo_tpu.models.image.objectdetection import SSD300VGG, SSDLite
 from analytics_zoo_tpu.models.recommendation import (
     NeuralCF,
     SessionRecommender,
@@ -16,5 +16,5 @@ from analytics_zoo_tpu.models.textmatching import KNRM
 __all__ = [
     "ZooModel", "registry", "NeuralCF", "WideAndDeep", "SessionRecommender",
     "TextClassifier", "KNRM", "Seq2Seq", "AnomalyDetector",
-    "ImageClassifier", "ObjectDetector", "SSDLite",
+    "ImageClassifier", "ObjectDetector", "SSDLite", "SSD300VGG",
 ]

@@ -15,11 +15,12 @@ from analytics_zoo_tpu.models.image.objectdetection.multibox_loss import (
 )
 from analytics_zoo_tpu.models.image.objectdetection.object_detector import (
     ObjectDetector,
+    SSD300VGG,
     SSDLite,
 )
 
 __all__ = [
     "generate_anchors", "iou_matrix", "encode_targets", "decode_boxes",
-    "nms", "MultiBoxLoss", "SSDLite", "ObjectDetector",
+    "nms", "MultiBoxLoss", "SSDLite", "SSD300VGG", "ObjectDetector",
     "mean_average_precision", "average_precision", "Visualizer",
 ]

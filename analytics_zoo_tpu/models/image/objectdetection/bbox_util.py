@@ -117,6 +117,45 @@ def anchors_from_config(name: str) -> np.ndarray:
                             cfg["aspect_ratios"])
 
 
+def ssd_pytorch_priors() -> np.ndarray:
+    """[8732, 4] corner-form priors in the EXACT ssd.pytorch PriorBox
+    geometry and per-cell order — required to decode heads TRAINED
+    against that prior box (``import_ssd300_from_torch`` checkpoints).
+
+    Differences from ``ANCHOR_CONFIGS["ssd300_vgg"]`` that make this a
+    separate generator rather than a preset: steps-based centers
+    ((j+0.5)*step/300, not (j+0.5)/fm), min/max pixel sizes
+    (30/60/111/162/213/264 + 315), and the per-cell order
+    [ratio-1, extra-sqrt, 2, 1/2, (3, 1/3)] — ``generate_anchors``
+    appends the extra anchor LAST, so index a in a trained head would
+    decode against the wrong prior shape."""
+    fms = (38, 19, 10, 5, 3, 1)
+    steps = (8, 16, 32, 64, 100, 300)
+    mins = (30, 60, 111, 162, 213, 264)
+    maxs = (60, 111, 162, 213, 264, 315)
+    ars = ((2,), (2, 3), (2, 3), (2, 3), (2,), (2,))
+    boxes: List[np.ndarray] = []
+    for k, fm in enumerate(fms):
+        s = mins[k] / 300.0
+        sp = float(np.sqrt(mins[k] * maxs[k])) / 300.0
+        f_k = 300.0 / steps[k]
+        centers = (np.arange(fm, dtype=np.float32) + 0.5) / f_k
+        cy, cx = np.meshgrid(centers, centers, indexing="ij")
+        cx, cy = cx.reshape(-1), cy.reshape(-1)
+        whs = [(s, s), (sp, sp)]
+        for ar in ars[k]:
+            r = float(np.sqrt(ar))
+            whs += [(s * r, s / r), (s / r, s * r)]
+        w = np.array([w for w, _ in whs], np.float32)
+        h = np.array([h for _, h in whs], np.float32)
+        cx, cy = cx[:, None], cy[:, None]
+        cell = np.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2, cy + h / 2], axis=2)
+        boxes.append(cell.reshape(-1, 4))
+    out = np.concatenate(boxes, axis=0).astype(np.float32)
+    return np.clip(out, 0.0, 1.0)
+
+
 def _center_size(boxes: np.ndarray) -> np.ndarray:
     wh = boxes[..., 2:] - boxes[..., :2]
     c = boxes[..., :2] + wh / 2

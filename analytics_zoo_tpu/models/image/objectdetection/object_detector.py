@@ -23,8 +23,44 @@ from analytics_zoo_tpu.models.image.objectdetection.multibox_loss import (
 )
 
 
+class _SSDBase(ZooModel):
+    """Shared SSD surface: multibox loss, ground-truth encoding, and the
+    per-source loc/conf head construction — one implementation for every
+    SSD flavor (a fix to target encoding or mining defaults must not have
+    to be applied twice)."""
+
+    @property
+    def n_anchors(self) -> int:
+        return len(self.anchors)
+
+    def _build_heads(self, sources, C1: int):
+        heads: List = []
+        for fm, ratios in zip(sources, self.ratios_per_layer):
+            A = bbox_util.anchors_per_cell(ratios)
+            loc = zl.Conv2D(A * 4, 3, 3, border_mode="same")(fm)
+            conf = zl.Conv2D(A * C1, 3, 3, border_mode="same")(fm)
+            loc = zl.Lambda(_reshape_head(4))(loc)       # [b, cells*A, 4]
+            conf = zl.Lambda(_reshape_head(C1))(conf)    # [b, cells*A, C+1]
+            heads.append(zl.merge([loc, conf], mode="concat",
+                                  concat_axis=-1))
+        return zl.merge(heads, mode="concat", concat_axis=1) \
+            if len(heads) > 1 else heads[0]
+
+    def loss(self, neg_pos_ratio: float = 3.0,
+             loc_weight: float = 1.0) -> MultiBoxLoss:
+        return MultiBoxLoss(self.class_num, neg_pos_ratio, loc_weight)
+
+    def encode_ground_truth(self, gt_boxes_per_image, gt_labels_per_image
+                            ) -> np.ndarray:
+        """List of per-image (boxes [g,4], labels [g]) → [b, A, 5]
+        targets."""
+        return np.stack([
+            bbox_util.encode_targets(b, l, self.anchors)
+            for b, l in zip(gt_boxes_per_image, gt_labels_per_image)])
+
+
 @registry.register
-class SSDLite(ZooModel):
+class SSDLite(_SSDBase):
     """Small SSD over a strided separable-conv backbone.
 
     ``image_size`` must be divisible by 32; detection scales sit at
@@ -55,10 +91,6 @@ class SSDLite(ZooModel):
                                                   self.ratios_per_layer)
         self.model = self.build_model()
 
-    @property
-    def n_anchors(self) -> int:
-        return len(self.anchors)
-
     def build_model(self):
         C1 = self.class_num + 1                   # + background
         inp = Input(shape=(self.image_size, self.image_size, 3))
@@ -75,30 +107,8 @@ class SSDLite(ZooModel):
         f8 = conv_block(h, 64, 2)                         # /8
         f16 = conv_block(f8, 128, 2)                      # /16
         f32 = conv_block(f16, 128, 2)                     # /32
-
-        heads: List = []
-        for fm, ratios in zip((f8, f16, f32), self.ratios_per_layer):
-            A = bbox_util.anchors_per_cell(ratios)
-            loc = zl.Conv2D(A * 4, 3, 3, border_mode="same")(fm)
-            conf = zl.Conv2D(A * C1, 3, 3, border_mode="same")(fm)
-            loc = zl.Lambda(_reshape_head(4))(loc)        # [b, cells*A, 4]
-            conf = zl.Lambda(_reshape_head(C1))(conf)     # [b, cells*A, C+1]
-            heads.append(zl.merge([loc, conf], mode="concat",
-                                  concat_axis=-1))
-        out = zl.merge(heads, mode="concat", concat_axis=1) \
-            if len(heads) > 1 else heads[0]
+        out = self._build_heads((f8, f16, f32), C1)
         return Model(input=inp, output=out)
-
-    def loss(self, neg_pos_ratio: float = 3.0,
-             loc_weight: float = 1.0) -> MultiBoxLoss:
-        return MultiBoxLoss(self.class_num, neg_pos_ratio, loc_weight)
-
-    def encode_ground_truth(self, gt_boxes_per_image, gt_labels_per_image
-                            ) -> np.ndarray:
-        """List of per-image (boxes [g,4], labels [g]) → [b, A, 5] targets."""
-        return np.stack([
-            bbox_util.encode_targets(b, l, self.anchors)
-            for b, l in zip(gt_boxes_per_image, gt_labels_per_image)])
 
     def _config(self):
         return dict(class_num=self.class_num, image_size=self.image_size,
@@ -109,6 +119,107 @@ def _reshape_head(last_dim):
     def fn(x):
         return x.reshape(x.shape[0], -1, last_dim)
     return fn
+
+
+def _l2norm_layer(channels: int, scale: float = 20.0):
+    """SSD's conv4_3 L2Norm: per-channel learnable scale over the
+    L2-normalized feature (the classic ParseNet layer every VGG-SSD
+    carries; ssd.pytorch stores it as ``L2Norm.weight``)."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    class L2Norm(nn.Module):
+        ch: int
+        init_scale: float
+
+        @nn.compact
+        def __call__(self, x):
+            w = self.param("scale",
+                           lambda rng, shape: jnp.full(shape,
+                                                       self.init_scale,
+                                                       jnp.float32),
+                           (self.ch,))
+            norm = jnp.sqrt((x * x).sum(-1, keepdims=True) + 1e-10)
+            return x / norm * w
+
+    return zl.KerasLayerWrapper(L2Norm(channels, scale))
+
+
+@registry.register
+class SSD300VGG(_SSDBase):
+    """The canonical SSD300 with a VGG-16 backbone — the reference's
+    headline detector (ref ``ObjectDetector.scala`` VGG SSD 300 configs +
+    ``ImageClassificationConfig``-style pretrained entries; the classic
+    8,732-box pyramid).
+
+    Built layer-for-layer to the PUBLIC ssd.pytorch layout (the de-facto
+    source of trained SSD300 weights): VGG convs through conv4_3 (pool3
+    ceil-mode), L2Norm(512, 20) on the conv4_3 source, pool5 3x3/s1/p1,
+    dilated conv6 (1024, d=6, p=6), conv7 1x1, the 8-conv extras pyramid,
+    and 3x3 loc/conf heads over the six sources with (4,6,6,6,4,4)
+    anchors per cell. Anchors are ``bbox_util.ssd_pytorch_priors()`` —
+    the EXACT PriorBox geometry and per-cell order those trained heads
+    decode against. ``models/migration_image.py``
+    ``import_ssd300_from_torch`` loads ssd.pytorch-format state_dicts
+    (``vgg.{i}``, ``L2Norm.weight``, ``extras.{i}``, ``loc/conf.{i}``).
+    Output: [b, 8732, 4 + class_num + 1] (loc offsets ++ class scores).
+    """
+
+    def __init__(self, class_num: int):
+        super().__init__()
+        self.class_num = int(class_num)          # object classes (no bg)
+        self.image_size = 300
+        self.anchors = bbox_util.ssd_pytorch_priors()
+        self.ratios_per_layer = [
+            list(r) for r in
+            bbox_util.ANCHOR_CONFIGS["ssd300_vgg"]["aspect_ratios"]]
+        self.model = self.build_model()
+
+    def build_model(self):
+        C1 = self.class_num + 1
+        inp = Input(shape=(300, 300, 3))
+
+        def conv(x, ch, k=3, pad=1, **kw):
+            return zl.Conv2D(ch, k, k, activation="relu",
+                             border_mode=pad, **kw)(x)
+
+        h = conv(conv(inp, 64), 64)
+        h = zl.MaxPooling2D((2, 2), strides=(2, 2))(h)          # 150
+        h = conv(conv(h, 128), 128)
+        h = zl.MaxPooling2D((2, 2), strides=(2, 2))(h)          # 75
+        h = conv(conv(conv(h, 256), 256), 256)
+        # pool3 is CEIL-mode (75 -> 38): one extra cell on the high side;
+        # input is post-ReLU (>= 0) so the zero pad cannot win a max
+        h = zl.MaxPooling2D((2, 2), strides=(2, 2),
+                            border_mode=((0, 1), (0, 1)))(h)    # 38
+        h = conv(conv(conv(h, 512), 512), 512)
+        src43 = h                                               # conv4_3
+        h = zl.MaxPooling2D((2, 2), strides=(2, 2))(h)          # 19
+        h = conv(conv(conv(h, 512), 512), 512)
+        h = zl.MaxPooling2D((3, 3), strides=(1, 1),
+                            border_mode=1)(h)                   # pool5, 19
+        h = zl.AtrousConvolution2D(1024, 3, 3, atrous_rate=(6, 6),
+                                   activation="relu",
+                                   border_mode=6)(h)            # conv6
+        h = conv(h, 1024, k=1, pad=0)                           # conv7
+        src7 = h
+
+        e = conv(h, 256, k=1, pad=0)
+        src8 = conv(e, 512, subsample=(2, 2))                   # 10
+        e = conv(src8, 128, k=1, pad=0)
+        src9 = conv(e, 256, subsample=(2, 2))                   # 5
+        e = conv(src9, 128, k=1, pad=0)
+        src10 = conv(e, 256, pad=0)                             # 3
+        e = conv(src10, 128, k=1, pad=0)
+        src11 = conv(e, 256, pad=0)                             # 1
+
+        norm43 = _l2norm_layer(512)(src43)
+        sources = (norm43, src7, src8, src9, src10, src11)
+        out = self._build_heads(sources, C1)
+        return Model(input=inp, output=out)
+
+    def _config(self):
+        return dict(class_num=self.class_num)
 
 
 class ObjectDetector:
