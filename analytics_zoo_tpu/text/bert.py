@@ -42,6 +42,10 @@ class BertConfig:
     max_position_len: int = 512
     type_vocab: int = 2
     initializer_range: float = 0.02
+    # exact (erf) gelu — what HF-format BERT checkpoints were trained
+    # with (text/hf_import.py); the tanh approximation would put a ~1e-3
+    # floor under import parity
+    gelu_exact: bool = True
     # computation dtype (params stay fp32); jnp.bfloat16 doubles MXU
     # throughput on TPU — the default for training at scale
     dtype: Optional[object] = None
@@ -69,6 +73,9 @@ class EncoderBlock(nn.Module):
     attn_drop: float = 0.1
     causal: bool = False
     dtype: Optional[object] = None
+    # erf gelu for BERT-checkpoint fidelity (HF trained with exact);
+    # the GPT-style causal stack keeps the canonical tanh approximation
+    gelu_exact: bool = False
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = False):
@@ -80,7 +87,7 @@ class EncoderBlock(nn.Module):
         x = nn.LayerNorm(epsilon=1e-12, name="attn_norm")(x + attn)
         h = nn.Dense(self.intermediate_size, dtype=self.dtype,
                      name="intermediate")(x)
-        h = nn.gelu(h)
+        h = nn.gelu(h, approximate=not self.gelu_exact)
         h = nn.Dense(self.hidden_size, dtype=self.dtype, name="output")(h)
         if self.dropout > 0:
             h = nn.Dropout(self.dropout, deterministic=not train)(h)
@@ -135,7 +142,7 @@ class BertModule(nn.Module):
                 hidden_size=cfg.hidden_size, n_head=cfg.n_head,
                 intermediate_size=cfg.intermediate_size,
                 dropout=cfg.hidden_drop, attn_drop=cfg.attn_drop,
-                dtype=cfg.dtype,
+                dtype=cfg.dtype, gelu_exact=cfg.gelu_exact,
                 name=f"block_{i}")(x, mask, train)
         pooled = nn.tanh(nn.Dense(cfg.hidden_size, name="pooler")(x[:, 0]))
         return x, pooled

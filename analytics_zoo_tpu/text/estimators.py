@@ -144,6 +144,16 @@ class _BertTaskEstimator:
         self.estimator.load(path)
         return self
 
+    def load_hf(self, state_dict_or_path):
+        """Initialize the encoder from a HuggingFace-format BERT
+        checkpoint (state_dict, live ``transformers`` module, or
+        torch.save path) — the living replacement for the reference's
+        TF1 ``init_checkpoint`` flow (bert_estimator.py). Task heads
+        keep their init; fine-tune as usual afterwards."""
+        from analytics_zoo_tpu.text.hf_import import load_hf_bert
+        load_hf_bert(self, state_dict_or_path)
+        return self
+
 
 class BERTClassifier(_BertTaskEstimator):
     """Sequence classification on the pooled output

@@ -1,0 +1,148 @@
+"""HuggingFace-format BERT checkpoint import.
+
+The reference's BERT estimators initialize from Google's released BERT
+checkpoints (ref ``pyzoo/zoo/tfpark/text/estimator/bert_estimator.py``
+``bert_config_file``/``init_checkpoint`` — TF1 ckpt format, dead outside
+TF1). The living interchange format for the SAME weights is the
+HuggingFace ``transformers`` state_dict (``bert-base-uncased`` et al.);
+this module maps it onto ``text.bert.BertModule``'s parameter tree:
+
+    clf = BERTClassifier(num_classes=2, config=BertConfig(...))
+    clf.load_hf("pytorch_model.bin")     # or a live BertModel / state_dict
+
+Parity is asserted against the REAL ``transformers`` implementation in
+``tests/test_hf_bert_import.py`` (transformers ships in this image), so
+the mapping is checked against the canonical source, not a hand twin.
+
+Layout conversions:
+- embeddings -> Embed tables (no transpose)
+- q/k/v Linear [768, 768] -> DenseGeneral kernels [hidden, heads, dim]
+- attention output Linear -> DenseGeneral kernel [heads, dim, hidden]
+- intermediate/output/pooler Linear [out, in] -> Dense kernel [in, out]
+- LayerNorm weight/bias -> scale/bias (eps 1e-12 both sides)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from analytics_zoo_tpu.text.bert import BertConfig
+
+
+def _np(t):
+    if hasattr(t, "detach"):
+        # .float() first: torch bf16 tensors (common in modern
+        # checkpoints) have no direct .numpy()
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t, np.float32)
+
+
+def _strip_prefix(sd: Dict[str, Any]) -> Dict[str, Any]:
+    """Accept BertModel dicts and BertFor* dicts (keys under 'bert.')."""
+    if any(k.startswith("bert.") for k in sd):
+        return {k[len("bert."):]: v for k, v in sd.items()
+                if k.startswith("bert.")}
+    return sd
+
+
+def _dense(sd, prefix):
+    return {"kernel": _np(sd[f"{prefix}.weight"]).T,
+            "bias": _np(sd[f"{prefix}.bias"])}
+
+
+def _norm(sd, prefix):
+    return {"scale": _np(sd[f"{prefix}.weight"]),
+            "bias": _np(sd[f"{prefix}.bias"])}
+
+
+def hf_bert_params(state_dict_or_model, config: BertConfig) -> dict:
+    """transformers ``BertModel`` weights -> ``BertModule`` params tree."""
+    sd = state_dict_or_model
+    if hasattr(sd, "state_dict"):
+        sd = sd.state_dict()
+    sd = _strip_prefix(dict(sd))
+    h, d = config.n_head, config.head_dim
+    H = config.hidden_size
+
+    def qkv(prefix):
+        w = _np(sd[f"{prefix}.weight"])               # [H, H] (out, in)
+        b = _np(sd[f"{prefix}.bias"])
+        return {"kernel": w.T.reshape(H, h, d), "bias": b.reshape(h, d)}
+
+    params = {
+        "word_embeddings": {
+            "embedding": _np(sd["embeddings.word_embeddings.weight"])},
+        "position_embeddings": {
+            "embedding": _np(sd["embeddings.position_embeddings.weight"])},
+        "token_type_embeddings": {
+            "embedding": _np(
+                sd["embeddings.token_type_embeddings.weight"])},
+        "embed_norm": _norm(sd, "embeddings.LayerNorm"),
+        "pooler": _dense(sd, "pooler.dense"),
+    }
+    for i in range(config.n_block):
+        p = f"encoder.layer.{i}"
+        wo = _np(sd[f"{p}.attention.output.dense.weight"])  # [H, H]
+        params[f"block_{i}"] = {
+            "attention": {
+                "query": qkv(f"{p}.attention.self.query"),
+                "key": qkv(f"{p}.attention.self.key"),
+                "value": qkv(f"{p}.attention.self.value"),
+                # DenseGeneral over (heads, dim) -> hidden
+                "out": {"kernel": wo.T.reshape(h, d, H),
+                        "bias": _np(
+                            sd[f"{p}.attention.output.dense.bias"])},
+            },
+            "attn_norm": _norm(sd, f"{p}.attention.output.LayerNorm"),
+            "intermediate": _dense(sd, f"{p}.intermediate.dense"),
+            "output": _dense(sd, f"{p}.output.dense"),
+            "ffn_norm": _norm(sd, f"{p}.output.LayerNorm"),
+        }
+    return params
+
+
+def _validate_like(new: dict, ref: dict, path: str = "bert"):
+    for k, v in new.items():
+        if k not in ref:
+            raise KeyError(f"{path}/{k} not in the model's parameter tree "
+                           f"(have {sorted(ref)})")
+        if isinstance(v, dict):
+            _validate_like(v, ref[k], f"{path}/{k}")
+        elif tuple(np.shape(v)) != tuple(np.shape(ref[k])):
+            raise ValueError(f"{path}/{k}: checkpoint shape "
+                             f"{np.shape(v)} != model {np.shape(ref[k])} "
+                             "(config mismatch?)")
+
+
+def load_hf_bert(estimator, state_dict_or_path,
+                 bert_key: str = "bert") -> None:
+    """Load HF BERT weights into a ``_BertTaskEstimator``'s encoder
+    (task heads keep their current init — the fine-tuning flow)."""
+    sd = state_dict_or_path
+    if isinstance(sd, str):
+        import torch
+        sd = torch.load(sd, map_location="cpu", weights_only=True)
+    est = estimator.estimator
+    # sync live params back only if training already materialized them —
+    # calling _init_state() here would build (then immediately discard)
+    # the full optimizer state
+    if est._state is not None:
+        import jax
+        est.adapter.params = jax.device_get(est._state["params"])
+        est.adapter.model_state = jax.device_get(est._state["model_state"])
+    params = dict(est.adapter.params)
+    if bert_key not in params:
+        raise KeyError(f"{bert_key!r} not in the estimator's parameter "
+                       f"tree (have {sorted(params)})")
+    new_bert = hf_bert_params(sd, estimator.config)
+    _validate_like(new_bert, params[bert_key])
+    params[bert_key] = new_bert
+    est.adapter.params = params
+    est._state = None
+    est._predict_fn = None
+    # the discarded state restarts the device step at 0 — keep the host
+    # mirrors consistent (same convention as load_orca_checkpoint)
+    est._py_step = 0
+    est._epoch = 0
