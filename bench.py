@@ -479,9 +479,13 @@ def _cpu_fallback_line(wedge_note: str):
         return None, f"fallback failed: {repr(e)[:200]}"
 
 
-def _assemble_record(out: dict, parts) -> dict:
+def _assemble_record(out: dict, parts, current: dict | None = None) -> dict:
     """Shared record assembly: NCF headline fields + secondary parts (one
-    failure must not kill the line) — used by main() and --cpu-emit."""
+    failure must not kill the line) — used by main() and --cpu-emit.
+    ``current`` (if given) tracks the in-flight part name so a deadline
+    watchdog can report where a tunnel wedge struck."""
+    if current is not None:
+        current["part"] = "measure_ncf"
     try:
         res = measure_ncf()
         out["value"] = round(res["best"], 1)
@@ -492,11 +496,48 @@ def _assemble_record(out: dict, parts) -> dict:
     except Exception as e:
         out["measure_ncf_error"] = repr(e)[:200]
     for part in parts:
+        if current is not None:
+            current["part"] = part.__name__
         try:
             out.update(part())
         except Exception as e:
             out[part.__name__ + "_error"] = repr(e)[:200]
+    if current is not None:
+        current["part"] = "done"
     return out
+
+
+def _run_with_deadline(out: dict, parts, deadline_s: float) -> None:
+    """Emit the one JSON line even if the accelerator tunnel wedges
+    MID-run (observed r3-r5: a chip op blocks in recv forever, after init
+    succeeded — the init watchdog can't catch it). The measurements run in
+    a daemon thread mutating ``out`` incrementally; if they outlive the
+    deadline, whatever was already measured on-chip is still printed,
+    labeled with the part that stalled."""
+    import threading
+    current = {"part": "init"}
+    done = threading.Event()
+
+    def work():
+        try:
+            _assemble_record(out, parts, current=current)
+        except BaseException as e:   # even SystemExit must reach the record
+            out["worker_error"] = f"{current['part']}: {e!r}"[:200]
+        finally:
+            done.set()
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    if not done.wait(deadline_s):
+        out["error"] = (
+            f"bench deadline {deadline_s:.0f}s expired inside "
+            f"{current['part']} (accelerator tunnel unresponsive mid-run); "
+            "fields present were measured on-chip before the stall")
+        # dict(out): atomic snapshot — the worker may still be mutating out
+        print(json.dumps(dict(out)))
+        sys.stdout.flush()
+        os._exit(4)
+    print(json.dumps(dict(out)))
 
 
 def _cpu_emit():
@@ -573,9 +614,10 @@ def main():
         "vs_baseline": 0.0,
         "device": jax.devices()[0].device_kind,
     }
-    print(json.dumps(_assemble_record(
+    _run_with_deadline(
         out, (measure_bert, measure_tcn, measure_serving,
-              measure_flash_attention, measure_int8_predict))))
+              measure_flash_attention, measure_int8_predict),
+        deadline_s=float(os.environ.get("BENCH_DEADLINE_S", 2700)))
 
 
 if __name__ == "__main__":

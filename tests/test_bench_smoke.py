@@ -118,3 +118,53 @@ def test_measure_int8_predict(tiny_bench, orca_ctx, monkeypatch):
     assert out["resnet50_fp32_ms_per_batch32"] > 0
     assert out["resnet50_int8_speedup"] > 0
     assert out["ncf_int8_speedup"] > 0
+
+
+def test_run_with_deadline_emits_partial_on_stall(tiny_bench, monkeypatch,
+                                                  capsys):
+    """A tunnel wedge MID-run must still produce the one JSON line with
+    every already-measured field and the name of the stalled part."""
+    import threading
+
+    bench = tiny_bench
+    monkeypatch.setattr(
+        bench, "measure_ncf",
+        lambda: {"best": 7.0, "staged": 7.0, "cached": None})
+    exited = {}
+
+    def fake_exit(code):
+        exited["code"] = code
+        raise SystemExit(code)
+
+    monkeypatch.setattr(bench.os, "_exit", fake_exit)
+
+    release = threading.Event()
+
+    def fast():
+        return {"fast_ok": 1}
+
+    def stall():
+        release.wait(30)          # simulated blocked recv; freed at exit
+        return {}
+
+    out = {"metric": "x", "device": "test"}
+    with pytest.raises(SystemExit):
+        bench._run_with_deadline(out, (fast, stall), deadline_s=1.0)
+    release.set()
+    assert exited["code"] == 4
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["fast_ok"] == 1
+    assert rec["value"] == 7.0
+    assert "stall" in rec["error"]
+
+
+def test_run_with_deadline_completes_normally(tiny_bench, monkeypatch,
+                                              capsys):
+    bench = tiny_bench
+    monkeypatch.setattr(
+        bench, "measure_ncf",
+        lambda: {"best": 7.0, "staged": 7.0, "cached": None})
+    out = {"metric": "x", "device": "test"}
+    bench._run_with_deadline(out, (lambda: {"a": 1},), deadline_s=30.0)
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["a"] == 1 and "error" not in rec
