@@ -150,13 +150,21 @@ def _step_flops(train_step, state, x, y):
         return None
 
 
-def _measure_step_time(est, x, y, warmup=3, iters=10):
+def _put_data_sharded(mesh, arr):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
+    spec = P(*(["data"] + [None] * (arr.ndim - 1)))
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+def _measure_step_time(est, x, y, warmup=3, iters=10):
+    import jax
     mesh = est._ensure_mesh()
     est._build_train_step()
-    xs = jax.device_put(x, NamedSharding(mesh, P(*(["data"] + [None] * (x.ndim - 1)))))
-    ys = jax.device_put(y, NamedSharding(mesh, P("data")))
+    # x may be a single ndarray or a multi-input tuple (e.g. Wide&Deep;
+    # tuple = multi-input to the adapter, matching the keras fit path)
+    xs = jax.tree_util.tree_map(lambda a: _put_data_sharded(mesh, a), x)
+    ys = _put_data_sharded(mesh, y)
     state = est._state
     for _ in range(warmup):
         state, logs = est._train_step(state, xs, ys)
@@ -450,6 +458,85 @@ def measure_int8_predict():
     return out
 
 
+# resnet-50 training shapes (shrunk by the smoke tests)
+RN50_MODEL, RN50_IMAGE, RN50_BATCH, RN50_CLASSES = "resnet-50", 224, 32, 2
+RN50_ITERS = 10
+
+
+def measure_resnet50_train():
+    """ResNet-50 training samples/s — BASELINE.md north-star row 2 (ref:
+    Orca PyTorch Estimator, ResNet-50 on dogs-vs-cats [class_num=2], CPU
+    executors; apps/dogs-vs-cats)."""
+    import numpy as np
+    from analytics_zoo_tpu.models.image.imageclassification import (
+        ImageClassifier,
+    )
+
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal(
+        (RN50_BATCH, RN50_IMAGE, RN50_IMAGE, 3)).astype(np.float32)
+    y = rng.integers(0, RN50_CLASSES, RN50_BATCH).astype(np.int32)
+    clf = ImageClassifier(class_num=RN50_CLASSES, model_name=RN50_MODEL,
+                          image_size=RN50_IMAGE)
+    clf.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    est = clf.model._ensure_estimator(for_training=True)
+    dt, flops = _measure_step_time(est, x, y, warmup=2, iters=RN50_ITERS)
+    out = {"resnet50_train_samples_per_sec": round(RN50_BATCH / dt, 1),
+           "resnet50_train_step_ms": round(dt * 1e3, 2)}
+    if flops:
+        out["resnet50_train_tflops_per_s"] = round(flops / dt / 1e12, 2)
+    return out
+
+
+# Wide&Deep training shapes: census-income-scale column set
+# (ref WideAndDeep.scala:101 / census demo; shrunk by the smoke tests)
+WND_BATCH = 1024
+WND_ITERS = 10
+WND_DIMS = dict(wide_base=(16, 100), wide_cross=(1000,),
+                indicator=(9, 6), embed_in=(16, 1000),
+                embed_out=(8, 64), n_continuous=2)
+
+
+def measure_widedeep_train():
+    """Wide&Deep training samples/s — BASELINE.md north-star row 3 (ref:
+    NNEstimator/Keras-style Wide&Deep on a Spark DataFrame, CPU
+    executors)."""
+    import numpy as np
+    from analytics_zoo_tpu.models.recommendation import (
+        ColumnFeatureInfo, WideAndDeep,
+    )
+
+    d = WND_DIMS
+    info = ColumnFeatureInfo(
+        wide_base_cols=[f"wb{i}" for i in range(len(d["wide_base"]))],
+        wide_base_dims=list(d["wide_base"]),
+        wide_cross_cols=[f"wc{i}" for i in range(len(d["wide_cross"]))],
+        wide_cross_dims=list(d["wide_cross"]),
+        indicator_cols=[f"ind{i}" for i in range(len(d["indicator"]))],
+        indicator_dims=list(d["indicator"]),
+        embed_cols=[f"em{i}" for i in range(len(d["embed_in"]))],
+        embed_in_dims=list(d["embed_in"]),
+        embed_out_dims=list(d["embed_out"]),
+        continuous_cols=[f"con{i}" for i in range(d["n_continuous"])])
+    rng = np.random.default_rng(4)
+    B = WND_BATCH
+    wide = (rng.random((B, sum(d["wide_base"]) + sum(d["wide_cross"])))
+            < 0.05).astype(np.float32)
+    ind = (rng.random((B, sum(d["indicator"]))) < 0.2).astype(np.float32)
+    emb = np.stack([rng.integers(0, n, B) for n in d["embed_in"]],
+                   1).astype(np.float32)
+    con = rng.standard_normal((B, d["n_continuous"])).astype(np.float32)
+    y = rng.integers(0, 2, B).astype(np.int32)
+
+    wnd = WideAndDeep(2, info, model_type="wide_n_deep")
+    wnd.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    est = wnd.model._ensure_estimator(for_training=True)
+    dt, _ = _measure_step_time(est, (wide, ind, emb, con), y,
+                               warmup=2, iters=WND_ITERS)
+    return {"widedeep_train_samples_per_sec": round(B / dt, 1),
+            "widedeep_train_step_ms": round(dt * 1e3, 2)}
+
+
 def _cpu_fallback_line(wedge_note: str):
     """The wedged backend init holds jax's global backend lock, so no
     fallback is possible IN-PROCESS — but a fresh subprocess with
@@ -616,7 +703,8 @@ def main():
     }
     _run_with_deadline(
         out, (measure_bert, measure_tcn, measure_serving,
-              measure_flash_attention, measure_int8_predict),
+              measure_flash_attention, measure_int8_predict,
+              measure_resnet50_train, measure_widedeep_train),
         deadline_s=float(os.environ.get("BENCH_DEADLINE_S", 2700)))
 
 

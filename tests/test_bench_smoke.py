@@ -168,3 +168,25 @@ def test_run_with_deadline_completes_normally(tiny_bench, monkeypatch,
     bench._run_with_deadline(out, (lambda: {"a": 1},), deadline_s=30.0)
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["a"] == 1 and "error" not in rec
+
+
+def test_measure_resnet50_train(tiny_bench, orca_ctx, monkeypatch):
+    bench = tiny_bench
+    monkeypatch.setattr(bench, "RN50_MODEL", "resnet-lite")
+    monkeypatch.setattr(bench, "RN50_IMAGE", 32)
+    monkeypatch.setattr(bench, "RN50_BATCH", 8)
+    monkeypatch.setattr(bench, "RN50_ITERS", 2)
+    out = bench.measure_resnet50_train()
+    assert out["resnet50_train_samples_per_sec"] > 0
+    assert out["resnet50_train_step_ms"] > 0
+
+
+def test_measure_widedeep_train(tiny_bench, orca_ctx, monkeypatch):
+    bench = tiny_bench
+    monkeypatch.setattr(bench, "WND_BATCH", 16)
+    monkeypatch.setattr(bench, "WND_ITERS", 2)
+    monkeypatch.setattr(bench, "WND_DIMS", dict(
+        wide_base=(4, 6), wide_cross=(10,), indicator=(3, 2),
+        embed_in=(5, 7), embed_out=(3, 4), n_continuous=2))
+    out = bench.measure_widedeep_train()
+    assert out["widedeep_train_samples_per_sec"] > 0
