@@ -130,6 +130,9 @@ def test_run_with_deadline_emits_partial_on_stall(tiny_bench, monkeypatch,
     monkeypatch.setattr(
         bench, "measure_ncf",
         lambda: {"best": 7.0, "staged": 7.0, "cached": None})
+    # a slow cold jit in the real sanity probe must not outlast the tight
+    # test deadline and misroute into the early-fallback branch
+    monkeypatch.setattr(bench, "_device_sanity", lambda out: None)
     exited = {}
 
     def fake_exit(code):
@@ -164,6 +167,7 @@ def test_run_with_deadline_completes_normally(tiny_bench, monkeypatch,
     monkeypatch.setattr(
         bench, "measure_ncf",
         lambda: {"best": 7.0, "staged": 7.0, "cached": None})
+    monkeypatch.setattr(bench, "_device_sanity", lambda out: None)
     out = {"metric": "x", "device": "test"}
     bench._run_with_deadline(out, (lambda: {"a": 1},), deadline_s=30.0)
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
@@ -190,3 +194,38 @@ def test_measure_widedeep_train(tiny_bench, orca_ctx, monkeypatch):
         embed_in=(5, 7), embed_out=(3, 4), n_continuous=2))
     out = bench.measure_widedeep_train()
     assert out["widedeep_train_samples_per_sec"] > 0
+
+
+def test_run_with_deadline_early_cpu_fallback_when_sanity_stalls(
+        tiny_bench, monkeypatch, capsys):
+    """Wedged-after-init mode: if even the sanity dispatch never returns,
+    bench must emit the labeled CPU-fallback line quickly (exit 3)."""
+    import threading
+
+    bench = tiny_bench
+    release = threading.Event()
+
+    def fake_assemble(out, parts, current=None):
+        current["part"] = "device_sanity"
+        release.wait(30)
+
+    monkeypatch.setattr(bench, "_assemble_record", fake_assemble)
+    monkeypatch.setattr(
+        bench, "_cpu_fallback_line",
+        lambda note, timeout_s=2400.0: (
+            json.dumps({"metric": "x", "cpu_fallback": 1,
+                        "error": note}), None))
+    exited = {}
+
+    def fake_exit(code):
+        exited["code"] = code
+        raise SystemExit(code)
+
+    monkeypatch.setattr(bench.os, "_exit", fake_exit)
+    with pytest.raises(SystemExit):
+        bench._run_with_deadline({"metric": "x"}, (), deadline_s=1.0)
+    release.set()
+    assert exited["code"] == 3
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["cpu_fallback"] == 1
+    assert "wedged post-init" in rec["error"]
