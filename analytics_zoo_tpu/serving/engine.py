@@ -161,6 +161,14 @@ class ClusterServing:
         self.timer.record("dequeue", time.time() - t0)
 
         t0 = time.time()
+        # per-record error HSETs accumulate here and ride the same
+        # pipelined flush as the batch results — per-record round-trips
+        # dominated host time at large batch sizes. Every exit path below
+        # flushes err_cmds plus one XACK per dequeued entry (undecodable
+        # records included: their ack IS the final flush).
+        err_cmds: list = []
+        ack_cmds = [("XACK", self.stream, self.group, str(eid))
+                    for eid, _ in entries]
         uris, rows = [], []
         for eid, payload in entries:
             # one bad record (corrupt b64, wrong cipher, bad uri) must not
@@ -171,16 +179,16 @@ class ClusterServing:
                 schema.validate_uri(uri)
             except Exception as e:
                 logger.warning("dropping undecodable record %s: %s", eid, e)
-                client.xack(self.stream, self.group, eid)
                 continue
             try:
                 inputs = self._decode_images(inputs)
             except Exception as e:
                 # the uri is known: the client gets a real error result
                 # (ref stores per-record errors the same way)
-                client.hset(self.result_key, uri,
-                            schema.encode_error(
-                                f"image decode failed: {e}", self.cipher))
+                err_cmds.append((
+                    "HSET", self.result_key, uri,
+                    schema.encode_error(
+                        f"image decode failed: {e}", self.cipher)))
                 continue
             uris.append(uri)
             rows.append(inputs)
@@ -199,14 +207,14 @@ class ClusterServing:
                     kept_uris.append(uri)
                     kept.append(r)
                 else:
-                    client.hset(self.result_key, uri, schema.encode_error(
-                        f"tensor shapes {dict(best)} expected, got "
-                        f"{ {k: np.shape(v) for k, v in r.items()} }",
-                        self.cipher))
+                    err_cmds.append((
+                        "HSET", self.result_key, uri, schema.encode_error(
+                            f"tensor shapes {dict(best)} expected, got "
+                            f"{ {k: np.shape(v) for k, v in r.items()} }",
+                            self.cipher)))
             uris, rows = kept_uris, kept
         if not rows:
-            for eid, _ in entries:
-                client.xack(self.stream, self.group, eid)
+            client.pipeline(err_cmds + ack_cmds)
             return 0
         cols = self.input_cols or sorted(rows[0].keys())
         batch = [np.stack([r[c] for r in rows]) for c in cols]
@@ -226,23 +234,31 @@ class ClusterServing:
             # the entries are acked — losing them silently would hang the
             # clients AND pin the broker's GC low-water mark forever
             logger.exception("inference failed for batch of %d", n)
-            for uri in uris:
-                client.hset(self.result_key, uri, schema.encode_error(
-                    f"inference failed: {e}", self.cipher))
-            for eid, _ in entries:
-                client.xack(self.stream, self.group, eid)
+            err = schema.encode_error(f"inference failed: {e}", self.cipher)
+            client.pipeline(
+                err_cmds
+                + [("HSET", self.result_key, uri, err) for uri in uris]
+                + ack_cmds)
             self.timer.record("inference_error", time.time() - t0)
             return 0
         self.timer.record("inference", time.time() - t0)
 
         t0 = time.time()
+        cmds = list(err_cmds)
         for uri, pred in zip(uris, preds):
-            if self.postprocess is not None:
-                pred = self.postprocess(pred)
-            client.hset(self.result_key, uri,
-                        schema.encode_result(pred, self.cipher))
-        for eid, _ in entries:
-            client.xack(self.stream, self.group, eid)
+            # a postprocess/encode failure on ONE record must not discard
+            # the whole batch's results and acks (the batch would XCLAIM-
+            # redeliver and fail deterministically forever)
+            try:
+                if self.postprocess is not None:
+                    pred = self.postprocess(pred)
+                val = schema.encode_result(pred, self.cipher)
+            except Exception as e:
+                logger.warning("postprocess failed for %s: %s", uri, e)
+                val = schema.encode_error(
+                    f"postprocess failed: {e}", self.cipher)
+            cmds.append(("HSET", self.result_key, uri, val))
+        client.pipeline(cmds + ack_cmds)
         self.timer.record("postprocess", time.time() - t0)
         self.records_out += n
         return n

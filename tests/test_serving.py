@@ -770,3 +770,41 @@ class TestArrowWireFormat:
         _, inputs = schema.decode_record(payload)
         assert not isinstance(inputs["words"], schema.ImageBytes)
         assert list(inputs["words"]) == ["Qk1hcmtldA=="]
+
+
+class TestPostprocessFailure:
+    def test_one_bad_postprocess_keeps_rest_of_batch(self, broker):
+        """A postprocess exception on one record must produce an error
+        result for THAT record only — the rest of the batch still gets
+        results and everything is acked (no XCLAIM redelivery loop)."""
+        im, torch_m = _make_model()
+
+        rng = np.random.RandomState(3)
+        xs = {f"p{i}": rng.randn(4).astype(np.float32) for i in range(8)}
+        import torch
+        wants = {u: torch_m(torch.from_numpy(x[None])).detach().numpy()[0]
+                 for u, x in xs.items()}
+        thr = float(np.median([w[0] for w in wants.values()]))
+
+        def post(pred):
+            if pred[0] > thr:           # deterministic per-record failure
+                raise ValueError("boom")
+            return pred
+
+        bad = {u for u, w in wants.items() if w[0] > thr}
+        assert bad and len(bad) < len(xs)   # the median splits the batch
+        with ClusterServing(im, broker.port, batch_size=4,
+                            postprocess=post).start():
+            in_q = InputQueue(port=broker.port)
+            out_q = OutputQueue(port=broker.port)
+            for uri, x in xs.items():
+                in_q.enqueue(uri, x=x)
+            for uri in xs:
+                if uri in bad:
+                    with pytest.raises(schema.ServingError, match="postprocess"):
+                        out_q.query(uri, timeout=20.0)
+                else:
+                    got = out_q.query(uri, timeout=20.0)
+                    np.testing.assert_allclose(got, wants[uri], atol=1e-4)
+        # nothing left pending: the batch was fully acked despite the error
+        assert broker.client().xpending("serving_stream", "serving") == 0
