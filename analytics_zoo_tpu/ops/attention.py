@@ -20,6 +20,7 @@ from typing import Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from flax.linen.dtypes import promote_dtype
 
 
 def dot_product_attention(q, k, v, mask=None, causal: bool = False,
@@ -71,6 +72,37 @@ def _reference_attention(q, k, v, mask=None, causal=False,
     return (out, probs) if return_probs else out
 
 
+class _ProjParams(nn.Module):
+    """Holds one head-projection's parameters without computing anything.
+
+    Shapes and initialization reproduce ``nn.DenseGeneral((heads, head_dim))``
+    exactly (kernel initialized on the flattened (in, heads*head_dim) shape,
+    then reshaped), so the param tree is bit-identical to the DenseGeneral
+    formulation this replaced — HF checkpoint import (text/hf_import.py) and
+    the TP partition rules (text/bert.py bert_tp_rules) key on these names.
+    Keeping the three projections as separate params but computing them as
+    ONE packed matmul is measurably faster on the MXU (one 768×2304 matmul
+    beats three 768×768 at BERT shapes) without changing any checkpoint."""
+
+    in_features: int
+    heads: int
+    head_dim: int
+
+    @nn.compact
+    def __call__(self):
+        h, d = self.heads, self.head_dim
+
+        def kernel_init(rng, *_):
+            flat = nn.initializers.lecun_normal()(
+                rng, (self.in_features, h * d), jnp.float32)
+            return flat.reshape(self.in_features, h, d)
+
+        kernel = self.param("kernel", kernel_init)
+        bias = self.param("bias", nn.initializers.zeros_init(), (h, d),
+                          jnp.float32)
+        return kernel, bias
+
+
 class AttentionModule(nn.Module):
     """Projection + fused attention + output projection.
 
@@ -85,11 +117,30 @@ class AttentionModule(nn.Module):
 
     @nn.compact
     def __call__(self, q_in, kv_in=None, mask=None, train: bool = False):
+        # identity check so callers that pass the same array explicitly
+        # (keras MultiHeadAttention does) still get the packed matmul
+        self_attn = kv_in is None or kv_in is q_in
         kv_in = q_in if kv_in is None else kv_in
         h, d = self.num_heads, self.head_dim
-        q = nn.DenseGeneral((h, d), dtype=self.dtype, name="query")(q_in)
-        k = nn.DenseGeneral((h, d), dtype=self.dtype, name="key")(kv_in)
-        v = nn.DenseGeneral((h, d), dtype=self.dtype, name="value")(kv_in)
+        wq, bq = _ProjParams(q_in.shape[-1], h, d, name="query")()
+        wk, bk = _ProjParams(kv_in.shape[-1], h, d, name="key")()
+        wv, bv = _ProjParams(kv_in.shape[-1], h, d, name="value")()
+        if self_attn:
+            # one packed (in, 3·h·d) matmul instead of three (in, h·d)
+            w = jnp.concatenate(
+                [p.reshape(p.shape[0], h * d) for p in (wq, wk, wv)], -1)
+            b = jnp.concatenate(
+                [p.reshape(h * d) for p in (bq, bk, bv)])
+            x, w, b = promote_dtype(q_in, w, b, dtype=self.dtype)
+            qkv = (x @ w + b).reshape(*x.shape[:-1], 3, h, d)
+            q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        else:
+            def proj(x, w, b):
+                x, w, b = promote_dtype(x, w, b, dtype=self.dtype)
+                return jnp.einsum("...i,ihd->...hd", x, w) + b
+            q = proj(q_in, wq, bq)
+            k = proj(kv_in, wk, bk)
+            v = proj(kv_in, wv, bv)
         out = dot_product_attention(q, k, v, mask=mask, causal=self.causal)
         out = nn.DenseGeneral(q_in.shape[-1], axis=(-2, -1),
                               dtype=self.dtype, name="out")(out)

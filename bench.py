@@ -183,7 +183,11 @@ def _measure_step_time(est, x, y, warmup=3, iters=10):
 BERT_SEQ = 128
 BERT_BATCHES = (32, 64, 128)    # canonical first; sweep amortizes the
                                 # optimizer's flat ~3 GB/step HBM traffic
-BERT_SCAN_STEPS = 8             # optimizer steps fused per dispatch
+BERT_SCAN_STEPS = 16            # optimizer steps fused per dispatch
+                                # (the axon tunnel adds a ~30 ms flat
+                                # cost per dispatch; 16 fused steps
+                                # amortize it to ~2 ms/step, matching
+                                # how fit(steps_per_loop=16+) runs)
 BERT_CFG_KW: dict = {}          # test hook: shrink the model
 
 
@@ -266,6 +270,9 @@ def measure_bert():
             out.update({
                 "bert_step_ms": round(dt * 1e3, 2),
                 "bert_scan_step_ms": round(dt_scan * 1e3, 2),
+                # scan metrics are per-step within this many fused
+                # steps; the knob changed 8->16 in r5, so record it
+                "bert_scan_steps": BERT_SCAN_STEPS,
                 "bert_step_tflops":
                     round(flops / 1e12, 3) if flops else None,
                 "bert_achieved_tflops_per_s":
