@@ -113,7 +113,8 @@ def calibrate_activations(apply_fn, state, batches) -> dict:
 
     def observer(next_fun, args, kwargs, context):
         mod = context.module
-        if isinstance(mod, nn.Dense) and args and hasattr(args[0], "shape"):
+        if isinstance(mod, (nn.Dense, nn.Conv)) and args \
+                and hasattr(args[0], "shape"):
             path = _module_path(mod)
             amax[path] = max(amax.get(path, 0.0),
                              float(jnp.max(jnp.abs(args[0]))))
@@ -125,9 +126,9 @@ def calibrate_activations(apply_fn, state, batches) -> dict:
             apply_fn(state, *xs)
     if not amax:
         raise ValueError(
-            "calibration saw no flax nn.Dense layers — activation int8 "
-            "covers flax/zoo-keras models (torch-translated graphs run "
-            "weight-only quantization instead)")
+            "calibration saw no flax nn.Dense/nn.Conv layers — activation "
+            "int8 covers flax/zoo-keras models (torch-translated graphs "
+            "run weight-only quantization instead)")
     return amax
 
 
@@ -154,11 +155,50 @@ def _lookup_quantized_kernel(qparams, path_parts):
     return None
 
 
+_CONV_DIMS = {1: ("NWC", "WIO", "NWC"),
+              2: ("NHWC", "HWIO", "NHWC"),
+              3: ("NDHWC", "DHWIO", "NDHWC")}
+
+
+def _conv_tuple(v, rank, default=1):
+    """Normalize a flax Conv stride/dilation attr to a rank-length tuple."""
+    if v is None:
+        v = default
+    if isinstance(v, int):
+        return (v,) * rank
+    return tuple(v)
+
+
+def _conv_padding(padding, rank):
+    """Canonicalize a flax Conv padding attr the way flax itself does
+    (flax keeps the raw user value on the module: int, pair, sequence of
+    ints/pairs, or string). Returns a lax-compatible value or None for
+    anything unsupported (→ caller falls back to float)."""
+    if isinstance(padding, str):
+        p = padding.upper()
+        return p if p in ("SAME", "VALID") else None
+    if isinstance(padding, int):
+        return ((padding, padding),) * rank
+    if isinstance(padding, (tuple, list)):
+        out = []
+        for e in padding:
+            if isinstance(e, int):
+                out.append((e, e))
+            elif isinstance(e, (tuple, list)) and len(e) == 2:
+                out.append(tuple(e))
+            else:
+                return None
+        return tuple(out) if len(out) == rank else None
+    return None
+
+
 def int8_interceptor(act_amax: dict, qparams=None):
     """flax method interceptor executing calibrated nn.Dense layers as
-    int8×int8→int32 ``lax.dot_general`` (the MXU int8 path), rescaled by
-    act_scale · per-channel weight scale. Uncalibrated layers and
-    non-Dense modules fall through to float.
+    int8×int8→int32 ``lax.dot_general`` and calibrated nn.Conv layers as
+    int8 ``conv_general_dilated`` (both the MXU int8 path — convs lower
+    to the systolic array the same way matmuls do), rescaled by
+    act_scale · per-channel weight scale. Uncalibrated layers and other
+    modules fall through to float.
 
     ``qparams``: the weight-quantized state tree — when the layer's kernel
     is stored as a QuantizedLeaf there, its int8 values/scales are used
@@ -168,32 +208,61 @@ def int8_interceptor(act_amax: dict, qparams=None):
     import flax.linen as nn
     import jax.numpy as jnp
 
+    def quantized_kernel(mod, params):
+        stored = _lookup_quantized_kernel(qparams, mod.path)
+        if stored is not None:
+            return stored.q, jnp.reshape(stored.scale, (-1,))    # (out,)
+        kernel = params["kernel"]
+        # per-output-channel (last axis); no keepdims: a (1, out) scale
+        # would add a rank to 1-D (e.g. vmapped) inputs' outputs
+        w_amax = jnp.max(jnp.abs(kernel),
+                         axis=tuple(range(kernel.ndim - 1)))
+        s_w = jnp.where(w_amax == 0, 1.0, w_amax / 127.0)
+        wq = jnp.clip(jnp.round(kernel / s_w), -127, 127).astype(jnp.int8)
+        return wq, s_w
+
     def interceptor(next_fun, args, kwargs, context):
         mod = context.module
-        if not isinstance(mod, nn.Dense):
+        is_dense = isinstance(mod, nn.Dense)
+        is_conv = isinstance(mod, nn.Conv)
+        if not (is_dense or is_conv):
             return next_fun(*args, **kwargs)
         path = _module_path(mod)
         if path not in act_amax or not args or args[0].ndim < 1:
             return next_fun(*args, **kwargs)
         x = args[0]
+        if is_conv:
+            # flax stores kernel_size raw: nn.Conv(4, 3) keeps the int
+            ks = mod.kernel_size
+            ks = (ks,) if isinstance(ks, int) else tuple(ks)
+            rank = len(ks)
+            padding = _conv_padding(mod.padding, rank)
+            # stick to the common jit shapes/options; anything exotic
+            # (unbatched call, circular padding, masked kernel, >3D)
+            # runs float
+            if (rank not in _CONV_DIMS or x.ndim != rank + 2
+                    or padding is None or mod.mask is not None):
+                return next_fun(*args, **kwargs)
         params = mod.variables["params"]
         s_in = jnp.float32(max(act_amax[path], 1e-8) / 127.0)
         xq = jnp.clip(jnp.round(x / s_in), -127, 127).astype(jnp.int8)
-        stored = _lookup_quantized_kernel(qparams, mod.path)
-        if stored is not None:
-            wq = stored.q
-            s_w = jnp.reshape(stored.scale, (-1,))      # (out,)
+        wq, s_w = quantized_kernel(mod, params)
+        if is_dense:
+            y = jax.lax.dot_general(
+                xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
         else:
-            kernel = params["kernel"]
-            # no keepdims: a (1, out) scale would add a rank to 1-D
-            # (e.g. vmapped) inputs' outputs
-            w_amax = jnp.max(jnp.abs(kernel), axis=0)
-            s_w = jnp.where(w_amax == 0, 1.0, w_amax / 127.0)
-            wq = jnp.clip(jnp.round(kernel / s_w), -127,
-                          127).astype(jnp.int8)
-        y = jax.lax.dot_general(
-            xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32)
+            dn = jax.lax.conv_dimension_numbers(
+                x.shape, wq.shape, _CONV_DIMS[rank])
+            y = jax.lax.conv_general_dilated(
+                xq, wq,
+                window_strides=_conv_tuple(mod.strides, rank),
+                padding=padding,
+                lhs_dilation=_conv_tuple(mod.input_dilation, rank),
+                rhs_dilation=_conv_tuple(mod.kernel_dilation, rank),
+                dimension_numbers=dn,
+                feature_group_count=mod.feature_group_count,
+                preferred_element_type=jnp.int32)
         y = y.astype(jnp.float32) * (s_in * s_w)
         if mod.use_bias:
             y = y + params["bias"]
@@ -203,7 +272,7 @@ def int8_interceptor(act_amax: dict, qparams=None):
 
 
 def int8_apply(apply_fn, act_amax: dict):
-    """Wrap an ``apply_fn(state, *xs)`` so every calibrated Dense runs
+    """Wrap an ``apply_fn(state, *xs)`` so every calibrated Dense/Conv runs
     int8 (jit-compatible: interception happens while tracing). The
     call-time state feeds the interceptor so stored int8 kernels are
     consumed directly."""

@@ -3,6 +3,8 @@ pyzoo/test/zoo/pipeline/inference/ and .../net/test_torch_net.py)."""
 
 import threading
 
+import re
+
 import numpy as np
 import pytest
 
@@ -733,6 +735,104 @@ class TestActivationInt8:
         im = InferenceModel().load_torch(m, x)
         with pytest.raises(ValueError, match="no flax nn.Dense"):
             im.quantize(mode="int8", calibration_data=x)
+
+    def test_conv_net_int8_matches_fp32(self, orca_ctx):
+        """Calibrated activation int8 must cover nn.Conv (ResNet-class
+        models are ~99% conv FLOPs — Dense-only coverage left them
+        effectively unquantized): argmax agreement + the jaxpr must show
+        an int8 convolution with int32 accumulation."""
+        import jax
+        import flax.linen as nn
+        from analytics_zoo_tpu.inference import InferenceModel
+
+        class ConvNet(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = nn.relu(nn.Conv(8, (3, 3), strides=2, name="c1")(x))
+                x = nn.relu(nn.Conv(16, (3, 3), padding="VALID",
+                                    name="c2")(x))
+                x = x.reshape(x.shape[0], -1)
+                return nn.Dense(4, name="head")(x)
+
+        rs = np.random.RandomState(7)
+        x = rs.randn(32, 12, 12, 3).astype(np.float32)
+        im = InferenceModel().load_flax(ConvNet(), x[:1])
+        ref = im.predict(x)
+        im.quantize(mode="int8", calibration_data=x[:16], min_elems=64)
+        got = im.predict(x)
+        assert got.shape == ref.shape
+        agree = (got.argmax(1) == ref.argmax(1)).mean()
+        assert agree >= 0.9, agree
+        nrmse = float(np.sqrt(np.mean((got - ref) ** 2)) / ref.std())
+        assert nrmse < 0.15, nrmse
+        jaxpr = str(jax.make_jaxpr(
+            lambda s, a: im._apply(s, a))(im._params, x[:4]))
+        assert re.search(
+            r"conv_general_dilated\[[^]]*preferred_element_type=int32",
+            jaxpr, re.S), "conv did not lower with int32 accumulation"
+        assert "i8[" in jaxpr
+
+    def test_conv_raw_int_attrs_quantize_or_fall_back(self, orca_ctx):
+        """flax keeps kernel_size/padding raw on the module (nn.Conv(4, 3)
+        → kernel_size == 3, padding=1 stays 1): the int8 path must
+        canonicalize them, not crash at trace time after a successful
+        quantize()."""
+        import flax.linen as nn
+        from analytics_zoo_tpu.inference import InferenceModel
+
+        class RawAttrNet(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = nn.relu(nn.Conv(8, (3, 3), padding=1, name="c1")(x))
+                x = nn.relu(nn.Conv(8, (3, 3), padding=(1, 1),
+                                    name="c2")(x))
+                x = x.mean(axis=(1, 2))
+                return nn.Dense(3, name="head")(x)
+
+        rs = np.random.RandomState(5)
+        x = rs.randn(16, 10, 10, 3).astype(np.float32)
+        im = InferenceModel().load_flax(RawAttrNet(), x[:1])
+        ref = im.predict(x)
+        im.quantize(mode="int8", calibration_data=x[:8], min_elems=64)
+        got = im.predict(x)           # must not raise
+        assert (got.argmax(1) == ref.argmax(1)).mean() >= 0.85
+
+        class Conv1DNet(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = nn.relu(nn.Conv(8, 3, name="c1")(x))   # int kernel_size
+                x = x.mean(axis=1)
+                return nn.Dense(3, name="head")(x)
+
+        x1 = rs.randn(16, 12, 4).astype(np.float32)
+        im1 = InferenceModel().load_flax(Conv1DNet(), x1[:1])
+        ref1 = im1.predict(x1)
+        im1.quantize(mode="int8", calibration_data=x1[:8], min_elems=32)
+        got1 = im1.predict(x1)        # must not raise
+        assert (got1.argmax(1) == ref1.argmax(1)).mean() >= 0.85
+
+    def test_depthwise_grouped_conv_int8(self, orca_ctx):
+        """feature_group_count (mobilenet depthwise) goes through the int8
+        conv path with per-output-channel scales intact."""
+        import flax.linen as nn
+        from analytics_zoo_tpu.inference import InferenceModel
+
+        class DWNet(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = nn.Conv(12, (1, 1), name="expand")(x)
+                x = nn.relu(nn.Conv(12, (3, 3), feature_group_count=12,
+                                    name="dw")(x))
+                x = x.reshape(x.shape[0], -1)
+                return nn.Dense(3, name="head")(x)
+
+        rs = np.random.RandomState(11)
+        x = rs.randn(24, 8, 8, 4).astype(np.float32)
+        im = InferenceModel().load_flax(DWNet(), x[:1])
+        ref = im.predict(x)
+        im.quantize(mode="int8", calibration_data=x[:12], min_elems=32)
+        got = im.predict(x)
+        assert (got.argmax(1) == ref.argmax(1)).mean() >= 0.85
 
     def test_zoo_keras_model_int8_end_to_end(self, orca_ctx):
         """The zoo-keras GraphModule path: its Dense layers are flax
