@@ -113,8 +113,11 @@ def calibrate_activations(apply_fn, state, batches) -> dict:
 
     def observer(next_fun, args, kwargs, context):
         mod = context.module
-        if isinstance(mod, (nn.Dense, nn.Conv)) and args \
-                and hasattr(args[0], "shape"):
+        supported = (isinstance(mod, nn.Dense)
+                     or (isinstance(mod, nn.Conv)
+                         and args and hasattr(args[0], "ndim")
+                         and _conv_int8_plan(mod, args[0].ndim) is not None))
+        if supported and args and hasattr(args[0], "shape"):
             path = _module_path(mod)
             amax[path] = max(amax.get(path, 0.0),
                              float(jnp.max(jnp.abs(args[0]))))
@@ -192,6 +195,23 @@ def _conv_padding(padding, rank):
     return None
 
 
+def _conv_int8_plan(mod, x_ndim):
+    """Return (rank, lax padding) when this nn.Conv call can run int8,
+    else None (exotic options — circular/causal padding, masked kernel,
+    >3 spatial dims, unbatched input — run float). Shared by the
+    calibration observer and the executing interceptor so a model whose
+    every conv is unsupported fails calibration LOUDLY instead of
+    silently running float."""
+    ks = mod.kernel_size
+    ks = (ks,) if isinstance(ks, int) else tuple(ks)
+    rank = len(ks)
+    padding = _conv_padding(mod.padding, rank)
+    if (rank not in _CONV_DIMS or x_ndim != rank + 2
+            or padding is None or mod.mask is not None):
+        return None
+    return rank, padding
+
+
 def int8_interceptor(act_amax: dict, qparams=None):
     """flax method interceptor executing calibrated nn.Dense layers as
     int8×int8→int32 ``lax.dot_general`` and calibrated nn.Conv layers as
@@ -232,17 +252,10 @@ def int8_interceptor(act_amax: dict, qparams=None):
             return next_fun(*args, **kwargs)
         x = args[0]
         if is_conv:
-            # flax stores kernel_size raw: nn.Conv(4, 3) keeps the int
-            ks = mod.kernel_size
-            ks = (ks,) if isinstance(ks, int) else tuple(ks)
-            rank = len(ks)
-            padding = _conv_padding(mod.padding, rank)
-            # stick to the common jit shapes/options; anything exotic
-            # (unbatched call, circular padding, masked kernel, >3D)
-            # runs float
-            if (rank not in _CONV_DIMS or x.ndim != rank + 2
-                    or padding is None or mod.mask is not None):
+            plan = _conv_int8_plan(mod, x.ndim)
+            if plan is None:
                 return next_fun(*args, **kwargs)
+            rank, padding = plan
         params = mod.variables["params"]
         s_in = jnp.float32(max(act_amax[path], 1e-8) / 127.0)
         xq = jnp.clip(jnp.round(x / s_in), -127, 127).astype(jnp.int8)

@@ -95,11 +95,17 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, o_scr, m_scr, l_scr, *,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)         # [block_q, d]
-        k_blk = k_ref[0].astype(jnp.float32)     # [block_k, d] (streamed)
-        v_blk = v_ref[0].astype(jnp.float32)
-        scale = 1.0 / jnp.sqrt(q.shape[-1])
-        s = q @ k_blk.T * scale                  # [block_q, block_k]
+        # MXU matmuls stay in the input dtype (bf16 doubles throughput on
+        # v5e); softmax state and the output accumulator are fp32 — the
+        # standard flash mixed-precision split. preferred_element_type
+        # gives fp32 accumulation inside the MXU either way.
+        q = q_ref[0]                             # [block_q, d]
+        k_blk = k_ref[0]                         # [block_k, d] (streamed)
+        v_blk = v_ref[0]
+        scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
+        s = jax.lax.dot_general(                 # [block_q, block_k] fp32
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
@@ -112,7 +118,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, o_scr, m_scr, l_scr, *,
         p = jnp.exp(s - m_new[:, None])
         corr = jnp.exp(m - m_new)
         l_new = l * corr + p.sum(-1)
-        o_scr[...] = o_scr[...] * corr[:, None] + p @ v_blk
+        pv = jax.lax.dot_general(                # p in v's dtype → MXU rate
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        o_scr[...] = o_scr[...] * corr[:, None] + pv
         m_scr[:, 0] = m_new
         l_scr[:, 0] = l_new
 

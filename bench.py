@@ -402,16 +402,40 @@ def measure_flash_attention():
                                                          causal=True))
     out = {"blockwise_attn_seq_ms": round(dt_block * 1e3, 3),
            "flash_attn_seq": S}
-    try:
+    # small block-size autotune (XLA autotunes its own fusion choices;
+    # give the pallas kernel the same courtesy) — best config is recorded
+    errors = []
+    for bq, bk in ((128, 128), (128, 256), (256, 256), (256, 512),
+                   (512, 512)):
+        if S % bq or S % bk or bq > S or bk > S:
+            continue
+        try:
+            dt_flash = timed(lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                q, k, v, causal=True, block_q=bq, block_k=bk))
+        except Exception as e:  # pallas is TPU-only: keep the blockwise
+            errors.append(f"{bq}x{bk}: {e!r}"[:120])
+            continue
+        if dt_flash * 1e3 < out.get("flash_attn_seq_ms", float("inf")):
+            out["flash_attn_seq_ms"] = round(dt_flash * 1e3, 3)
+            out["flash_attn_block"] = f"{bq}x{bk}"
+    if "flash_attn_seq_ms" not in out and not errors:
+        # no grid candidate divided S (tiny smoke shapes): fall back to
+        # the legacy single config so S always gets a number or a REAL
+        # error, never a blank diagnostic
         bq = min(128, S)
-        dt_flash = timed(lambda q, k, v: flash_attention(
-            q, k, v, causal=True, block_q=bq, block_k=bq))
-        out["flash_attn_seq_ms"] = round(dt_flash * 1e3, 3)
-        out["flash_vs_blockwise_speedup"] = round(dt_block / dt_flash, 3)
-    except Exception as e:
-        # pallas is TPU-only: on the CPU fallback (or a kernel break)
-        # record the blockwise number + the reason instead of losing both
-        out["flash_attn_error"] = repr(e)[:160]
+        try:
+            dt_flash = timed(lambda q, k, v: flash_attention(
+                q, k, v, causal=True, block_q=bq, block_k=bq))
+            out["flash_attn_seq_ms"] = round(dt_flash * 1e3, 3)
+            out["flash_attn_block"] = f"{bq}x{bq}"
+        except Exception as e:
+            errors.append(f"{bq}x{bq}: {e!r}"[:120])
+    if "flash_attn_seq_ms" in out:
+        out["flash_vs_blockwise_speedup"] = round(
+            dt_block / (out["flash_attn_seq_ms"] / 1e3), 3)
+    else:
+        # record the reason instead of losing both numbers
+        out["flash_attn_error"] = "; ".join(errors)[:160]
     return out
 
 
