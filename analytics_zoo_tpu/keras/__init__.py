@@ -2,3 +2,4 @@ from analytics_zoo_tpu.keras import layers  # noqa: F401
 from analytics_zoo_tpu.keras import regularizers  # noqa: F401
 from analytics_zoo_tpu.keras.engine import Input  # noqa: F401
 from analytics_zoo_tpu.keras.models import Sequential, Model  # noqa: F401
+from analytics_zoo_tpu.keras import policy  # noqa: F401

@@ -26,9 +26,19 @@ class KerasLayer(_KerasLayerBase):
     """Layer base that records ``input_shape`` (used when a layer opens a
     Sequential, ref pyzoo keras layers' input_shape kwarg)."""
 
+    # class-level default keeps topology.pkl files pickled before the
+    # dtype-policy attribute existed loadable (same trick as
+    # SeparableConv2D.depth_multiplier)
+    compute_dtype = None
+
     def __init__(self, name=None, input_shape=None):
         super().__init__(name)
         self.input_shape = tuple(input_shape) if input_shape is not None else None
+        # mixed-precision policy snapshot (keras/policy.py): taken when
+        # the layer object is constructed so deferred make_module() calls
+        # are not affected by later policy flips
+        from analytics_zoo_tpu.keras import policy as _policy
+        self.compute_dtype = _policy.compute_dtype()
         # flax param-collection key ("kernel"/"bias") → Regularizer; the
         # model assembles these into one penalty added to the training loss
         # (ref BigDL wRegularizer/bRegularizer on every layer)
@@ -116,7 +126,8 @@ class Dense(KerasLayer):
 
     def make_module(self):
         return nn.Dense(self.output_dim, use_bias=self.bias,
-                        kernel_init=self.init, name=self.name)
+                        kernel_init=self.init, dtype=self.compute_dtype,
+                        name=self.name)
 
     def apply(self, module, args, train):
         return self.activation(module(args[0]))
@@ -322,7 +333,8 @@ class Embedding(KerasLayer):
 
     def make_module(self):
         return nn.Embed(self.input_dim, self.output_dim,
-                        embedding_init=self.init, name=self.name)
+                        embedding_init=self.init, dtype=self.compute_dtype,
+                        name=self.name)
 
     def apply(self, module, args, train):
         ids = args[0].astype(jnp.int32)
@@ -345,8 +357,8 @@ class BatchNormalization(KerasLayer):
 
     def make_module(self):
         return nn.BatchNorm(use_running_average=None, momentum=self.momentum,
-                            epsilon=self.epsilon, name=self.name,
-                            axis_name=None)
+                            epsilon=self.epsilon, dtype=self.compute_dtype,
+                            name=self.name, axis_name=None)
 
     def apply(self, module, args, train):
         return module(args[0], use_running_average=not train)
@@ -358,7 +370,8 @@ class LayerNormalization(KerasLayer):
         self.epsilon = epsilon
 
     def make_module(self):
-        return nn.LayerNorm(epsilon=self.epsilon, name=self.name)
+        return nn.LayerNorm(epsilon=self.epsilon,
+                            dtype=self.compute_dtype, name=self.name)
 
     def apply(self, module, args, train):
         return module(args[0])
@@ -407,7 +420,8 @@ class Conv1D(KerasLayer):
         return nn.Conv(self.nb_filter, (self.filter_length,),
                        strides=(self.stride,), padding=self.padding,
                        kernel_dilation=(self.dilation,), use_bias=self.bias,
-                       kernel_init=self.init, name=self.name)
+                       kernel_init=self.init, dtype=self.compute_dtype,
+                       name=self.name)
 
     def apply(self, module, args, train):
         return self.activation(module(args[0]))
@@ -437,7 +451,8 @@ class Conv2D(KerasLayer):
     def make_module(self):
         return nn.Conv(self.nb_filter, self.kernel, strides=self.strides,
                        padding=self.padding, use_bias=self.bias,
-                       kernel_init=self.init, name=self.name)
+                       kernel_init=self.init, dtype=self.compute_dtype,
+                       name=self.name)
 
     def apply(self, module, args, train):
         return self.activation(module(args[0]))
@@ -473,6 +488,7 @@ class SeparableConv2D(KerasLayer):
             strides: tuple
             padding: str
             depth_multiplier: int
+            dtype: object = None
 
             @nn.compact
             def __call__(self, x):
@@ -480,11 +496,13 @@ class SeparableConv2D(KerasLayer):
                 x = nn.Conv(c * self.depth_multiplier, self.kernel,
                             strides=self.strides,
                             padding=self.padding, feature_group_count=c,
-                            name="depthwise")(x)
-                return nn.Conv(self.nb_filter, (1, 1), name="pointwise")(x)
+                            dtype=self.dtype, name="depthwise")(x)
+                return nn.Conv(self.nb_filter, (1, 1), dtype=self.dtype,
+                               name="pointwise")(x)
 
         return _Sep(self.nb_filter, self.kernel, self.strides, self.padding,
-                    self.depth_multiplier, name=self.name)
+                    self.depth_multiplier, self.compute_dtype,
+                    name=self.name)
 
     def apply(self, module, args, train):
         return self.activation(module(args[0]))
@@ -615,6 +633,8 @@ class _RNNBase(KerasLayer):
         # activation=None means linear, like every other layer here
         if self.activation != "tanh":
             kwargs["activation_fn"] = get_activation(self.activation)
+        if self.compute_dtype is not None:
+            kwargs["dtype"] = self.compute_dtype
         return self.cell_cls(features=self.output_dim, **kwargs)
 
     def make_module(self):
@@ -700,7 +720,7 @@ class MultiHeadAttention(KerasLayer):
         return AttentionModule(num_heads=self.num_heads,
                                head_dim=self.head_dim,
                                dropout=self.dropout, causal=self.causal,
-                               name=self.name)
+                               dtype=self.compute_dtype, name=self.name)
 
     def apply(self, module, args, train):
         q = args[0]
@@ -815,7 +835,7 @@ class TransformerLayer(KerasLayer):
             vocab=self.vocab, hidden_size=self.hidden_size,
             n_block=self.n_block, n_head=self.n_head,
             hidden_drop=self.hidden_drop, max_position_len=self.seq_len,
-            name=self.name)
+            dtype=self.compute_dtype, name=self.name)
 
     def apply(self, module, args, train):
         return module(args[0], train=train)
@@ -841,7 +861,7 @@ class BERT(KerasLayer):
             vocab=vocab, hidden_size=hidden_size, n_block=n_block,
             n_head=n_head, intermediate_size=intermediate_size,
             max_position_len=max_position_len, hidden_drop=hidden_drop,
-            attn_drop=attn_drop)
+            attn_drop=attn_drop, dtype=self.compute_dtype)
         self.output = output
 
     def _infer_shape(self, in_shapes):
@@ -1309,7 +1329,8 @@ class Conv3D(KerasLayer):
     def make_module(self):
         return nn.Conv(self.nb_filter, self.kernel, strides=self.strides,
                        padding=self.padding, use_bias=self.bias,
-                       kernel_init=self.init, name=self.name)
+                       kernel_init=self.init, dtype=self.compute_dtype,
+                       name=self.name)
 
     def apply(self, module, args, train):
         return self.activation(module(args[0]))
@@ -1353,7 +1374,7 @@ class AtrousConvolution2D(KerasLayer):
         return nn.Conv(self.nb_filter, self.kernel, strides=self.strides,
                        padding=self.padding, kernel_dilation=self.rate,
                        use_bias=self.bias, kernel_init=self.init,
-                       name=self.name)
+                       dtype=self.compute_dtype, name=self.name)
 
     def apply(self, module, args, train):
         return self.activation(module(args[0]))
@@ -1379,7 +1400,7 @@ class Deconvolution2D(KerasLayer):
         return nn.ConvTranspose(self.nb_filter, self.kernel,
                                 strides=self.strides, padding=self.padding,
                                 use_bias=self.bias, kernel_init=self.init,
-                                name=self.name)
+                                dtype=self.compute_dtype, name=self.name)
 
     def apply(self, module, args, train):
         return self.activation(module(args[0]))
@@ -1496,7 +1517,8 @@ class ConvLSTM2D(KerasLayer):
 
     def make_module(self):
         cell = nn.ConvLSTMCell(features=self.nb_filter,
-                               kernel_size=(self.nb_kernel,) * self._kdims)
+                               kernel_size=(self.nb_kernel,) * self._kdims,
+                               dtype=self.compute_dtype)
         return nn.RNN(cell, reverse=self.go_backwards, name=self.name)
 
     def apply(self, module, args, train):
@@ -1701,16 +1723,17 @@ class Highway(_ModuleLayer):
         self.bias = bias
 
     def make_module(self):
-        act, use_bias = self.activation, self.bias
+        act, use_bias, cdt = self.activation, self.bias, self.compute_dtype
 
         class _Highway(nn.Module):
             @nn.compact
             def __call__(self, x):
                 d = x.shape[-1]
-                t = nn.sigmoid(nn.Dense(d, use_bias=use_bias,
+                t = nn.sigmoid(nn.Dense(d, use_bias=use_bias, dtype=cdt,
                                         name="transform")(x))
-                h = act(nn.Dense(d, use_bias=use_bias, name="h")(x))
-                return t * h + (1.0 - t) * x
+                h = act(nn.Dense(d, use_bias=use_bias, dtype=cdt,
+                                name="h")(x))
+                return t * h + (1.0 - t) * x.astype(t.dtype)
 
         return _Highway(name=self.name)
 
@@ -1728,11 +1751,12 @@ class MaxoutDense(KerasLayer):
 
     def make_module(self):
         od, k, use_bias = self.output_dim, self.nb_feature, self.bias
+        cdt = self.compute_dtype
 
         class _Maxout(nn.Module):
             @nn.compact
             def __call__(self, x):
-                y = nn.Dense(od * k, use_bias=use_bias)(x)
+                y = nn.Dense(od * k, use_bias=use_bias, dtype=cdt)(x)
                 return y.reshape(y.shape[:-1] + (k, od)).max(-2)
 
         return _Maxout(name=self.name)
@@ -1789,7 +1813,8 @@ class WordEmbedding(KerasLayer):
             return None
         vocab, dim = self.weights.shape
         init = lambda *a: jnp.asarray(self.weights)  # noqa: E731
-        return nn.Embed(vocab, dim, embedding_init=init, name=self.name)
+        return nn.Embed(vocab, dim, embedding_init=init,
+                        dtype=self.compute_dtype, name=self.name)
 
     def apply(self, module, args, train):
         ids = args[0].astype(jnp.int32)
@@ -1797,7 +1822,9 @@ class WordEmbedding(KerasLayer):
             ids = jnp.maximum(ids - 1, 0)
         if module is not None:
             return module(ids)
-        return jnp.asarray(self.weights)[ids]
+        out = jnp.asarray(self.weights)[ids]
+        return out if self.compute_dtype is None \
+            else out.astype(self.compute_dtype)
 
     def _infer_shape(self, in_shapes):
         s = in_shapes[0]

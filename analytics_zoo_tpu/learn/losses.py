@@ -37,30 +37,44 @@ def mean_squared_logarithmic_error(y_true, y_pred):
     return jnp.square(a - b).mean(-1)
 
 
+def _f32(y_pred):
+    """Cross-entropies compute in fp32 even under a bf16 compute policy:
+    log/exp of bf16 logits costs accuracy for no MXU win (the loss is a
+    scalar tail, not a matmul)."""
+    y_pred = jnp.asarray(y_pred)
+    return y_pred.astype(jnp.float32) \
+        if jnp.issubdtype(y_pred.dtype, jnp.floating) else y_pred
+
+
 def binary_crossentropy(y_true, y_pred):
+    y_pred = _f32(y_pred)
     p = jnp.clip(_flatten_trailing(y_pred), _EPS, 1 - _EPS)
     t = _flatten_trailing(y_true)
     return -(t * jnp.log(p) + (1 - t) * jnp.log1p(-p)).mean(-1)
 
 
 def binary_crossentropy_from_logits(y_true, y_pred):
+    y_pred = _f32(y_pred)
     z = _flatten_trailing(y_pred)
     t = _flatten_trailing(y_true)
     return (jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))).mean(-1)
 
 
 def categorical_crossentropy(y_true, y_pred):
+    y_pred = _f32(y_pred)
     p = jnp.clip(y_pred, _EPS, 1.0)
     return -(y_true * jnp.log(p)).sum(-1)
 
 
 def sparse_categorical_crossentropy(y_true, y_pred):
+    y_pred = _f32(y_pred)
     logp = jnp.log(jnp.clip(y_pred, _EPS, 1.0))
     idx = jnp.asarray(y_true).astype(jnp.int32)
     return -jnp.take_along_axis(logp, idx[..., None], axis=-1)[..., 0]
 
 
 def sparse_categorical_crossentropy_from_logits(y_true, y_pred):
+    y_pred = _f32(y_pred)
     logp = y_pred - jax_logsumexp(y_pred)
     idx = jnp.asarray(y_true).astype(jnp.int32)
     out = -jnp.take_along_axis(logp, idx[..., None], axis=-1)[..., 0]

@@ -352,7 +352,7 @@ class ImageClassifier(ZooModel):
 
     def __init__(self, class_num: int, model_name: str = "resnet-lite",
                  image_size: int = 224, channels: int = 3,
-                 pretrained=None):
+                 pretrained=None, dtype: str = "float32"):
         super().__init__()
         if model_name not in _ARCHS:
             raise ValueError(
@@ -361,7 +361,13 @@ class ImageClassifier(ZooModel):
         self.model_name = model_name
         self.image_size = int(image_size)
         self.channels = int(channels)
-        self.model = self.build_model()
+        self.dtype = dtype
+        # dtype="mixed_bfloat16": bf16 compute / fp32 params (keras/
+        # policy.py) — on TPU this doubles MXU throughput and halves
+        # activation HBM traffic; params and BN statistics stay fp32
+        from analytics_zoo_tpu.keras import policy as _policy
+        with _policy.policy_scope(dtype):
+            self.model = self.build_model()
         if pretrained is not None:
             # torchvision-format state_dict (dict, torch module, or path
             # to a torch.save file) — the TPU-era replacement for the
@@ -388,7 +394,8 @@ class ImageClassifier(ZooModel):
 
     def _config(self):
         return dict(class_num=self.class_num, model_name=self.model_name,
-                    image_size=self.image_size, channels=self.channels)
+                    image_size=self.image_size, channels=self.channels,
+                    dtype=self.dtype)
 
 
 # ---- per-model preprocessing configs + labeled output -------------------

@@ -170,6 +170,7 @@ class TransformerModule(nn.Module):
     hidden_drop: float = 0.1
     attn_drop: Optional[float] = None  # None → follow hidden_drop
     max_position_len: int = 512
+    dtype: Optional[object] = None     # computation dtype (params fp32)
 
     @nn.compact
     def __call__(self, input_ids, train: bool = False):
@@ -190,7 +191,7 @@ class TransformerModule(nn.Module):
             x = EncoderBlock(
                 hidden_size=self.hidden_size, n_head=self.n_head,
                 intermediate_size=inter, dropout=self.hidden_drop,
-                attn_drop=attn_drop,
+                attn_drop=attn_drop, dtype=self.dtype,
                 causal=True, name=f"block_{i}")(x, train=train)
         return x
 

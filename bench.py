@@ -507,13 +507,17 @@ def measure_resnet50_train():
     x = rng.standard_normal(
         (RN50_BATCH, RN50_IMAGE, RN50_IMAGE, 3)).astype(np.float32)
     y = rng.integers(0, RN50_CLASSES, RN50_BATCH).astype(np.int32)
+    # bf16 compute / fp32 params — how real TPU training runs (the BERT
+    # part already measures bf16; r5 threads the policy through the
+    # keras conv/BN layers so the image zoo gets the same treatment)
     clf = ImageClassifier(class_num=RN50_CLASSES, model_name=RN50_MODEL,
-                          image_size=RN50_IMAGE)
+                          image_size=RN50_IMAGE, dtype="mixed_bfloat16")
     clf.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
     est = clf.model._ensure_estimator(for_training=True)
     dt, flops = _measure_step_time(est, x, y, warmup=2, iters=RN50_ITERS)
     out = {"resnet50_train_samples_per_sec": round(RN50_BATCH / dt, 1),
-           "resnet50_train_step_ms": round(dt * 1e3, 2)}
+           "resnet50_train_step_ms": round(dt * 1e3, 2),
+           "resnet50_train_dtype": "mixed_bfloat16"}
     if flops:
         out["resnet50_train_tflops_per_s"] = round(flops / dt / 1e12, 2)
     return out

@@ -1,0 +1,92 @@
+"""Mixed-precision dtype policy (keras/policy.py): bf16 compute, fp32
+params, snapshotted at layer construction."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+@pytest.fixture
+def orca_ctx():
+    import analytics_zoo_tpu as zoo
+    return zoo.init_orca_context(cluster_mode="local")
+
+
+class TestDtypePolicy:
+    def test_default_is_float32(self):
+        from analytics_zoo_tpu.keras import policy
+        assert policy.dtype_policy() == "float32"
+        assert policy.compute_dtype() is None
+
+    def test_unknown_policy_rejected(self):
+        from analytics_zoo_tpu.keras import policy
+        with pytest.raises(ValueError, match="unknown dtype policy"):
+            policy.set_dtype_policy("float16")
+
+    def test_scope_restores(self):
+        from analytics_zoo_tpu.keras import policy
+        with policy.policy_scope("mixed_bfloat16"):
+            assert policy.compute_dtype() == jnp.bfloat16
+        assert policy.compute_dtype() is None
+
+    def test_snapshot_at_construction(self, orca_ctx):
+        """A layer built under the policy keeps bf16 compute even after
+        the policy is reset; a layer built outside stays fp32."""
+        from analytics_zoo_tpu.keras import layers as zl, policy
+        with policy.policy_scope("mixed_bfloat16"):
+            inside = zl.Dense(4, input_shape=(8,))
+        outside = zl.Dense(4, input_shape=(8,))
+        assert inside.compute_dtype == jnp.bfloat16
+        assert outside.compute_dtype is None
+
+    def test_mixed_model_params_stay_fp32_outputs_bf16(self, orca_ctx):
+        from analytics_zoo_tpu.keras import Sequential, policy
+        from analytics_zoo_tpu.keras import layers as zl
+        with policy.policy_scope("mixed_bfloat16"):
+            m = Sequential()
+            m.add(zl.Conv2D(8, 3, 3, activation="relu",
+                            input_shape=(12, 12, 3)))
+            m.add(zl.BatchNormalization())
+            m.add(zl.Flatten())
+            m.add(zl.Dense(4))
+        est = m._ensure_estimator()
+        params = est.adapter.params
+        kinds = {np.asarray(p).dtype for p in jax.tree_util.tree_leaves(
+            params) if np.issubdtype(np.asarray(p).dtype, np.floating)}
+        assert kinds == {np.dtype("float32")}, kinds
+        x = np.random.default_rng(0).standard_normal(
+            (2, 12, 12, 3)).astype(np.float32)
+        out = est.adapter.module.apply(
+            {"params": est.adapter.params, **est.adapter.model_state}, x)
+        assert out.dtype == jnp.bfloat16
+
+    def test_mixed_model_trains(self, orca_ctx):
+        """Loss decreases under the bf16 policy (fp32 loss tail via the
+        _f32 upcast in learn/losses.py)."""
+        from analytics_zoo_tpu.keras import Sequential, policy
+        from analytics_zoo_tpu.keras import layers as zl
+        with policy.policy_scope("mixed_bfloat16"):
+            m = Sequential()
+            m.add(zl.Dense(16, activation="relu", input_shape=(8,)))
+            m.add(zl.Dense(3))
+        m.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy_logits")
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((64, 8)).astype(np.float32)
+        y = rng.integers(0, 3, 64).astype(np.int32)
+        h = m.fit(x, y, batch_size=32, nb_epoch=4)
+        assert h["loss"][-1] < h["loss"][0]
+
+    def test_image_classifier_dtype_arg(self, orca_ctx):
+        from analytics_zoo_tpu.models.image.imageclassification import (
+            ImageClassifier,
+        )
+        m = ImageClassifier(class_num=3, model_name="mobilenet-v2",
+                            image_size=32, dtype="mixed_bfloat16")
+        out = np.asarray(m.predict(
+            np.zeros((2, 32, 32, 3), np.float32), distributed=False))
+        assert out.shape == (2, 3)
+        # softmax probabilities normalized despite bf16 hidden compute
+        np.testing.assert_allclose(out.sum(1), 1.0, rtol=2e-2)
