@@ -90,3 +90,27 @@ class TestDtypePolicy:
         assert out.shape == (2, 3)
         # softmax probabilities normalized despite bf16 hidden compute
         np.testing.assert_allclose(out.sum(1), 1.0, rtol=2e-2)
+
+    def test_mixed_policy_with_sharded_strategy(self, orca_ctx):
+        """bf16 compute composes with mesh sharding: a dp4,tp2 model
+        under the policy trains and keeps fp32 params."""
+        from analytics_zoo_tpu.keras import Sequential, policy
+        from analytics_zoo_tpu.keras import layers as zl
+        with policy.policy_scope("mixed_bfloat16"):
+            m = Sequential()
+            m.add(zl.Dense(32, activation="relu", input_shape=(16,)))
+            m.add(zl.Dense(4))
+        m.set_strategy("dp4,tp2",
+                       param_rules=[(r".*dense.*kernel", (None, "model"))])
+        m.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy_logits")
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((64, 16)).astype(np.float32)
+        y = rng.integers(0, 4, 64).astype(np.int32)
+        h = m.fit(x, y, batch_size=32, nb_epoch=3)
+        assert h["loss"][-1] < h["loss"][0]
+        est = m._ensure_estimator()
+        kinds = {np.asarray(p).dtype for p in jax.tree_util.tree_leaves(
+            est.adapter.params)
+            if np.issubdtype(np.asarray(p).dtype, np.floating)}
+        assert kinds == {np.dtype("float32")}, kinds
