@@ -728,6 +728,21 @@ def _cpu_emit():
         "error": note,
         "bert_skipped": "BERT-base step takes minutes on one CPU core",
     }
+    # point the fallback record at the most recent committed on-chip
+    # record, if one exists — read at emit time so the pointer can never
+    # go stale or claim numbers the file doesn't contain
+    onchip = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "BENCH_builder_r5_onchip.json")
+    try:
+        with open(onchip) as fh:
+            rec = json.load(fh)
+        out["onchip_record"] = {
+            "file": os.path.basename(onchip),
+            "device": rec.get("device"),
+            "ncf_train_samples_per_sec": rec.get("value"),
+            "vs_baseline": rec.get("vs_baseline")}
+    except Exception:
+        pass
     print(json.dumps(_assemble_record(out, (measure_tcn, measure_serving))))
 
 

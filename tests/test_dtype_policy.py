@@ -114,3 +114,10 @@ class TestDtypePolicy:
             est.adapter.params)
             if np.issubdtype(np.asarray(p).dtype, np.floating)}
         assert kinds == {np.dtype("float32")}, kinds
+        # the tp rule must have ACTUALLY applied — otherwise this test
+        # passes vacuously with every param on the default layout
+        specs = [str(getattr(leaf.sharding, "spec", ""))
+                 for leaf in jax.tree_util.tree_leaves(
+                     est._state["params"])
+                 if getattr(leaf, "ndim", 0) == 2]
+        assert any("model" in sp for sp in specs), specs
