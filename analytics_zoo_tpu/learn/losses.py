@@ -18,29 +18,35 @@ def _flatten_trailing(a):
 
 
 def mean_squared_error(y_true, y_pred):
+    y_pred = _f32(y_pred)
     return jnp.square(_flatten_trailing(y_pred) - _flatten_trailing(y_true)).mean(-1)
 
 
 def mean_absolute_error(y_true, y_pred):
+    y_pred = _f32(y_pred)
     return jnp.abs(_flatten_trailing(y_pred) - _flatten_trailing(y_true)).mean(-1)
 
 
 def mean_absolute_percentage_error(y_true, y_pred):
+    y_pred = _f32(y_pred)
     t = _flatten_trailing(y_true)
     return (100.0 * jnp.abs((t - _flatten_trailing(y_pred))
                             / jnp.clip(jnp.abs(t), _EPS, None))).mean(-1)
 
 
 def mean_squared_logarithmic_error(y_true, y_pred):
+    y_pred = _f32(y_pred)
     a = jnp.log1p(jnp.clip(_flatten_trailing(y_pred), _EPS, None))
     b = jnp.log1p(jnp.clip(_flatten_trailing(y_true), _EPS, None))
     return jnp.square(a - b).mean(-1)
 
 
 def _f32(y_pred):
-    """Cross-entropies compute in fp32 even under a bf16 compute policy:
-    log/exp of bf16 logits costs accuracy for no MXU win (the loss is a
-    scalar tail, not a matmul)."""
+    """Losses compute in fp32 even under a bf16 compute policy: log/exp/
+    square of bf16 predictions costs accuracy for no MXU win (the loss is
+    a scalar tail, not a matmul). Applied to both the cross-entropy and
+    regression families so bf16 TARGETS can't silently drag the whole
+    loss into bf16 either."""
     y_pred = jnp.asarray(y_pred)
     return y_pred.astype(jnp.float32) \
         if jnp.issubdtype(y_pred.dtype, jnp.floating) else y_pred

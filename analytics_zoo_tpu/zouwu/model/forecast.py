@@ -21,12 +21,27 @@ class Forecaster:
     base; sklearn-style like the reference's)."""
 
     def __init__(self, *, optimizer="adam", loss="mse",
-                 model_dir: Optional[str] = None, seed: int = 0):
+                 model_dir: Optional[str] = None, seed: int = 0,
+                 dtype: str = "float32"):
         self.optimizer = optimizer
         self.loss = loss
         self.model_dir = model_dir
         self.seed = seed
         self._est: Optional[object] = None
+        # "float32" (default) or "mixed_bfloat16": bf16 compute with fp32
+        # params — the keras/policy.py table is the single source of
+        # truth for names and semantics (the loss tail stays fp32 via
+        # learn/losses.py)
+        from analytics_zoo_tpu.keras.policy import _POLICIES
+        if dtype not in _POLICIES:
+            raise ValueError(
+                f"unknown dtype {dtype!r}; one of {sorted(_POLICIES)}")
+        self.dtype = dtype
+
+    @property
+    def _net_dtype(self):
+        from analytics_zoo_tpu.keras.policy import _POLICIES
+        return _POLICIES[self.dtype]
 
     # subclasses implement
     def _build_module(self, x: np.ndarray):  # pragma: no cover
@@ -86,7 +101,8 @@ class LSTMForecaster(Forecaster):
     def _build_module(self, x):
         return VanillaLSTMNet(output_dim=self.target_dim,
                               lstm_units=self.lstm_units,
-                              dropouts=self.dropouts)
+                              dropouts=self.dropouts,
+                              dtype=self._net_dtype)
 
 
 class Seq2SeqForecaster(Forecaster):
@@ -101,7 +117,8 @@ class Seq2SeqForecaster(Forecaster):
 
     def _build_module(self, x):
         return Seq2SeqNet(future_seq_len=self.future_seq_len,
-                          latent_dim=self.latent_dim, dropout=self.dropout)
+                          latent_dim=self.latent_dim, dropout=self.dropout,
+                          dtype=self._net_dtype)
 
 
 class TCNForecaster(Forecaster):
@@ -120,7 +137,8 @@ class TCNForecaster(Forecaster):
         return TemporalConvNet(future_seq_len=self.future_seq_len,
                                num_channels=self.num_channels,
                                kernel_size=self.kernel_size,
-                               dropout=self.dropout)
+                               dropout=self.dropout,
+                               dtype=self._net_dtype)
 
 
 class MTNetForecaster(Forecaster):
@@ -152,6 +170,13 @@ class MTNetForecaster(Forecaster):
                  dropout: Optional[float] = None,
                  **kwargs):
         super().__init__(**kwargs)
+        if self._net_dtype is not None:
+            # fail loudly instead of silently training fp32: MTNetModule
+            # (attention-GRU encoders) has no dtype plumbing yet
+            raise ValueError(
+                "MTNetForecaster does not support mixed precision yet; "
+                "use dtype='float32' (LSTM/Seq2Seq/TCN forecasters do "
+                "support 'mixed_bfloat16')")
         legacy_call = any(v is not None for v in (
             long_series_num, series_length, cnn_kernel_size, dropout,
             rnn_hid_size))

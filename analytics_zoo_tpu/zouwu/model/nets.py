@@ -12,11 +12,16 @@ TPU-native rebuilds of the reference's torch/keras forecast models:
   long-term memory chunks encoded by CNN+attention, short-term CNN encoder,
   autoregressive highway). Same decomposition, flax idiom.
 
-All take [batch, time, features] and emit [batch, horizon]."""
+All take [batch, time, features] and emit [batch, horizon]. The LSTM,
+Seq2Seq and TCN nets accept ``dtype`` (e.g. ``jnp.bfloat16``) for
+mixed-precision compute with fp32 params — keras/policy.py semantics:
+hidden layers run in ``dtype``, the output head and the loss stay fp32
+(learn/losses.py upcasts). MTNetModule is fp32-only for now —
+MTNetForecaster rejects the dtype flag rather than ignoring it."""
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax
@@ -27,15 +32,19 @@ class VanillaLSTMNet(nn.Module):
     output_dim: int = 1
     lstm_units: Tuple[int, ...] = (32, 32)
     dropouts: Tuple[float, ...] = (0.2, 0.2)
+    dtype: Optional[object] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         for i, units in enumerate(self.lstm_units):
-            x = nn.RNN(nn.OptimizedLSTMCell(features=units))(x)
+            x = nn.RNN(nn.OptimizedLSTMCell(features=units,
+                                            dtype=self.dtype))(x)
             drop = self.dropouts[min(i, len(self.dropouts) - 1)]
             if drop:
                 x = nn.Dropout(rate=drop, deterministic=not train)(x)
-        return nn.Dense(self.output_dim)(x[:, -1, :])
+        # output head stays fp32 (keras mixed-precision guidance): bf16
+        # forecast values would leak ml_dtypes.bfloat16 into user code
+        return nn.Dense(self.output_dim)(x[:, -1, :].astype(jnp.float32))
 
 
 class Seq2SeqNet(nn.Module):
@@ -43,11 +52,13 @@ class Seq2SeqNet(nn.Module):
     latent_dim: int = 64
     dropout: float = 0.2
     output_dim: int = 1
+    dtype: Optional[object] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         b = x.shape[0]
-        enc = nn.RNN(nn.OptimizedLSTMCell(features=self.latent_dim))(x)
+        enc = nn.RNN(nn.OptimizedLSTMCell(features=self.latent_dim,
+                                          dtype=self.dtype))(x)
         ctx = enc[:, -1, :]                                   # [b, latent]
         if self.dropout:
             ctx = nn.Dropout(rate=self.dropout,
@@ -56,8 +67,10 @@ class Seq2SeqNet(nn.Module):
         # inference graph, matching the reference's inference decoder)
         dec_in = jnp.broadcast_to(ctx[:, None, :],
                                   (b, self.future_seq_len, self.latent_dim))
-        dec = nn.RNN(nn.OptimizedLSTMCell(features=self.latent_dim))(dec_in)
-        out = nn.Dense(self.output_dim)(dec)                  # [b, f, od]
+        dec = nn.RNN(nn.OptimizedLSTMCell(features=self.latent_dim,
+                                          dtype=self.dtype))(dec_in)
+        out = nn.Dense(self.output_dim)(
+            dec.astype(jnp.float32))                          # [b, f, od]
         return out[..., 0] if self.output_dim == 1 else out
 
 
@@ -66,6 +79,7 @@ class _TemporalBlock(nn.Module):
     kernel_size: int
     dilation: int
     dropout: float
+    dtype: Optional[object] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -75,11 +89,13 @@ class _TemporalBlock(nn.Module):
         for _ in range(2):
             y = jnp.pad(y, ((0, 0), (pad, 0), (0, 0)))
             y = nn.Conv(self.channels, (self.kernel_size,),
-                        kernel_dilation=(self.dilation,), padding="VALID")(y)
+                        kernel_dilation=(self.dilation,), padding="VALID",
+                        dtype=self.dtype)(y)
             y = nn.relu(y)
             y = nn.Dropout(rate=self.dropout, deterministic=not train)(y)
-        res = x if x.shape[-1] == self.channels else nn.Dense(self.channels)(x)
-        return nn.relu(y + res)
+        res = x if x.shape[-1] == self.channels \
+            else nn.Dense(self.channels, dtype=self.dtype)(x)
+        return nn.relu(y + res.astype(y.dtype))
 
 
 class TemporalConvNet(nn.Module):
@@ -89,13 +105,15 @@ class TemporalConvNet(nn.Module):
     num_channels: Tuple[int, ...] = (30, 30, 30)
     kernel_size: int = 7
     dropout: float = 0.2
+    dtype: Optional[object] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         for i, ch in enumerate(self.num_channels):
             x = _TemporalBlock(ch, self.kernel_size, 2 ** i,
-                               self.dropout)(x, train)
-        return nn.Dense(self.future_seq_len)(x[:, -1, :])
+                               self.dropout, self.dtype)(x, train)
+        return nn.Dense(self.future_seq_len)(
+            x[:, -1, :].astype(jnp.float32))
 
 
 class _AttentionGRU(nn.Module):

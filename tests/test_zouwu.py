@@ -128,6 +128,42 @@ class TestForecasters:
         final = f.evaluate(x, y)["mse"]
         assert final < 0.05  # learnable linear map
 
+    def test_tcn_forecaster_mixed_bfloat16(self):
+        """dtype="mixed_bfloat16": bf16 compute, fp32 params, still
+        learns the linear map (loss tail is fp32)."""
+        import jax
+        from analytics_zoo_tpu.learn.optimizers import Adam
+        x, y = _xy(n=128, horizon=2)
+        f = TCNForecaster(future_seq_len=2, num_channels=(8, 8),
+                          kernel_size=3, dropout=0.0,
+                          optimizer=Adam(learningrate=0.01),
+                          dtype="mixed_bfloat16")
+        f.fit(x, y, epochs=20, batch_size=16)
+        assert f.evaluate(x, y)["mse"] < 0.08
+        import numpy as _np
+        kinds = {_np.asarray(p).dtype
+                 for p in jax.tree_util.tree_leaves(
+                     f._est._state["params"])}
+        assert kinds == {_np.dtype("float32")}, kinds
+
+    def test_forecaster_rejects_unknown_dtype(self):
+        with pytest.raises(ValueError, match="unknown dtype"):
+            LSTMForecaster(dtype="float16")
+
+    def test_mixed_predict_returns_fp32(self):
+        """bf16 hidden compute must not leak ml_dtypes.bfloat16 into
+        user-facing forecasts (fp32 output head)."""
+        x, y = _xy(horizon=1)
+        f = LSTMForecaster(target_dim=1, lstm_units=(8,), dropouts=(0.0,),
+                           dtype="mixed_bfloat16")
+        f.fit(x, y[:, :1], epochs=1, batch_size=16)
+        assert f.predict(x).dtype == np.float32
+
+    def test_mtnet_rejects_mixed_precision(self):
+        with pytest.raises(ValueError, match="does not support mixed"):
+            MTNetForecaster(future_seq_len=1, long_num=3, time_step=4,
+                            dtype="mixed_bfloat16")
+
     def test_seq2seq_forecaster(self):
         x, y = _xy(horizon=3)
         f = Seq2SeqForecaster(future_seq_len=3, latent_dim=8, dropout=0.0)
