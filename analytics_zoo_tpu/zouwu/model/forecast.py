@@ -170,13 +170,6 @@ class MTNetForecaster(Forecaster):
                  dropout: Optional[float] = None,
                  **kwargs):
         super().__init__(**kwargs)
-        if self._net_dtype is not None:
-            # fail loudly instead of silently training fp32: MTNetModule
-            # (attention-GRU encoders) has no dtype plumbing yet
-            raise ValueError(
-                "MTNetForecaster does not support mixed precision yet; "
-                "use dtype='float32' (LSTM/Seq2Seq/TCN forecasters do "
-                "support 'mixed_bfloat16')")
         legacy_call = any(v is not None for v in (
             long_series_num, series_length, cnn_kernel_size, dropout,
             rnn_hid_size))
@@ -216,7 +209,7 @@ class MTNetForecaster(Forecaster):
             rnn_dropout=rnn_dropout if rnn_dropout is not None else 0.0)
 
     def _build_module(self, x):
-        return MTNetModule(**self.kw)
+        return MTNetModule(dtype=self._net_dtype, **self.kw)
 
 
 # High-dimensional panel forecaster (ref zouwu/model/forecast/

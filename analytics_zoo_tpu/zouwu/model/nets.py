@@ -12,12 +12,11 @@ TPU-native rebuilds of the reference's torch/keras forecast models:
   long-term memory chunks encoded by CNN+attention, short-term CNN encoder,
   autoregressive highway). Same decomposition, flax idiom.
 
-All take [batch, time, features] and emit [batch, horizon]. The LSTM,
-Seq2Seq and TCN nets accept ``dtype`` (e.g. ``jnp.bfloat16``) for
-mixed-precision compute with fp32 params — keras/policy.py semantics:
-hidden layers run in ``dtype``, the output head and the loss stay fp32
-(learn/losses.py upcasts). MTNetModule is fp32-only for now —
-MTNetForecaster rejects the dtype flag rather than ignoring it."""
+All take [batch, time, features] and emit [batch, horizon]. Every
+module accepts ``dtype`` (e.g. ``jnp.bfloat16``) for mixed-precision
+compute with fp32 params — keras/policy.py semantics: hidden layers run
+in ``dtype``, attention softmaxes, the output heads and the loss stay
+fp32 (learn/losses.py upcasts)."""
 
 from __future__ import annotations
 
@@ -133,6 +132,7 @@ class _AttentionGRU(nn.Module):
     (the ref caches the same product in get_constants)."""
 
     hidden_sizes: Sequence[int]
+    dtype: Optional[object] = None
 
     @nn.compact
     def __call__(self, x):
@@ -140,7 +140,11 @@ class _AttentionGRU(nn.Module):
         init = nn.initializers.truncated_normal(stddev=0.1)
         w1 = self.param("W1", init, (d, d))
         b2 = self.param("b2", init, (d,))
-        states = tuple(jnp.zeros((b, int(h))) for h in self.hidden_sizes)
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
+            w1, b2 = w1.astype(self.dtype), b2.astype(self.dtype)
+        states = tuple(jnp.zeros((b, int(h)), x.dtype)
+                       for h in self.hidden_sizes)
         xw1 = x @ w1 + b2                                   # [b, t, d]
         # carry = recurrent states only; X and X·W1+b2 are loop-invariant
         # and broadcast; the step owns the attention weights (shared
@@ -150,6 +154,7 @@ class _AttentionGRU(nn.Module):
             split_rngs={"params": False},
             in_axes=(1, nn.broadcast, nn.broadcast), out_axes=1)
         _, ys = scan(hidden_sizes=tuple(self.hidden_sizes),
+                     dtype=self.dtype,
                      name="steps")(states, x, x, xw1)
         return ys[:, -1, :]                                 # last output
 
@@ -160,6 +165,7 @@ class _AttentionGRUStep(nn.Module):
     every step shares them."""
 
     hidden_sizes: Tuple[int, ...]
+    dtype: Optional[object] = None
 
     @nn.compact
     def __call__(self, states, x_t, x_all, xw1):
@@ -170,15 +176,21 @@ class _AttentionGRUStep(nn.Module):
         w3 = self.param("W3", init, (2 * d, d))
         b3 = self.param("b3", init, (d,))
         v = self.param("V", init, (d, 1))
+        if self.dtype is not None:
+            w2, w3, b3, v = (p.astype(self.dtype)
+                             for p in (w2, w3, b3, v))
         h_top = states[-1]
         e = jnp.tanh(xw1 + (h_top @ w2)[:, None, :]) @ v    # [b, T, 1]
-        a = jax.nn.softmax(e, axis=1)
+        # softmax over T stays fp32 (stability), result back in compute
+        # dtype
+        a = jax.nn.softmax(e.astype(jnp.float32),
+                           axis=1).astype(e.dtype)
         x_weighted = jnp.sum(a * x_all, axis=1)             # [b, D]
         x_in = jnp.concatenate([x_t, x_weighted], axis=-1) @ w3 + b3
         new_states = []
         h = x_in
         for i, (hsize, st) in enumerate(zip(self.hidden_sizes, states)):
-            st2, h = nn.GRUCell(features=int(hsize),
+            st2, h = nn.GRUCell(features=int(hsize), dtype=self.dtype,
                                 name=f"gru_{i}")(st, h)
             new_states.append(st2)
         return tuple(new_states), h
@@ -224,6 +236,7 @@ class MTNetModule(nn.Module):
     ar_window: int = 4
     cnn_dropout: float = 0.1
     rnn_dropout: float = 0.1
+    dtype: Optional[object] = None
 
     def _encoder(self, chunks, name, train):
         """[b·num, T, F] → [b·num, last_rnn_size] (ref __encoder)."""
@@ -231,6 +244,7 @@ class MTNetModule(nn.Module):
         y = nn.Conv(self.cnn_hid_size, (self.cnn_height,), padding="VALID",
                     kernel_init=init,
                     bias_init=nn.initializers.constant(0.1),
+                    dtype=self.dtype,
                     name=f"{name}_conv")(chunks)
         y = nn.relu(y)
         y = nn.Dropout(rate=self.cnn_dropout, deterministic=not train,
@@ -239,6 +253,7 @@ class MTNetModule(nn.Module):
             y = nn.Dropout(rate=self.rnn_dropout, deterministic=not train,
                            name=f"{name}_rnn_drop")(y)
         return _AttentionGRU(hidden_sizes=self.rnn_hid_sizes,
+                             dtype=self.dtype,
                              name=f"{name}_attgru")(y)
 
     @nn.compact
@@ -259,6 +274,8 @@ class MTNetModule(nn.Module):
                                 train).reshape(b, n, h_last)
         query = self._encoder(short, "query", train)         # [b, h]
 
+        memory, context, query = (z.astype(jnp.float32)
+                                  for z in (memory, context, query))
         prob = jnp.einsum("bnh,bh->bn", memory, query)
         prob = jax.nn.softmax(prob, axis=-1)                 # over memories
         out = context * prob[..., None]                      # [b, n, h]
@@ -269,7 +286,8 @@ class MTNetModule(nn.Module):
                              bias_init=nn.initializers.constant(0.1),
                              name="head")(pred_x)
         if self.ar_window > 0:
-            ar_in = short[:, -self.ar_window:, :].reshape(b, -1)
+            ar_in = short[:, -self.ar_window:, :].reshape(
+                b, -1).astype(jnp.float32)
             linear = nn.Dense(self.output_dim, kernel_init=init,
                               bias_init=nn.initializers.constant(0.1),
                               name="ar")(ar_in)

@@ -159,10 +159,22 @@ class TestForecasters:
         f.fit(x, y[:, :1], epochs=1, batch_size=16)
         assert f.predict(x).dtype == np.float32
 
-    def test_mtnet_rejects_mixed_precision(self):
-        with pytest.raises(ValueError, match="does not support mixed"):
-            MTNetForecaster(future_seq_len=1, long_num=3, time_step=4,
+    def test_mtnet_mixed_precision(self):
+        """MTNet under mixed_bfloat16: attention-GRU encoders run bf16,
+        params stay fp32, forecasts come back fp32, and it still fits."""
+        import jax
+        x, y = _xy(n=64, lookback=16, horizon=1)
+        f = MTNetForecaster(future_seq_len=1, long_num=3, time_step=4,
+                            cnn_height=2, ar_window=2,
+                            cnn_dropout=0.0, rnn_dropout=0.0,
                             dtype="mixed_bfloat16")
+        h = f.fit(x, y, epochs=3, batch_size=16)
+        assert h["loss"][-1] < h["loss"][0]
+        pred = f.predict(x)
+        assert pred.dtype == np.float32 and pred.shape == (len(x), 1)
+        kinds = {np.asarray(p).dtype for p in jax.tree_util.tree_leaves(
+            f._est._state["params"])}
+        assert kinds == {np.dtype("float32")}, kinds
 
     def test_seq2seq_forecaster(self):
         x, y = _xy(horizon=3)
