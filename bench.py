@@ -391,11 +391,11 @@ def measure_flash_attention():
 
     def timed(fn):
         f = jax.jit(fn)
-        f(q, k, v).block_until_ready()          # compile
+        jax.block_until_ready(f(q, k, v))       # compile
         t0 = time.perf_counter()
         for _ in range(FA_ITERS):
             out = f(q, k, v)
-        out.block_until_ready()
+        jax.block_until_ready(out)
         return (time.perf_counter() - t0) / FA_ITERS
 
     dt_block = timed(lambda q, k, v: blockwise_attention(q, k, v,
@@ -433,6 +433,26 @@ def measure_flash_attention():
     if "flash_attn_seq_ms" in out:
         out["flash_vs_blockwise_speedup"] = round(
             dt_block / (out["flash_attn_seq_ms"] / 1e3), 3)
+        # fwd+bwd: the pallas FlashAttention-2 backward kernels vs
+        # differentiating the blockwise scan (r5: the backward-path story)
+        bq, bk = (int(t) for t in out["flash_attn_block"].split("x"))
+        try:
+            def grad_of(fn):
+                return jax.grad(
+                    lambda q, k, v: fn(q, k, v).astype(jnp.float32).sum(),
+                    argnums=(0, 1, 2))
+
+            dtg_flash = timed(grad_of(
+                lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                                block_q=bq, block_k=bk)))
+            dtg_block = timed(grad_of(
+                lambda q, k, v: blockwise_attention(q, k, v, causal=True)))
+            out["flash_bwd_ms"] = round(dtg_flash * 1e3, 3)
+            out["blockwise_bwd_ms"] = round(dtg_block * 1e3, 3)
+            out["flash_bwd_vs_blockwise_speedup"] = round(
+                dtg_block / dtg_flash, 3)
+        except Exception as e:
+            out["flash_bwd_error"] = repr(e)[:120]
     else:
         # record the reason instead of losing both numbers
         out["flash_attn_error"] = "; ".join(errors)[:160]

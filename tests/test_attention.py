@@ -134,6 +134,60 @@ def test_flash_kernel_interpret_mode(orca_ctx):
     np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-4)
 
 
+def test_flash_backward_kernel_interpret_mode(orca_ctx):
+    """FlashAttention-2 backward kernels (dq + dk/dv over the saved
+    logsumexp) vs the blockwise vjp, interpret mode on CPU — exact in
+    fp32, bf16-rounding otherwise. Also checks the lse the forward
+    saves."""
+    import functools
+    import jax
+    import jax.numpy as jnp
+    import jax.experimental.pallas as pl
+    from analytics_zoo_tpu.ops import flash_attention as fa
+
+    orig = pl.pallas_call
+    try:
+        pl.pallas_call = functools.partial(orig, interpret=True)
+        for causal in (False, True):
+            q, k, v = _qkv(b=2, s=256, h=2, d=128, seed=11 + causal)
+            g = np.asarray(jax.random.normal(
+                jax.random.PRNGKey(3), (2, 256, 2, 128)), np.float32)
+
+            # call the kernels DIRECTLY: flash_attention's vjp would
+            # silently fall back to the blockwise reference on a broken
+            # kernel, making the comparison vacuous
+            out, lse = fa._flash_fwd(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), causal=causal,
+                                     block_q=128, block_k=128,
+                                     return_lse=True)
+            gf = fa._flash_bwd(jnp.asarray(q), jnp.asarray(k),
+                               jnp.asarray(v), out, lse, jnp.asarray(g),
+                               causal, 128, 128)
+
+            def f_block(q, k, v):
+                return (fa.blockwise_attention(
+                    jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                    causal=causal) * jnp.asarray(g)).sum()
+
+            gb = jax.grad(f_block, argnums=(0, 1, 2))(q, k, v)
+            for name, a, b in zip("qkv", gf, gb):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4,
+                    err_msg=f"d{name} causal={causal}")
+            # the saved lse must equal the true logsumexp of scaled scores
+            scores = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(128)
+            if causal:
+                mask = np.tril(np.ones((256, 256), bool))
+                scores = np.where(mask[None, None], scores, -1e30)
+            ref_lse = np.log(np.exp(
+                scores - scores.max(-1, keepdims=True)).sum(-1))                 + scores.max(-1)
+            np.testing.assert_allclose(
+                np.asarray(lse).reshape(2, 2, 256),
+                ref_lse.astype(np.float32), rtol=1e-4, atol=1e-4)
+    finally:
+        pl.pallas_call = orig
+
+
 class TestCausalCrossLength:
     """Regression: causal mask must be bottom-right aligned (KV-cache decode
     semantics) in every implementation, not just _reference_attention."""
