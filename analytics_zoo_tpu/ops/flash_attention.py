@@ -30,8 +30,11 @@ NEG_INF = -1e30
 
 # ---------------------------------------------------------------- blockwise
 
-def blockwise_attention(q, k, v, causal: bool = False, block_k: int = 128):
-    """q,k,v: [b, s, h, d] → [b, s, h, d]; O(s·block_k) memory."""
+def blockwise_attention(q, k, v, causal: bool = False, block_k: int = 128,
+                        return_lse: bool = False):
+    """q,k,v: [b, s, h, d] → [b, s, h, d]; O(s·block_k) memory.
+    ``return_lse``: also return the per-row logsumexp as [b·h, s] fp32
+    (the layout the pallas kernels use)."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
     block_k = min(block_k, sk)
@@ -74,16 +77,25 @@ def blockwise_attention(q, k, v, causal: bool = False, block_k: int = 128):
     (o, m, l), _ = jax.lax.scan(body, (o0, m0, l0),
                                 (k_blocks, v_blocks, jnp.arange(nk)))
     out = o / jnp.maximum(l, 1e-37)[..., None]
-    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+    out = out.transpose(0, 2, 1, 3).astype(q.dtype)
+    if return_lse:
+        lse = (m + jnp.log(jnp.maximum(l, 1e-37))).reshape(b * h, sq)
+        return out, lse
+    return out
 
 
 # ---------------------------------------------------------------- pallas fwd
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_scr, m_scr,
-                      l_scr, *,
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                       block_k: int, causal: bool, block_q: int, nk: int,
                       causal_off: int):
     import jax.experimental.pallas as pl
+
+    # rest = (lse_ref?, o_scr, m_scr, l_scr): the lse output only exists
+    # when the caller asked for it (training) — inference keeps the old
+    # single-output forward and pays nothing for it
+    lse_ref = rest[0] if len(rest) == 4 else None
+    o_scr, m_scr, l_scr = rest[-3:]
 
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -134,9 +146,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_scr, m_scr,
     def _flush():
         l_fin = jnp.maximum(l_scr[:, 0], 1e-37)
         o_ref[0] = (o_scr[...] / l_fin[:, None]).astype(o_ref.dtype)
-        # logsumexp per query row (scaled-score space) — the backward
-        # kernels reconstruct p = exp(s - lse) from it
-        lse_ref[0] = m_scr[:, 0] + jnp.log(l_fin)
+        if lse_ref is not None:
+            # logsumexp per query row (scaled-score space) — the backward
+            # kernels reconstruct p = exp(s - lse) from it
+            lse_ref[0] = m_scr[:, 0] + jnp.log(l_fin)
 
 
 def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
@@ -159,28 +172,31 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
     vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     nk = sk // block_k
     grid = (b * h, sq // block_q, nk)
-    out, lse = pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct((b * h, sq, d), q.dtype)]
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0))]
+    if return_lse:
+        out_shape.append(jax.ShapeDtypeStruct((b * h, sq), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, block_q),
+                                      lambda i, qi, ki: (i, qi)))
+    res = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, block_k=block_k,
                           causal=causal, block_q=block_q, nk=nk,
                           causal_off=sk - sq),
-        out_shape=(jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-                   jax.ShapeDtypeStruct((b * h, sq), jnp.float32)),
+        out_shape=tuple(out_shape),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, qi, ki: (i, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, qi, ki: (i, ki, 0)),
         ],
-        out_specs=(
-            pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda i, qi, ki: (i, qi)),
-        ),
+        out_specs=tuple(out_specs),
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
     )(qt, kt, vt)
+    out, lse = res if return_lse else (res[0], None)
     out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
     return (out, lse) if return_lse else out
 
@@ -194,9 +210,12 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
 # use Δ = rowsum(dO ⊙ O) for the softmax Jacobian. MXU matmuls run in the
 # input dtype with fp32 accumulation; accumulators live in VMEM scratch.
 
-def _bwd_block(q, k_blk, v_blk, do, lse, delta, qi, ki, *,
+def _bwd_block(q, k_blk, v_blk, do, lse, delta, glse, qi, ki, *,
                block_q, block_k, causal, causal_off):
-    """Shared per-tile math: returns (p, ds) as fp32 [block_q, block_k]."""
+    """Shared per-tile math: returns (p, ds) as fp32 [block_q, block_k].
+    ``glse`` is the cotangent of the row logsumexp (zero for plain
+    attention): since ∂lse_i/∂s_ij = p_ij, it folds into the same
+    softmax-Jacobian term as Δ."""
     scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
     s = jax.lax.dot_general(
         q, k_blk, (((1,), (1,)), ((), ())),
@@ -211,13 +230,13 @@ def _bwd_block(q, k_blk, v_blk, do, lse, delta, qi, ki, *,
     dp = jax.lax.dot_general(                         # dO · Vᵀ
         do, v_blk, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
-    ds = p * (dp - delta[:, None]) * scale
+    ds = p * (dp - delta[:, None] + glse[:, None]) * scale
     return p, ds
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, dq_scr, *, block_q, block_k, nk, causal,
-                         causal_off):
+                         glse_ref, dq_ref, dq_scr, *, block_q, block_k,
+                         nk, causal, causal_off):
     import jax.experimental.pallas as pl
 
     qi, ki = pl.program_id(1), pl.program_id(2)
@@ -233,8 +252,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _compute():
         q, k_blk, v_blk = q_ref[0], k_ref[0], v_ref[0]
         _, ds = _bwd_block(q, k_blk, v_blk, do_ref[0], lse_ref[0],
-                           delta_ref[0], qi, ki, block_q=block_q,
-                           block_k=block_k, causal=causal,
+                           delta_ref[0], glse_ref[0], qi, ki,
+                           block_q=block_q, block_k=block_k, causal=causal,
                            causal_off=causal_off)
         dq_scr[...] += jax.lax.dot_general(           # dS · K
             ds.astype(q.dtype), k_blk, (((1,), (0,)), ((), ())),
@@ -246,8 +265,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, dk_scr, dv_scr, *, block_q,
-                          block_k, nq, causal, causal_off):
+                          glse_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                          block_q, block_k, nq, causal, causal_off):
     import jax.experimental.pallas as pl
 
     ki, qi = pl.program_id(1), pl.program_id(2)
@@ -264,8 +283,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _compute():
         q, k_blk, v_blk, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         p, ds = _bwd_block(q, k_blk, v_blk, do, lse_ref[0], delta_ref[0],
-                           qi, ki, block_q=block_q, block_k=block_k,
-                           causal=causal, causal_off=causal_off)
+                           glse_ref[0], qi, ki, block_q=block_q,
+                           block_k=block_k, causal=causal,
+                           causal_off=causal_off)
         dv_scr[...] += jax.lax.dot_general(           # Pᵀ · dO
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -280,7 +300,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, o, lse, g, causal: bool, block_q: int,
-               block_k: int):
+               block_k: int, g_lse=None):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -294,6 +314,9 @@ def _flash_bwd(q, k, v, o, lse, g, causal: bool, block_q: int,
     delta = jnp.sum(dot.astype(jnp.float32)
                     * o.transpose(0, 2, 1, 3).reshape(
                         b * h, sq, d).astype(jnp.float32), axis=-1)
+    if g_lse is None:
+        g_lse = jnp.zeros_like(lse)
+    g_lse = g_lse.astype(jnp.float32)
     nq, nk = sq // block_q, sk // block_k
     causal_off = sk - sq
     common = dict(block_q=block_q, block_k=block_k, causal=causal,
@@ -305,10 +328,10 @@ def _flash_bwd(q, k, v, o, lse, g, causal: bool, block_q: int,
         functools.partial(_flash_bwd_dq_kernel, nk=nk, **common),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
         grid=(b * h, nq, nk),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec, r_spec],
         out_specs=q_spec,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-    )(qt, kt, vt, dot, lse, delta)
+    )(qt, kt, vt, dot, lse, delta, g_lse)
     # dkv grid: key blocks resident, query blocks innermost
     qk_spec = pl.BlockSpec((1, block_q, d), lambda i, ki, qi: (i, qi, 0))
     kk_spec = pl.BlockSpec((1, block_k, d), lambda i, ki, qi: (i, ki, 0))
@@ -318,11 +341,12 @@ def _flash_bwd(q, k, v, o, lse, g, causal: bool, block_q: int,
         out_shape=(jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
                    jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)),
         grid=(b * h, nk, nq),
-        in_specs=[qk_spec, kk_spec, kk_spec, qk_spec, rk_spec, rk_spec],
+        in_specs=[qk_spec, kk_spec, kk_spec, qk_spec, rk_spec, rk_spec,
+                  rk_spec],
         out_specs=(kk_spec, kk_spec),
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
-    )(qt, kt, vt, dot, lse, delta)
+    )(qt, kt, vt, dot, lse, delta, g_lse)
 
     def unfold(a, s):
         return a.reshape(b, h, s, d).transpose(0, 2, 1, 3)
@@ -346,22 +370,54 @@ def _fa_fwd(q, k, v, causal, block_q, block_k):
     return out, (q, k, v, out, lse)
 
 
-def _fa_bwd(causal, block_q, block_k, res, g):
+def _bwd_with_fallback(causal, block_q, block_k, res, g_out, g_lse):
+    """Shared by both vjps: pallas backward, else warn + rematerialise
+    through blockwise. Only trace-time failures land in the except (a
+    Mosaic compile failure inside jit surfaces later as a hard error)."""
     q, k, v, o, lse = res
     try:
-        return _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k)
+        return _flash_bwd(q, k, v, o, lse, g_out, causal, block_q,
+                          block_k, g_lse=g_lse)
     except Exception as e:
-        # rematerialisation fallback — same gradients, more FLOPs. Only
-        # trace-time failures land here (a Mosaic compile failure inside
-        # jit surfaces later as a hard error); warn so a silently
-        # degraded training run is at least visible in the logs
         import warnings
         warnings.warn(
             f"pallas flash backward unavailable ({e!r:.120}); gradients "
             "fall back to rematerialised blockwise attention")
         _, vjp = jax.vjp(lambda q, k, v: blockwise_attention(
-            q, k, v, causal=causal, block_k=block_k), q, k, v)
-        return vjp(g)
+            q, k, v, causal=causal, block_k=block_k, return_lse=True),
+            q, k, v)
+        if g_lse is None:
+            g_lse = jnp.zeros_like(lse)
+        return vjp((g_out, g_lse))
+
+
+def _fa_bwd(causal, block_q, block_k, res, g):
+    return _bwd_with_fallback(causal, block_q, block_k, res, g, None)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention_with_lse(q, k, v, causal: bool = False,
+                             block_q: int = 128, block_k: int = 128):
+    """Like ``flash_attention`` but also returns the per-row logsumexp
+    ([b·h, s] fp32). Differentiable in BOTH outputs — the lse cotangent
+    folds into the backward kernels' softmax-Jacobian term — which is
+    what ring attention needs to merge per-ring-step partial softmaxes
+    (ops/ring_attention.py use_flash path)."""
+    return _flash_fwd(q, k, v, causal, block_q, block_k, return_lse=True)
+
+
+def _fal_fwd(q, k, v, causal, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k,
+                          return_lse=True)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _fal_bwd(causal, block_q, block_k, res, g):
+    g_out, g_lse = g
+    return _bwd_with_fallback(causal, block_q, block_k, res, g_out, g_lse)
+
+
+flash_attention_with_lse.defvjp(_fal_fwd, _fal_bwd)

@@ -28,6 +28,67 @@ from analytics_zoo_tpu.parallel import mesh as mesh_lib
 NEG_INF = -1e30
 
 
+def _ring_flash_local(q, k, v, *, axis_name: str, causal: bool,
+                      block: int):
+    """Flash-kernel ring step: each resident k/v block goes through the
+    pallas kernel (``flash_attention_with_lse``) and the per-step partial
+    softmaxes merge via their logsumexps — no [s_loc, s_loc] score matrix
+    ever materializes, on top of the ring's O(s/p) sharding. Causality by
+    block position: past blocks run the un-masked kernel, the diagonal
+    block the causal kernel, future blocks are skipped."""
+    from analytics_zoo_tpu.ops.flash_attention import (
+        flash_attention_with_lse,
+    )
+    p = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+
+    def flash_step(k_cur, v_cur, caus):
+        o_i, lse_i = flash_attention_with_lse(
+            q, k_cur, v_cur, caus, block, block)
+        return (o_i.astype(jnp.float32).transpose(0, 2, 1, 3),
+                lse_i.reshape(b, h, s_loc))
+
+    def step_outputs(src, k_cur, v_cur):
+        if not causal:
+            return flash_step(k_cur, v_cur, False)
+        dead = (jnp.zeros((b, h, s_loc, d), jnp.float32),
+                jnp.full((b, h, s_loc), NEG_INF, jnp.float32))
+        return jax.lax.cond(
+            src > my, lambda: dead,
+            lambda: jax.lax.cond(
+                src == my,
+                lambda: flash_step(k_cur, v_cur, True),
+                lambda: flash_step(k_cur, v_cur, False)))
+
+    def accum(i, num, m, den, k_cur, v_cur):
+        src = (my - i) % p
+        o_i, lse_i = step_outputs(src, k_cur, v_cur)
+        m_new = jnp.maximum(m, lse_i)
+        c_old = jnp.exp(m - m_new)
+        c_new = jnp.exp(lse_i - m_new)
+        num = num * c_old[..., None] + o_i * c_new[..., None]
+        den = den * c_old + c_new
+        return num, m_new, den
+
+    def body(i, carry):
+        num, m, den, k_cur, v_cur = carry
+        num, m, den = accum(i, num, m, den, k_cur, v_cur)
+        perm = [(r, (r + 1) % p) for r in range(p)]
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return num, m, den, k_next, v_next
+
+    num0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    m0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
+    den0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    num, m, den, k_last, v_last = jax.lax.fori_loop(
+        0, p - 1, body, (num0, m0, den0, k, v))
+    num, m, den = accum(p - 1, num, m, den, k_last, v_last)
+    out = num / jnp.maximum(den, 1e-37)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
 def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
     """Runs inside shard_map: q,k,v are the local [b, s_loc, h, d] blocks."""
     p = jax.lax.axis_size(axis_name)
@@ -76,12 +137,19 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
 
 
 def ring_attention(q, k, v, mesh=None, axis_name: str = mesh_lib.SEQ_AXIS,
-                   causal: bool = False, batch_axis: Optional[str] = None):
+                   causal: bool = False, batch_axis: Optional[str] = None,
+                   use_flash: Optional[bool] = None,
+                   flash_block: int = 128):
     """q,k,v: [batch, seq, heads, dim] global arrays (seq sharded over
     ``axis_name``) → same-shaped output, seq-sharded.
 
     ``batch_axis``: optionally also shard batch (e.g. "data") so the same
     call works under dp×sp meshes.
+
+    ``use_flash``: run each resident block through the pallas flash
+    kernels and merge ring steps via logsumexp — O(block) memory inside
+    each step on top of the ring's O(s/p). ``None`` auto-selects on TPU
+    when the local block and head_dim are tile-aligned.
     """
     from jax import shard_map
 
@@ -92,8 +160,22 @@ def ring_attention(q, k, v, mesh=None, axis_name: str = mesh_lib.SEQ_AXIS,
     p = axes[axis_name]
     assert q.shape[1] % p == 0, \
         f"seq len {q.shape[1]} must divide over {axis_name}={p}"
+    s_loc, d = q.shape[1] // p, q.shape[-1]
+    if use_flash is None:
+        try:
+            on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+        except Exception:  # pragma: no cover
+            on_tpu = False
+        use_flash = (on_tpu and s_loc % flash_block == 0
+                     and d % 128 == 0)
     spec = P(batch_axis, axis_name, None, None)
-    fn = functools.partial(_ring_attention_local, axis_name=axis_name,
-                           causal=causal)
+    if use_flash:
+        assert s_loc % flash_block == 0, \
+            f"local seq {s_loc} must divide by flash_block {flash_block}"
+        fn = functools.partial(_ring_flash_local, axis_name=axis_name,
+                               causal=causal, block=flash_block)
+    else:
+        fn = functools.partial(_ring_attention_local, axis_name=axis_name,
+                               causal=causal)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, check_vma=False)(q, k, v)

@@ -188,6 +188,55 @@ def test_flash_backward_kernel_interpret_mode(orca_ctx):
         pl.pallas_call = orig
 
 
+def test_ring_flash_composition(orca_ctx):
+    """ring_attention(use_flash=True): each resident block runs the
+    pallas kernels and ring steps merge via logsumexp (the lse cotangent
+    flows through flash_attention_with_lse's backward). Forward AND
+    gradients must match blockwise over the full sequence."""
+    import functools
+    import jax
+    import jax.numpy as jnp
+    import jax.experimental.pallas as pl
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from analytics_zoo_tpu.parallel.strategy import ShardingStrategy
+    from analytics_zoo_tpu.ops.ring_attention import ring_attention
+    from analytics_zoo_tpu.ops.flash_attention import blockwise_attention
+
+    mesh = ShardingStrategy.parse("sp8").build_mesh()
+    key = jax.random.PRNGKey(0)
+    B, S, H, D = 1, 1024, 1, 128
+    q, k, v = (np.asarray(jax.random.normal(kk, (B, S, H, D)), np.float32)
+               for kk in jax.random.split(key, 3))
+    sh = NamedSharding(mesh, P(None, "seq", None, None))
+    gq, gk, gv = (jax.device_put(a, sh) for a in (q, k, v))
+    g = np.asarray(jax.random.normal(jax.random.PRNGKey(9),
+                                     (B, S, H, D)), np.float32)
+
+    orig = pl.pallas_call
+    try:
+        pl.pallas_call = functools.partial(orig, interpret=True)
+        for causal in (False, True):
+            out = np.asarray(ring_attention(gq, gk, gv, mesh=mesh,
+                                            causal=causal, use_flash=True))
+            ref = np.asarray(blockwise_attention(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                causal=causal))
+            np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+            gr = jax.grad(lambda q, k, v: (ring_attention(
+                q, k, v, mesh=mesh, causal=causal, use_flash=True)
+                * jnp.asarray(g)).sum(), argnums=(0, 1, 2))(gq, gk, gv)
+            gb = jax.grad(lambda q, k, v: (blockwise_attention(
+                q, k, v, causal=causal) * jnp.asarray(g)).sum(),
+                argnums=(0, 1, 2))(jnp.asarray(q), jnp.asarray(k),
+                                   jnp.asarray(v))
+            for name, a, b in zip("qkv", gr, gb):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-3, atol=5e-4,
+                    err_msg=f"d{name} causal={causal}")
+    finally:
+        pl.pallas_call = orig
+
+
 class TestCausalCrossLength:
     """Regression: causal mask must be bottom-right aligned (KV-cache decode
     semantics) in every implementation, not just _reference_attention."""
