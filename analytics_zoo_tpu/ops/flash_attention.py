@@ -84,6 +84,17 @@ def blockwise_attention(q, k, v, causal: bool = False, block_k: int = 128,
     return out
 
 
+def default_use_flash(seq: int, head_dim: int, block: int = 128) -> bool:
+    """Shared auto-select for the sequence-parallel compositions (ring /
+    Ulysses): pallas kernels on TPU when the per-device attention shapes
+    are tile-aligned."""
+    try:
+        on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:  # pragma: no cover
+        on_tpu = False
+    return on_tpu and seq % block == 0 and head_dim % 128 == 0
+
+
 # ---------------------------------------------------------------- pallas fwd
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,

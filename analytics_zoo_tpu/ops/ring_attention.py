@@ -162,12 +162,8 @@ def ring_attention(q, k, v, mesh=None, axis_name: str = mesh_lib.SEQ_AXIS,
         f"seq len {q.shape[1]} must divide over {axis_name}={p}"
     s_loc, d = q.shape[1] // p, q.shape[-1]
     if use_flash is None:
-        try:
-            on_tpu = jax.devices()[0].platform in ("tpu", "axon")
-        except Exception:  # pragma: no cover
-            on_tpu = False
-        use_flash = (on_tpu and s_loc % flash_block == 0
-                     and d % 128 == 0)
+        from analytics_zoo_tpu.ops.flash_attention import default_use_flash
+        use_flash = default_use_flash(s_loc, d, flash_block)
     spec = P(batch_axis, axis_name, None, None)
     if use_flash:
         assert s_loc % flash_block == 0, \

@@ -42,7 +42,8 @@ def _attention(q, k, v, causal: bool):
 
 def ulysses_attention(q, k, v, *, mesh=None, causal: bool = False,
                       axis: str = mesh_lib.SEQ_AXIS,
-                      batch_axis: Optional[str] = None):
+                      batch_axis: Optional[str] = None,
+                      use_flash: Optional[bool] = None):
     """q, k, v: [b, s, h, d] GLOBAL arrays sequence-sharded over ``axis``
     (s divisible by the axis size, h divisible too; ``batch_axis`` names
     the data-parallel axis the batch dim is sharded over, if any). Returns
@@ -50,6 +51,13 @@ def ulysses_attention(q, k, v, *, mesh=None, causal: bool = False,
 
     Inside shard_map: all-to-all seq→head, full attention on local heads,
     all-to-all head→seq. XLA lowers both to one ICI all-to-all each.
+
+    ``use_flash``: run the per-device full attention through the pallas
+    flash kernels (fwd + FA-2 bwd) instead of materializing the [s, s]
+    score matrix — after the all-to-all each device holds the FULL
+    sequence for its heads, so long-context Ulysses without flash is
+    O(s²) HBM per device. ``None`` auto-selects on TPU when seq and
+    head_dim are tile-aligned.
     """
     if mesh is None:
         mesh = mesh_lib.get_default_mesh()
@@ -61,6 +69,9 @@ def ulysses_attention(q, k, v, *, mesh=None, causal: bool = False,
     if s % p or h % p:
         raise ValueError(f"seq {s} and heads {h} must divide the {axis!r} "
                          f"axis size {p}")
+    if use_flash is None:
+        from analytics_zoo_tpu.ops.flash_attention import default_use_flash
+        use_flash = default_use_flash(s, d)
 
     spec = P(batch_axis, axis, None, None)
     smap = _shard_map()
@@ -77,8 +88,14 @@ def ulysses_attention(q, k, v, *, mesh=None, causal: bool = False,
             return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
                                       tiled=True)
 
-        out = _attention(to_heads(q_loc), to_heads(k_loc),
-                         to_heads(v_loc), causal)
+        qh, kh, vh = to_heads(q_loc), to_heads(k_loc), to_heads(v_loc)
+        if use_flash:
+            from analytics_zoo_tpu.ops.flash_attention import (
+                flash_attention,
+            )
+            out = flash_attention(qh, kh, vh, causal)
+        else:
+            out = _attention(qh, kh, vh, causal)
         return to_seq(out)
 
     return run(q, k, v)
