@@ -236,19 +236,16 @@ class InferenceModel:
             self._jitted = jax.jit(apply_fn)
 
     # ------------------------------------------------------------- predict
-    def predict(self, x, batch_size: Optional[int] = None) -> np.ndarray:
-        """Batch predict. ``x``: ndarray or tuple of ndarrays (multi-input).
-        Thread-safe; at most ``concurrent_num`` predicts run concurrently
-        (ref InferenceModel.doPredict + model-queue take/offer)."""
-        import jax
-
+    def _snapshot(self):
         with self._lock:
             # one consistent snapshot: a concurrent load_* or
             # load_checkpoint can't mix model versions across chunks
-            apply_ok = self._apply is not None
-            params, jitted, n_inputs = self._params, self._jitted, self._n_inputs
-        if not apply_ok:
-            raise RuntimeError("no model loaded")
+            if self._apply is None:
+                raise RuntimeError("no model loaded")
+            return self._params, self._jitted, self._n_inputs
+
+    @staticmethod
+    def _coerce(x, n_inputs) -> Tuple[np.ndarray, ...]:
         xs = _as_tuple(x)
         if len(xs) != n_inputs:
             if n_inputs == 1:
@@ -256,33 +253,99 @@ class InferenceModel:
             else:
                 raise ValueError(
                     f"model takes {n_inputs} inputs, got {len(xs)}")
-        xs = tuple(np.asarray(a) for a in xs)
+        return tuple(np.asarray(a) for a in xs)
+
+    def _chunks(self, x, n_inputs, batch_size):
+        """Split one logical batch into compile-bucket chunks, padding the
+        tail so every shape hits an already-built executable: yields
+        ``(chunk_tuple, n_valid)``."""
+        xs = self._coerce(x, n_inputs)
         n = xs[0].shape[0]
         if n == 0:
             raise ValueError("predict called on an empty batch")
         bs = int(batch_size) if batch_size else n
+        for lo in range(0, n, bs):
+            hi = min(lo + bs, n)
+            chunk = tuple(a[lo:hi] for a in xs)
+            valid = hi - lo
+            if valid < bs:
+                # pad to the bucket so the same executable is reused
+                chunk = tuple(
+                    np.concatenate(
+                        [a, np.repeat(a[-1:], bs - valid, axis=0)])
+                    for a in chunk)
+            yield chunk, valid
+
+    def predict(self, x, batch_size: Optional[int] = None,
+                pipeline_window: int = 2) -> np.ndarray:
+        """Batch predict. ``x``: ndarray, tuple of ndarrays (multi-input),
+        or an iterator/generator of such batches — a stream is consumed
+        incrementally, one window's worth at a time, instead of being
+        materialized up front.
+
+        Chunks flow through a bounded in-flight dispatch window
+        (``pipeline_window`` batches deep, common/pipeline_io.py): chunk
+        N+1 is sliced/padded and dispatched while chunk N computes, and
+        results are fetched only as the window retires them — never inline
+        with a dispatch. ``pipeline_window=1`` reproduces the synchronous
+        cadence. Outputs are bit-identical either way (same executables,
+        same inputs; only the fetch schedule changes).
+
+        Thread-safe; at most ``concurrent_num`` predicts run concurrently
+        (ref InferenceModel.doPredict + model-queue take/offer)."""
+        import jax
+        from analytics_zoo_tpu.common.pipeline_io import DevicePipeline
+
+        params, jitted, n_inputs = self._snapshot()
+
+        def chunks():
+            if hasattr(x, "__next__"):       # stream of batches
+                for b in x:
+                    yield from self._chunks(b, n_inputs, batch_size)
+            else:
+                yield from self._chunks(x, n_inputs, batch_size)
+
         outs = []
+
+        def take(comp):
+            if comp.error is not None:
+                raise comp.error
+            outs.append(jax.tree_util.tree_map(
+                lambda a: a[:comp.ctx], comp.result))
+
         with self._sem:
-            for lo in range(0, n, bs):
-                hi = min(lo + bs, n)
-                chunk = tuple(a[lo:hi] for a in xs)
-                valid = hi - lo
-                if valid < bs:
-                    # pad to the bucket so the same executable is reused
-                    chunk = tuple(
-                        np.concatenate(
-                            [a, np.repeat(a[-1:], bs - valid, axis=0)])
-                        for a in chunk)
-                out = jitted(params, *chunk)
-                out = jax.device_get(out)
-                out = jax.tree_util.tree_map(lambda a: a[:valid], out)
-                outs.append(out)
+            pipe = DevicePipeline(lambda c: jitted(params, *c),
+                                  window=max(1, int(pipeline_window)))
+            with pipe:
+                for chunk, valid in chunks():
+                    for comp in pipe.submit(chunk, ctx=valid):
+                        take(comp)
+                for comp in pipe.drain():
+                    take(comp)
+        if not outs:
+            raise ValueError("predict called on an empty batch")
         leaves = [jax.tree_util.tree_leaves(o) for o in outs]
         treedef = jax.tree_util.tree_structure(outs[0])
         return jax.tree_util.tree_unflatten(
             treedef,
             [np.concatenate([l[i] for l in leaves])
              for i in range(len(leaves[0]))])
+
+    def predict_async(self, x):
+        """Dispatch ONE already-batched input (ndarray or multi-input
+        tuple) without blocking — the serving engine's staged-dispatch
+        hook. Returns an opaque pending value; pass it to
+        ``predict_fetch`` for the host result. The caller owns batching
+        and padding (the engine pads to its own bucket) and bounds
+        in-flight work through its DevicePipeline window, so the
+        ``concurrent_num`` semaphore is not taken here."""
+        params, jitted, n_inputs = self._snapshot()
+        return jitted(params, *self._coerce(x, n_inputs))
+
+    def predict_fetch(self, pending):
+        """Blocking host side of ``predict_async``."""
+        import jax
+        return jax.device_get(pending)
 
     def predict_classes(self, x, batch_size: Optional[int] = None,
                         zero_based_label: bool = True) -> np.ndarray:

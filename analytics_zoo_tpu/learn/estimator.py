@@ -850,11 +850,20 @@ class JaxEstimator:
             out[m.name] = m.result(ms)
         return out
 
-    def predict(self, data, batch_size: int = 32, feature_cols=None
-                ) -> "np.ndarray | XShards":
+    def predict(self, data, batch_size: int = 32, feature_cols=None,
+                pipeline_window: int = 2) -> "np.ndarray | XShards":
         """(ref estimator.py predict:598-654; returns XShards when given
-        XShards, ndarray otherwise)"""
+        XShards, ndarray otherwise)
+
+        Batches flow through a bounded in-flight dispatch window
+        (common/pipeline_io.py): up to ``pipeline_window`` dispatched
+        batches stay on the device while the iterator stages the next
+        host→device transfer, and ``device_get`` runs only when the window
+        retires a batch — never inline with a dispatch. Outputs are
+        bit-identical to the synchronous path (``pipeline_window=1`` is
+        the synchronous cadence)."""
         import jax
+        from analytics_zoo_tpu.common.pipeline_io import DevicePipeline
         was_shards = isinstance(data, XShards)
         if isinstance(data, tuple):
             # predict takes features only — a tuple is a multi-input x, not
@@ -867,13 +876,25 @@ class JaxEstimator:
         self._init_state()
         self._build_predict()
         outs = []
-        for x, _, mask in ds.device_iterator(mesh, self.strategy, batch_size,
-                                             drop_remainder=False):
-            preds = jax.device_get(self._predict_fn(self._state, x))
+
+        def take(comp):
+            if comp.error is not None:
+                raise comp.error
+            preds, mask = comp.result, comp.ctx
             if mask is not None:
                 valid = int(np.asarray(mask).sum())
                 preds = jax.tree_util.tree_map(lambda a: a[:valid], preds)
             outs.append(preds)
+
+        pipe = DevicePipeline(lambda x: self._predict_fn(self._state, x),
+                              window=max(1, int(pipeline_window)))
+        with pipe:
+            for x, _, mask in ds.device_iterator(
+                    mesh, self.strategy, batch_size, drop_remainder=False):
+                for comp in pipe.submit(x, ctx=mask):
+                    take(comp)
+            for comp in pipe.drain():
+                take(comp)
         leaves = [jax.tree_util.tree_leaves(o) for o in outs]
         treedef = jax.tree_util.tree_structure(outs[0])
         merged = jax.tree_util.tree_unflatten(

@@ -28,28 +28,30 @@ def mean_absolute_error(y_true, y_pred):
 
 
 def mean_absolute_percentage_error(y_true, y_pred):
-    y_pred = _f32(y_pred)
+    y_pred, y_true = _f32(y_pred), _f32(y_true)
     t = _flatten_trailing(y_true)
     return (100.0 * jnp.abs((t - _flatten_trailing(y_pred))
                             / jnp.clip(jnp.abs(t), _EPS, None))).mean(-1)
 
 
 def mean_squared_logarithmic_error(y_true, y_pred):
-    y_pred = _f32(y_pred)
+    y_pred, y_true = _f32(y_pred), _f32(y_true)
     a = jnp.log1p(jnp.clip(_flatten_trailing(y_pred), _EPS, None))
     b = jnp.log1p(jnp.clip(_flatten_trailing(y_true), _EPS, None))
     return jnp.square(a - b).mean(-1)
 
 
-def _f32(y_pred):
+def _f32(a):
     """Losses compute in fp32 even under a bf16 compute policy: log/exp/
-    square of bf16 predictions costs accuracy for no MXU win (the loss is
-    a scalar tail, not a matmul). Applied to both the cross-entropy and
-    regression families so bf16 TARGETS can't silently drag the whole
-    loss into bf16 either."""
-    y_pred = jnp.asarray(y_pred)
-    return y_pred.astype(jnp.float32) \
-        if jnp.issubdtype(y_pred.dtype, jnp.floating) else y_pred
+    square/divide of bf16 values costs accuracy for no MXU win (the loss
+    is a scalar tail, not a matmul). Applied to predictions everywhere,
+    and ALSO to targets wherever the target enters a nonlinear op (the
+    log/ratio family: msle, mape, kld, poisson) — a bf16 target inside a
+    log would otherwise evaluate the transcendental at bf16 precision
+    even though everything around it is fp32."""
+    a = jnp.asarray(a)
+    return a.astype(jnp.float32) \
+        if jnp.issubdtype(a.dtype, jnp.floating) else a
 
 
 def binary_crossentropy(y_true, y_pred):
@@ -105,12 +107,13 @@ def squared_hinge(y_true, y_pred):
 
 
 def kullback_leibler_divergence(y_true, y_pred):
-    t = jnp.clip(y_true, _EPS, 1.0)
-    p = jnp.clip(y_pred, _EPS, 1.0)
+    t = jnp.clip(_f32(y_true), _EPS, 1.0)
+    p = jnp.clip(_f32(y_pred), _EPS, 1.0)
     return (t * jnp.log(t / p)).sum(-1)
 
 
 def poisson(y_true, y_pred):
+    y_pred, y_true = _f32(y_pred), _f32(y_true)
     return (_flatten_trailing(y_pred)
             - _flatten_trailing(y_true) * jnp.log(_flatten_trailing(y_pred) + _EPS)
             ).mean(-1)

@@ -107,19 +107,32 @@ class AttentionModule(nn.Module):
     """Projection + fused attention + output projection.
 
     ``dtype``: computation dtype (params stay fp32) — bf16 doubles MXU
-    throughput on TPU."""
+    throughput on TPU.
+
+    ``self_attention``: force the packed-QKV path on (True) or off (False).
+    The default (None) falls back to an *identity* check — packed when
+    ``kv_in is None or kv_in is q_in`` — which catches callers that pass
+    the same array twice (keras MultiHeadAttention does), but NOT callers
+    whose arguments were rebound by a transform: ``jax.checkpoint`` /
+    ``jax.vmap`` / donated buffers hand the module two *distinct* tracers
+    for the same value, silently demoting it to three separate matmuls.
+    Set ``self_attention=True`` when the module is constructed for a
+    self-attention site to make the fused path transform-proof."""
 
     num_heads: int
     head_dim: int
     dropout: float = 0.0
     causal: bool = False
     dtype: Optional[jnp.dtype] = None
+    self_attention: Optional[bool] = None
 
     @nn.compact
     def __call__(self, q_in, kv_in=None, mask=None, train: bool = False):
-        # identity check so callers that pass the same array explicitly
-        # (keras MultiHeadAttention does) still get the packed matmul
-        self_attn = kv_in is None or kv_in is q_in
+        # explicit flag wins; the identity-check fallback keeps old call
+        # sites working but does not survive argument-rebinding transforms
+        # (see class docstring)
+        self_attn = (self.self_attention if self.self_attention is not None
+                     else kv_in is None or kv_in is q_in)
         kv_in = q_in if kv_in is None else kv_in
         h, d = self.num_heads, self.head_dim
         wq, bq = _ProjParams(q_in.shape[-1], h, d, name="query")()

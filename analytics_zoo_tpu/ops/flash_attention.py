@@ -87,7 +87,9 @@ def blockwise_attention(q, k, v, causal: bool = False, block_k: int = 128,
 def default_use_flash(seq: int, head_dim: int, block: int = 128) -> bool:
     """Shared auto-select for the sequence-parallel compositions (ring /
     Ulysses): pallas kernels on TPU when the per-device attention shapes
-    are tile-aligned."""
+    are tile-aligned. ``head_dim % 128 != 0`` (e.g. 64, the BERT-class
+    default) always returns False — callers fall back to their blockwise
+    path for those models."""
     try:
         on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     except Exception:  # pragma: no cover

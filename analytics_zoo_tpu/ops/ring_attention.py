@@ -149,7 +149,12 @@ def ring_attention(q, k, v, mesh=None, axis_name: str = mesh_lib.SEQ_AXIS,
     ``use_flash``: run each resident block through the pallas flash
     kernels and merge ring steps via logsumexp — O(block) memory inside
     each step on top of the ring's O(s/p). ``None`` auto-selects on TPU
-    when the local block and head_dim are tile-aligned.
+    when the local block and head_dim are tile-aligned. Note the
+    tile-alignment rule excludes ``head_dim % 128 != 0``: auto-select
+    NEVER engages flash for e.g. head_dim=64 (BERT-class models) — those
+    shapes take the blockwise-jax path. ``use_flash=True`` overrides the
+    heuristic but the kernel does not pad head_dim, so an unaligned lane
+    dimension is left to the Mosaic compiler (may relayout or reject).
     """
     from jax import shard_map
 

@@ -13,6 +13,13 @@ the MXU saturated, so the engine pads each dequeued batch up to
 via its batch slicing, tf_dataset.py:117).
 
 Per-stage latency stats mirror serving ``Timer.scala:26``.
+
+The serve loop is a produce → staged-dispatch → drain pipeline
+(common/pipeline_io.py): dequeue/decode/preprocess of batch N+1 overlaps
+batch N's device compute through a bounded in-flight window, and results
+are only fetched when the window is full or the stream idles — round-5
+on-chip profiling showed the synchronous loop left the accelerator idle
+during every broker round-trip (VERDICT.md weak #5/#7).
 """
 
 from __future__ import annotations
@@ -24,33 +31,18 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+# StageTimer moved to the shared pipeline layer; re-exported here because
+# the engine is its historical home.
+from analytics_zoo_tpu.common.pipeline_io import (  # noqa: F401
+    Completed,
+    DevicePipeline,
+    StageTimer,
+)
 from analytics_zoo_tpu.serving import schema
 from analytics_zoo_tpu.serving.broker import Broker, BrokerClient
 from analytics_zoo_tpu.serving.client import INPUT_STREAM, RESULT_HASH
 
 logger = logging.getLogger(__name__)
-
-
-class StageTimer:
-    """Per-stage wall-time stats (ref serving/utils/Timer.scala:26)."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self.stats: Dict[str, List[float]] = {}
-
-    def record(self, stage: str, dt: float):
-        with self._lock:
-            self.stats.setdefault(stage, []).append(dt)
-
-    def summary(self) -> Dict[str, Dict[str, float]]:
-        with self._lock:
-            out = {}
-            for stage, xs in self.stats.items():
-                arr = np.asarray(xs)
-                out[stage] = {"count": len(xs), "mean_ms": float(arr.mean() * 1e3),
-                              "p99_ms": float(np.percentile(arr, 99) * 1e3),
-                              "total_s": float(arr.sum())}
-            return out
 
 
 def ndarray_chain(pipe):
@@ -88,7 +80,21 @@ class ClusterServing:
     decode-and-preprocess flow (PreProcessing.scala:36,67-90). Build one
     from a preset with ``image_pipeline("resnet-50", source=...)`` or wire
     it from config.yaml's ``preprocessing:`` section.
+
+    ``pipeline_window``: how many dispatched batches may be in flight on
+    the device while the loop dequeues/preprocesses the next ones (0 =
+    fully synchronous dispatch, the pre-pipeline behavior — kept as the
+    measured baseline for bench.py's sync-vs-pipelined comparison).
+
+    ``max_batch_size``: cap for adaptive batch growth. Under sustained
+    backlog (every dequeue returns a full batch) the engine doubles its
+    batch bucket up to this cap — fewer, bigger dispatches win when the
+    per-dispatch cost dominates. ``None`` defaults to 4× ``batch_size``;
+    set it equal to ``batch_size`` to pin the bucket.
     """
+
+    #: consecutive full dequeues that count as "sustained backlog"
+    BACKLOG_GROW_AFTER = 8
 
     def __init__(self, model, broker_port: int, batch_size: int = 8,
                  stream: str = INPUT_STREAM, result_key: str = RESULT_HASH,
@@ -98,9 +104,15 @@ class ClusterServing:
                  postprocess=None, block_ms: int = 50,
                  claim_min_idle_ms: int = 30000,
                  broker_host: str = "127.0.0.1",
-                 image_preprocess=None):
+                 image_preprocess=None,
+                 pipeline_window: int = 2,
+                 max_batch_size: Optional[int] = None):
         self.model = model
         self.batch_size = int(batch_size)
+        self.pipeline_window = int(pipeline_window)
+        self.max_batch_size = int(max_batch_size) if max_batch_size \
+            else 4 * self.batch_size
+        self._full_streak = 0
         self.broker_host = broker_host
         self.broker_port = broker_port
         self.stream, self.result_key = stream, result_key
@@ -140,7 +152,10 @@ class ClusterServing:
         return out
 
     # --------------------------------------------------------------- loop
-    def _serve_once(self, client: BrokerClient) -> int:
+    def _produce(self, client: BrokerClient, block_ms: int):
+        """Host stage: dequeue + decode + preprocess + stack/pad ONE batch.
+        Returns ``(x, ctx)`` ready for dispatch, or None when nothing
+        servable arrived (per-record errors are flushed here)."""
         t0 = time.time()
         # recover entries a dead/crashed consumer never acked (ref: the
         # Redis-streams recovery path the reference LACKS an analog of —
@@ -155,10 +170,12 @@ class ClusterServing:
         if not entries:
             entries = client.xreadgroup(self.group, self.consumer,
                                         self.stream, self.batch_size,
-                                        self.block_ms)
+                                        block_ms)
         if not entries:
-            return 0
+            self._full_streak = 0
+            return None
         self.timer.record("dequeue", time.time() - t0)
+        self._grow_batch_on_backlog(len(entries))
 
         t0 = time.time()
         # per-record error HSETs accumulate here and ride the same
@@ -215,7 +232,7 @@ class ClusterServing:
             uris, rows = kept_uris, kept
         if not rows:
             client.pipeline(err_cmds + ack_cmds)
-            return 0
+            return None
         cols = self.input_cols or sorted(rows[0].keys())
         batch = [np.stack([r[c] for r in rows]) for c in cols]
         n = len(rows)
@@ -224,25 +241,56 @@ class ClusterServing:
                 [b, np.repeat(b[-1:], self.batch_size - n, axis=0)])
                 for b in batch]
         self.timer.record("preprocess", time.time() - t0)
-
-        t0 = time.time()
         x = batch[0] if len(batch) == 1 else tuple(batch)
-        try:
-            preds = np.asarray(self.model.predict(x))[:n]
-        except Exception as e:
+        return x, (uris, err_cmds, ack_cmds, n)
+
+    def _grow_batch_on_backlog(self, dequeued: int):
+        """Adaptive batch growth: every dequeue coming back full means the
+        stream is producing faster than we drain — double the compile
+        bucket (one recompile per doubling) up to ``max_batch_size``."""
+        if dequeued >= self.batch_size:
+            self._full_streak += 1
+        else:
+            self._full_streak = 0
+        if (self._full_streak >= self.BACKLOG_GROW_AFTER
+                and self.batch_size < self.max_batch_size):
+            self.batch_size = min(2 * self.batch_size, self.max_batch_size)
+            self._full_streak = 0
+            self.timer.record_value("batch_size", self.batch_size)
+            logger.info("sustained backlog: batch bucket grown to %d",
+                        self.batch_size)
+
+    def _dispatch(self, x):
+        """Device stage: non-blocking when the model supports it (an
+        InferenceModel dispatches the jitted executable and returns device
+        futures); duck-typed models fall back to their blocking predict."""
+        fn = getattr(self.model, "predict_async", None)
+        return fn(x) if fn is not None else self.model.predict(x)
+
+    def _fetch(self, pending):
+        fn = getattr(self.model, "predict_fetch", None)
+        return np.asarray(fn(pending) if fn is not None else pending)
+
+    def _finish(self, client: BrokerClient, comp: Completed) -> int:
+        """Drain stage: postprocess + result/ack flush for one retired
+        batch."""
+        uris, err_cmds, ack_cmds, n = comp.ctx
+        if comp.error is not None:
             # model incompatibility: every record gets an error result and
             # the entries are acked — losing them silently would hang the
             # clients AND pin the broker's GC low-water mark forever
-            logger.exception("inference failed for batch of %d", n)
-            err = schema.encode_error(f"inference failed: {e}", self.cipher)
+            logger.error("inference failed for batch of %d: %s",
+                         n, comp.error)
+            err = schema.encode_error(f"inference failed: {comp.error}",
+                                      self.cipher)
             client.pipeline(
                 err_cmds
                 + [("HSET", self.result_key, uri, err) for uri in uris]
                 + ack_cmds)
-            self.timer.record("inference_error", time.time() - t0)
+            self.timer.record("inference_error", comp.inflight_s)
             return 0
-        self.timer.record("inference", time.time() - t0)
-
+        self.timer.record("inference", comp.inflight_s)
+        preds = np.asarray(comp.result)[:n]
         t0 = time.time()
         cmds = list(err_cmds)
         for uri, pred in zip(uris, preds):
@@ -258,21 +306,59 @@ class ClusterServing:
                 val = schema.encode_error(
                     f"postprocess failed: {e}", self.cipher)
             cmds.append(("HSET", self.result_key, uri, val))
-        client.pipeline(cmds + ack_cmds)
+        # count BEFORE the flush: the broker makes the HSETs visible to
+        # polling clients before it answers the pipelined write, so a
+        # client that sees its result and immediately reads /metrics must
+        # find the batch already counted
         self.timer.record("postprocess", time.time() - t0)
         self.records_out += n
+        client.pipeline(cmds + ack_cmds)
         return n
 
+    def _serve_once(self, client: BrokerClient,
+                    pipe: Optional[DevicePipeline] = None) -> int:
+        """One loop turn: produce a batch and stage its dispatch; retire
+        batches the window pushed out (or everything, when the stream
+        idles — a lone request must not wait for the window to fill)."""
+        if pipe is None:                         # direct-call compatibility
+            pipe = self._make_pipe()
+            done = []
+            produced = self._produce(client, self.block_ms)
+            if produced is not None:
+                done = pipe.submit(*produced)
+            done += pipe.drain()
+            return sum(self._finish(client, c) for c in done)
+        # while batches are in flight, poll instead of blocking in the
+        # broker read — their results are ready to drain right now
+        block_ms = 0 if pipe.in_flight else self.block_ms
+        produced = self._produce(client, block_ms)
+        if produced is not None:
+            done = pipe.submit(*produced)
+            if self.pipeline_window == 0:        # measured sync baseline
+                done += pipe.drain()
+        else:
+            done = pipe.drain()
+        return sum(self._finish(client, c) for c in done)
+
+    def _make_pipe(self) -> DevicePipeline:
+        return DevicePipeline(self._dispatch,
+                              window=max(1, self.pipeline_window),
+                              fetch_fn=self._fetch, timer=self.timer)
+
     def _run(self):
-        logger.info("serving started: stream=%s batch=%d",
-                    self.stream, self.batch_size)
+        logger.info("serving started: stream=%s batch=%d window=%d",
+                    self.stream, self.batch_size, self.pipeline_window)
         client: Optional[BrokerClient] = None
+        # the pipeline outlives broker reconnects: in-flight device work is
+        # finished against the redialed client, so results are never lost
+        # to a socket failure between dispatch and drain
+        pipe = self._make_pipe()
         while not self._stop.is_set():
             try:
                 if client is None:
                     client = BrokerClient(host=self.broker_host,
                                           port=self.broker_port)
-                self._serve_once(client)
+                self._serve_once(client, pipe)
             except (ConnectionError, OSError):
                 # broker died or the socket went bad: DROP the client and
                 # redial next round (keeping a dead client would loop
@@ -288,6 +374,15 @@ class ClusterServing:
                 # the loop is the service — survive anything per-batch
                 logger.exception("serve step failed; continuing")
                 time.sleep(0.05)
+        # drain-on-stop: in-flight batches still flush their results/acks
+        # so a clean shutdown never strands dispatched work
+        try:
+            for c in pipe.drain():
+                if client is not None:
+                    self._finish(client, c)
+        except Exception:
+            logger.exception("final drain failed; pending entries will be "
+                             "re-delivered via XCLAIM")
         if client is not None:
             client.close()
 

@@ -289,16 +289,29 @@ def measure_bert():
     return out
 
 
-def _serve_once(im, payloads, tag):
-    """One end-to-end serve run: broker + engine + pipelined client."""
+# serving bench shapes (shrunk by the smoke tests): enough batches that
+# the dispatch window actually pipelines, and a model deep enough that
+# device compute is comparable to the host's decode/broker work — the
+# regime where overlap pays
+SERVE_N, SERVE_BATCH, SERVE_HIDDEN, SERVE_WINDOW = 2048, 64, 256, 4
+# best-of-k per mode, interleaved: single-core broker/scheduler jitter
+# swings a lone pass by ~±15%, drowning the overlap delta
+SERVE_REPS = 3
+
+
+def _serve_once(im, payloads, tag, pipeline_window=SERVE_WINDOW):
+    """One end-to-end serve run: broker + engine + pipelined client.
+    ``pipeline_window=0`` measures the synchronous-dispatch baseline."""
     from analytics_zoo_tpu.serving import (
         Broker, ClusterServing, InputQueue, OutputQueue,
     )
     N = len(payloads)
-    # large batch bucket: over the accelerator tunnel the cost is per
-    # DISPATCH, so fewer, bigger batches dominate records/s
+    # fixed batch bucket (max_batch_size pins adaptive growth) so sync and
+    # pipelined runs hit identical executables and differ only in overlap
     with Broker.launch() as broker, \
-            ClusterServing(im, broker.port, batch_size=256).start():
+            ClusterServing(im, broker.port, batch_size=SERVE_BATCH,
+                           max_batch_size=SERVE_BATCH,
+                           pipeline_window=pipeline_window).start():
         in_q = InputQueue(port=broker.port)
         out_q = OutputQueue(port=broker.port)
         # warm the compile bucket
@@ -315,10 +328,18 @@ def _serve_once(im, payloads, tag):
 
 
 def measure_serving():
-    """Cluster Serving end-to-end records/s through the native C++ broker,
-    fp32 and int8 weight-quantized (ref BASELINE: Flink
+    """Cluster Serving end-to-end records/s through the native C++ broker:
+    synchronous-dispatch baseline vs the bounded in-flight window
+    (ISSUE 1 tentpole — the overlap win is a measured artifact, not a
+    claim), plus int8 weight+activation quantized (ref BASELINE: Flink
     numRecordsOutPerSecond + the reference's 'up to 2x inference speedup'
-    int8 claim — the reference publishes the metric surface, no number)."""
+    int8 claim — the reference publishes the metric surface, no number).
+
+    On a single-core CPU host the two modes are parity-bounded (engine,
+    broker, and XLA all share the core, so overlap cannot create
+    throughput); the sync/pipelined ratio there reads ~1.0±noise and is
+    recorded for the on-chip run, where each dispatch carries the ~30 ms
+    tunnel tax that the window actually hides."""
     import numpy as np
     import flax.linen as nn
     from analytics_zoo_tpu.inference import InferenceModel
@@ -326,14 +347,28 @@ def measure_serving():
     class Net(nn.Module):
         @nn.compact
         def __call__(self, x):
-            return nn.Dense(8)(nn.relu(nn.Dense(32)(x)))
+            for _ in range(3):
+                x = nn.relu(nn.Dense(SERVE_HIDDEN)(x))
+            return nn.Dense(8)(x)
 
     im = InferenceModel().load_flax(Net(), np.zeros((1, 16), np.float32))
-    N = 512
     rng = np.random.default_rng(3)
-    payloads = rng.standard_normal((N, 16)).astype(np.float32)
-    rps, backend = _serve_once(im, payloads, "r")
-    out = {"serving_records_per_sec": round(rps, 1),
+    payloads = rng.standard_normal((SERVE_N, 16)).astype(np.float32)
+    # interleave the modes so slow host drift hits both equally; keep the
+    # best pass of each (same executables — only the overlap differs)
+    sync_runs, pipe_runs = [], []
+    for i in range(SERVE_REPS):
+        sync_runs.append(_serve_once(im, payloads, f"s{i}",
+                                     pipeline_window=0))
+        pipe_runs.append(_serve_once(im, payloads, f"r{i}"))
+    rps_sync = max(r[0] for r in sync_runs)
+    rps_pipe = max(r[0] for r in pipe_runs)
+    backend = sync_runs[0][1]
+    out = {"serving_records_per_sec": round(rps_pipe, 1),
+           "serving_sync_records_per_sec": round(rps_sync, 1),
+           "serving_pipelined_records_per_sec": round(rps_pipe, 1),
+           "serving_pipeline_speedup": round(rps_pipe / rps_sync, 3),
+           "serving_pipeline_window": SERVE_WINDOW,
            "serving_broker": backend}
     try:
         # calibrated activation+weight int8: every Dense runs as
@@ -389,12 +424,21 @@ def measure_flash_attention():
     k = jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
     v = jax.random.normal(kv, (B, S, H, D), jnp.bfloat16)
 
-    def timed(fn):
+    def timed(fn, chain=lambda out, a: (out, a[1], a[2])):
+        """Mean per-iteration time with honest fencing: each iteration's
+        input depends on the previous output (``chain`` folds result into
+        the next args), so the final ``block_until_ready`` fences the whole
+        chain — not just the last of FA_ITERS unordered dispatches, which
+        would let XLA overlap them all and under-report per-call latency.
+        Attention output is a convex combination of ``v`` so the chained
+        values stay bounded and every iteration hits the same executable."""
         f = jax.jit(fn)
         jax.block_until_ready(f(q, k, v))       # compile
+        args = (q, k, v)
         t0 = time.perf_counter()
         for _ in range(FA_ITERS):
-            out = f(q, k, v)
+            out = f(*args)
+            args = chain(out, args)
         jax.block_until_ready(out)
         return (time.perf_counter() - t0) / FA_ITERS
 
@@ -442,11 +486,15 @@ def measure_flash_attention():
                     lambda q, k, v: fn(q, k, v).astype(jnp.float32).sum(),
                     argnums=(0, 1, 2))
 
+            # grads return (dq, dk, dv): chain them straight in as the
+            # next iteration's inputs
             dtg_flash = timed(grad_of(
                 lambda q, k, v: flash_attention(q, k, v, causal=True,
-                                                block_q=bq, block_k=bk)))
+                                                block_q=bq, block_k=bk)),
+                chain=lambda out, a: out)
             dtg_block = timed(grad_of(
-                lambda q, k, v: blockwise_attention(q, k, v, causal=True)))
+                lambda q, k, v: blockwise_attention(q, k, v, causal=True)),
+                chain=lambda out, a: out)
             out["flash_bwd_ms"] = round(dtg_flash * 1e3, 3)
             out["blockwise_bwd_ms"] = round(dtg_block * 1e3, 3)
             out["flash_bwd_vs_blockwise_speedup"] = round(

@@ -6,7 +6,8 @@
 #
 #   dev/run-tests.sh              # everything
 #   dev/run-tests.sh core         # one lane
-#   Lanes: core data keras models zouwu automl serving interop examples
+#   dev/run-tests.sh smoke        # fast pre-push subset (<5 min, 1 core)
+#   Lanes: smoke core data keras models zouwu automl serving interop examples
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -15,6 +16,12 @@ lane="${1:-all}"
 run() { echo "== pytest $*"; python -m pytest -q "$@"; }
 
 case "$lane" in
+  # fast cross-subsystem sweep for the edit loop: serving end-to-end,
+  # the dispatch pipeline, estimator, inference + quantize, attention
+  # ops — everything marked slow stays out
+  smoke)    run -m "not slow" tests/test_pipeline_io.py \
+                tests/test_serving.py tests/test_inference_net.py \
+                tests/test_estimator.py tests/test_attention.py ;;
   core)     run tests/test_context.py tests/test_estimator.py \
                 tests/test_estimator_edge.py tests/test_estimator_factories.py \
                 tests/test_attention.py tests/test_pipeline.py tests/test_moe.py ;;

@@ -60,7 +60,10 @@ def main():
             print("served:", stats["records_out"], "records; stage "
                   "latencies (ms):",
                   {k: round(v["mean_ms"], 1) for k, v in stats.items()
-                   if isinstance(v, dict)})
+                   if isinstance(v, dict) and "mean_ms" in v})
+            print("pipeline gauges:",
+                  {k: round(v["mean"], 2) for k, v in stats.items()
+                   if isinstance(v, dict) and "mean" in v})
 
 
 if __name__ == "__main__":

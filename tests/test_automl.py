@@ -251,6 +251,7 @@ class TestHyperband:
 
 
 class TestPopulationSearch:
+    @pytest.mark.slow  # ~14s: trains the full population twice (vmap+serial)
     def test_vmapped_population_matches_and_beats_serial(self, tmp_path,
                                                          orca_ctx):
         """The fused vmap population must (a) train every member for real,

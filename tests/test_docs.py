@@ -34,10 +34,18 @@ sys.path.insert(0, {repo!r})
 """
 
 
+# docs whose snippets train real models for minutes on 1 CPU core — run
+# them in the full lane only, not in tier-1/smoke (model-zoo ~100s,
+# zouwu ~12s measured)
+_SLOW_DOCS = {"model-zoo.md", "zouwu.md"}
+
+
 def _doc_files():
-    return sorted(f for f in os.listdir(DOCS)
+    docs = sorted(f for f in os.listdir(DOCS)
                   if f.endswith(".md") and f not in ("BERT_MFU.md",
                                                      "INT8_CEILING.md"))
+    return [pytest.param(d, marks=pytest.mark.slow) if d in _SLOW_DOCS
+            else d for d in docs]
 
 
 def extract_blocks(path):

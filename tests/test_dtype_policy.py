@@ -79,6 +79,30 @@ class TestDtypePolicy:
         h = m.fit(x, y, batch_size=32, nb_epoch=4)
         assert h["loss"][-1] < h["loss"][0]
 
+    def test_bf16_targets_upcast_in_log_family_losses(self):
+        """Regression: _f32 used to upcast only y_pred, so a bf16 TARGET
+        inside a log/ratio op (msle's log1p(y_true), mape's 1/|y_true|,
+        kld's log(t/p), poisson) evaluated the transcendental at bf16
+        precision. Each loss must now match its result on fp32-cast
+        targets exactly, and compute in fp32."""
+        from analytics_zoo_tpu.learn import losses
+
+        rng = np.random.default_rng(3)
+        t32 = (rng.uniform(0.05, 4.0, (8, 5))).astype(np.float32)
+        p32 = (rng.uniform(0.05, 4.0, (8, 5))).astype(np.float32)
+        t16, p16 = t32.astype(jnp.bfloat16), p32.astype(jnp.bfloat16)
+        for name in ("msle", "mape", "kld", "poisson"):
+            fn = losses.get(name)
+            got = fn(t16, p16)
+            assert got.dtype == jnp.float32, name
+            # bf16 inputs upcast-then-compute == computing on the fp32
+            # casts directly (bitwise — the cast is the ONLY rounding)
+            want = fn(np.asarray(t16, np.float32),
+                      np.asarray(p16, np.float32))
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(want), err_msg=name)
+
+    @pytest.mark.slow  # ~14s: compiles mobilenet-v2 inference on 1 core
     def test_image_classifier_dtype_arg(self, orca_ctx):
         from analytics_zoo_tpu.models.image.imageclassification import (
             ImageClassifier,

@@ -21,8 +21,15 @@ ALL = ["recommendation_ncf.py", "anomaly_detection.py",
        "automl_hp_search.py", "qa_ranker.py", "multihost_launch.py",
        "image_classification_serving.py"]
 
+# the heavyweight end-to-end examples (multi-process launches, real
+# training loops: 10-25s each on 1 core) run in the examples lane only
+_SLOW = {"distributed_training.py", "autots_forecast.py",
+         "object_detection.py", "multihost_launch.py"}
 
-@pytest.mark.parametrize("script", ALL)
+
+@pytest.mark.parametrize(
+    "script", [pytest.param(s, marks=pytest.mark.slow) if s in _SLOW else s
+               for s in ALL])
 def test_example_runs(script):
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")

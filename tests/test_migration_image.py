@@ -36,6 +36,7 @@ def _parity(name, size, class_num=10, batch=2, tol=1e-4):
     return clf, twin
 
 
+@pytest.mark.slow  # 30-400s per model: full torchvision import + parity
 class TestTorchvisionImportParity:
     """eval-mode predict parity vs the torch twin (GAP backbones run at
     64px to keep single-core CPU time sane; the fixed-flatten ones need
@@ -126,6 +127,7 @@ class TestTorchvisionImportParity:
                                    rtol=1e-6)
 
 
+@pytest.mark.slow  # ~6 min: SSD300-VGG import parity on 1 core
 class TestSSD300Import:
     """SSD300-VGG weight import (ssd.pytorch-format state_dict — the
     public source of trained SSD300 weights; ref ObjectDetector.scala

@@ -422,6 +422,7 @@ class TestSSDFidelity:
                                    atol=1e-5)
 
 
+@pytest.mark.slow  # ~75s: trains the SSD overfit fixture end-to-end
 class TestSSDImageFixture:
     """Full detect path on checked-in image fixtures (the reference keeps
     VOC sample images in zoo/src/test/resources for exactly this)."""
@@ -475,8 +476,12 @@ class TestFullBackbones:
 
     NAMES = ["alexnet", "vgg-16", "resnet-50", "inception-v1",
              "squeezenet", "densenet-121", "mobilenet-v2"]
-
-    @pytest.mark.parametrize("name", NAMES)
+    # the three >10s compiles stay out of the fast lanes (the set is
+    # inlined: comprehensions cannot read class-body names)
+    @pytest.mark.parametrize(
+        "name", [pytest.param(n, marks=pytest.mark.slow)
+                 if n in {"vgg-16", "resnet-50", "inception-v1"}
+                 else n for n in NAMES])
     def test_builds_and_forwards(self, orca_ctx, name):
         m = ImageClassifier(class_num=7, model_name=name, image_size=64)
         out = np.asarray(m.predict(np.zeros((2, 64, 64, 3), np.float32),

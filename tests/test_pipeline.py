@@ -160,6 +160,7 @@ class TestHeterogeneousPipeline:
         assert got.shape == (16, 8, 17)
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow  # ~14s: pipeline-parallel grads vs sequential
     def test_gradients_match_sequential(self, pipe_mesh):
         import jax
         import jax.numpy as jnp

@@ -1,0 +1,331 @@
+"""Device-dispatch pipeline tests (common/pipeline_io.py + its three
+consumers): window-bound backpressure, FIFO ordering under out-of-order
+device completion, error propagation, drain-on-close, and bit-exact
+equivalence of the pipelined predict paths with their synchronous cadence
+(ISSUE 1 acceptance criteria)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.pipeline_io import (
+    Completed,
+    DevicePipeline,
+    StageTimer,
+)
+
+
+# ------------------------------------------------------------ unit: window
+class Recorder:
+    """submit/fetch pair instrumented to count batches in flight —
+    a stand-in for the device: submit is non-blocking, fetch blocks."""
+
+    def __init__(self, fetch_delay=None):
+        self.outstanding = 0
+        self.max_outstanding = 0
+        self.submitted = []
+        self.fetched = []
+        self.fetch_delay = fetch_delay or (lambda b: 0.0)
+
+    def submit(self, batch):
+        self.outstanding += 1
+        self.max_outstanding = max(self.max_outstanding, self.outstanding)
+        self.submitted.append(batch)
+        return batch
+
+    def fetch(self, pending):
+        d = self.fetch_delay(pending)
+        if d:
+            time.sleep(d)
+        self.outstanding -= 1
+        self.fetched.append(pending)
+        return pending * 10
+
+
+def test_window_validation():
+    with pytest.raises(ValueError):
+        DevicePipeline(lambda b: b, window=0)
+
+
+def test_backpressure_never_exceeds_window():
+    """THE acceptance assertion: at most K batches in flight, ever —
+    dispatch and retrieval are decoupled but bounded."""
+    for k in (1, 2, 4):
+        rec = Recorder()
+        pipe = DevicePipeline(rec.submit, window=k, fetch_fn=rec.fetch)
+        for i in range(20):
+            pipe.submit(i)
+            assert pipe.in_flight <= k
+            assert rec.outstanding <= k
+        pipe.drain()
+        assert rec.max_outstanding == k        # the window actually fills
+        assert pipe.in_flight == 0
+        assert rec.fetched == list(range(20))
+
+
+def test_submit_returns_nothing_until_window_fills():
+    rec = Recorder()
+    pipe = DevicePipeline(rec.submit, window=3, fetch_fn=rec.fetch)
+    assert pipe.submit(0) == []
+    assert pipe.submit(1) == []
+    assert pipe.submit(2) == []
+    done = pipe.submit(3)                      # overflow retires the oldest
+    assert [c.result for c in done] == [0]
+    assert pipe.in_flight == 3
+    assert [c.result for c in pipe.drain()] == [10, 20, 30]
+
+
+def test_ordering_under_out_of_order_completion():
+    """Batches 'complete' on the fake device in reverse order (early
+    batches are the slowest to fetch); retirement must still be FIFO in
+    submission order."""
+    rec = Recorder(fetch_delay=lambda b: 0.02 if b < 3 else 0.0)
+    done = {}
+
+    def complete_async(batch):
+        # out-of-order completion: a background thread finishes later
+        # batches first; fetch then waits on the per-batch event
+        ev = threading.Event()
+        done[batch] = ev
+        threading.Timer(0.03 if batch < 3 else 0.001, ev.set).start()
+        return batch
+
+    def fetch(batch):
+        done[batch].wait(timeout=5)
+        return rec.fetch(batch)
+
+    pipe = DevicePipeline(complete_async, window=2, fetch_fn=fetch)
+    out = list(pipe.map(range(6)))
+    assert out == [0, 10, 20, 30, 40, 50]      # submission order, always
+
+
+def test_map_reraises_failed_batch_in_order():
+    def submit(b):
+        if b == 3:
+            raise RuntimeError("bad batch 3")
+        return b
+
+    pipe = DevicePipeline(submit, window=2, fetch_fn=lambda p: p)
+    got = []
+    with pytest.raises(RuntimeError, match="bad batch 3"):
+        for r in pipe.map(range(6)):
+            got.append(r)
+    # everything BEFORE the failed batch was yielded first
+    assert got == [0, 1, 2]
+
+
+def test_dispatch_error_rides_window_in_order():
+    """A failed dispatch retires as an error Completed at its FIFO
+    position; neighbours are unaffected (the serving engine depends on
+    this to emit per-record error results without tearing down)."""
+    def submit(b):
+        if b == 1:
+            raise ValueError("boom")
+        return b
+
+    pipe = DevicePipeline(submit, window=4, fetch_fn=lambda p: p)
+    for i in range(3):
+        pipe.submit(i, ctx=f"ctx{i}")
+    comps = pipe.drain()
+    assert [c.ctx for c in comps] == ["ctx0", "ctx1", "ctx2"]
+    assert comps[0].error is None and comps[0].result == 0
+    assert isinstance(comps[1].error, ValueError)
+    assert comps[1].result is None
+    assert comps[2].error is None and comps[2].result == 2
+
+
+def test_fetch_error_is_captured_not_raised():
+    def fetch(p):
+        if p == 1:
+            raise OSError("device pull failed")
+        return p
+
+    pipe = DevicePipeline(lambda b: b, window=4, fetch_fn=fetch)
+    for i in range(3):
+        pipe.submit(i)
+    comps = pipe.drain()
+    assert comps[0].error is None
+    assert isinstance(comps[1].error, OSError)
+    assert comps[2].error is None
+
+
+def test_drain_on_close():
+    rec = Recorder()
+    with DevicePipeline(rec.submit, window=8, fetch_fn=rec.fetch) as pipe:
+        for i in range(5):
+            pipe.submit(i)
+        assert pipe.in_flight == 5
+    # __exit__ retired everything — no device work left dangling
+    assert pipe.in_flight == 0
+    assert rec.fetched == list(range(5))
+
+
+def test_drain_max_n():
+    pipe = DevicePipeline(lambda b: b, window=8, fetch_fn=lambda p: p)
+    for i in range(5):
+        pipe.submit(i)
+    assert [c.result for c in pipe.drain(max_n=2)] == [0, 1]
+    assert pipe.in_flight == 3
+    assert [c.result for c in pipe.drain()] == [2, 3, 4]
+
+
+def test_timer_gauges_recorded():
+    t = StageTimer()
+    pipe = DevicePipeline(lambda b: b, window=2, fetch_fn=lambda p: p,
+                          timer=t)
+    list(pipe.map(range(4)))
+    s = t.summary()
+    assert s["dispatch"]["count"] == 4 and s["fetch"]["count"] == 4
+    assert s["window_depth"]["count"] == 4
+    assert 1.0 <= s["window_depth"]["p99"] <= 2.0
+    assert s["overlap_ratio"]["count"] == 4
+    assert all(0.0 <= v <= 1.0 for v in t.values["overlap_ratio"])
+
+
+# ------------------------------------------- consumers: bit-exact equality
+class _Net:
+    pass
+
+
+def _flax_im(seed=0, n_in=6, n_out=4):
+    import flax.linen as nn
+    from analytics_zoo_tpu.inference import InferenceModel
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(n_out)(nn.relu(nn.Dense(16)(x)))
+
+    return InferenceModel().load_flax(
+        Net(), np.zeros((1, n_in), np.float32))
+
+
+def test_inference_model_pipelined_matches_sync(orca_ctx):
+    im = _flax_im()
+    x = np.random.default_rng(0).standard_normal((37, 6)).astype(np.float32)
+    sync = im.predict(x, batch_size=8, pipeline_window=1)
+    for w in (2, 4):
+        piped = im.predict(x, batch_size=8, pipeline_window=w)
+        np.testing.assert_array_equal(sync, piped)   # bitwise
+    # generator input streams through the same window, same bits
+    gen = (x[i:i + 8] for i in range(0, len(x), 8))
+    streamed = im.predict(gen, pipeline_window=3)
+    np.testing.assert_array_equal(sync, streamed)
+
+
+def test_inference_model_async_hooks_match_predict(orca_ctx):
+    im = _flax_im(seed=1)
+    x = np.random.default_rng(1).standard_normal((8, 6)).astype(np.float32)
+    pending = im.predict_async(x)
+    got = np.asarray(im.predict_fetch(pending))
+    np.testing.assert_array_equal(got, im.predict(x))
+
+
+def test_estimator_predict_pipelined_matches_sync(orca_ctx):
+    import flax.linen as nn
+    from analytics_zoo_tpu.learn.estimator import Estimator
+    from analytics_zoo_tpu.learn.optimizers import Adam
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            return nn.Dense(1)(nn.relu(nn.Dense(16)(x)))
+
+    x = np.random.default_rng(2).standard_normal((70, 4)).astype(np.float32)
+    est = Estimator.from_flax(model=MLP(), loss="mse", optimizer=Adam(1e-2),
+                              sample_input=x[:2])
+    sync = est.predict(x, batch_size=16, pipeline_window=1)
+    for w in (2, 4):
+        piped = est.predict(x, batch_size=16, pipeline_window=w)
+        np.testing.assert_array_equal(sync, piped)   # bitwise
+
+
+# ------------------------------------------------------- engine: behavior
+class _CountingModel:
+    """Duck-typed serving model: counts concurrently in-flight dispatched
+    batches via the predict_async/predict_fetch hooks the engine uses."""
+
+    def __init__(self):
+        self.outstanding = 0
+        self.max_outstanding = 0
+        self.lock = threading.Lock()
+
+    def predict_async(self, x):
+        with self.lock:
+            self.outstanding += 1
+            self.max_outstanding = max(self.max_outstanding,
+                                       self.outstanding)
+        return np.asarray(x)
+
+    def predict_fetch(self, pending):
+        with self.lock:
+            self.outstanding -= 1
+        return pending * 2.0
+
+
+def _serve(model, n, batch_size, **kw):
+    from analytics_zoo_tpu.serving import (
+        Broker, ClusterServing, InputQueue, OutputQueue,
+    )
+    rng = np.random.default_rng(7)
+    xs = {f"u{i}": rng.standard_normal(3).astype(np.float32)
+          for i in range(n)}
+    with Broker.launch() as broker, \
+            ClusterServing(model, broker.port, batch_size=batch_size,
+                           **kw).start() as eng:
+        in_q = InputQueue(port=broker.port)
+        out_q = OutputQueue(port=broker.port)
+        uris = in_q.enqueue_batch((u, {"x": v}) for u, v in xs.items())
+        res = out_q.query_many(uris, timeout=30.0)
+    assert all(v is not None for v in res.values())
+    return xs, res, eng
+
+
+def test_engine_backpressure_bounded_by_window(orca_ctx):
+    """The serve loop keeps dispatch and retrieval decoupled, but never
+    exceeds pipeline_window batches in flight on the model."""
+    model = _CountingModel()
+    xs, res, eng = _serve(model, n=48, batch_size=4, pipeline_window=2,
+                          max_batch_size=4)
+    assert model.max_outstanding <= 2
+    for u, x in xs.items():
+        np.testing.assert_allclose(res[u], x * 2.0, rtol=1e-6)
+    m = eng.metrics()
+    assert m["records_out"] == 48
+    assert "window_depth" in m and m["window_depth"]["p99"] <= 2.0
+
+
+def test_engine_pipelined_matches_sync_results(orca_ctx):
+    im = _flax_im(n_in=3, n_out=2)
+    xs0, res0, _ = _serve(im, n=20, batch_size=4, pipeline_window=0,
+                          max_batch_size=4)
+    xs1, res1, _ = _serve(im, n=20, batch_size=4, pipeline_window=3,
+                          max_batch_size=4)
+    for u in xs0:
+        np.testing.assert_array_equal(res0[u], res1[u])   # bitwise
+
+
+def test_engine_adaptive_batch_growth(orca_ctx):
+    """Sustained backlog (every dequeue full) doubles the batch bucket up
+    to max_batch_size; the growth is visible as the batch_size gauge."""
+    model = _CountingModel()
+    # one pipelined write lands 96 records at once -> dequeues at bucket 2
+    # come back full until the stream drains, far past the
+    # BACKLOG_GROW_AFTER=8 streak
+    xs, res, eng = _serve(model, n=96, batch_size=2, pipeline_window=2,
+                          max_batch_size=8)
+    assert eng.batch_size > 2
+    assert eng.batch_size <= 8
+    m = eng.metrics()
+    assert "batch_size" in m and m["batch_size"]["count"] >= 1
+    for u, x in xs.items():
+        np.testing.assert_allclose(res[u], x * 2.0, rtol=1e-6)
+
+
+def test_engine_growth_pinned_when_capped(orca_ctx):
+    model = _CountingModel()
+    _, _, eng = _serve(model, n=40, batch_size=4, pipeline_window=2,
+                       max_batch_size=4)
+    assert eng.batch_size == 4                  # pinned: cap == initial

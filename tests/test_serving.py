@@ -496,6 +496,7 @@ class TestContainerEntrypoint:
     HTTP frontend from one config.yaml and serves end-to-end (the
     reference's cluster-serving container flow)."""
 
+    @pytest.mark.slow  # ~14s: boots the full container stack in a subprocess
     def test_start_serving_script(self, tmp_path):
         import os
         import signal

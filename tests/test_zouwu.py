@@ -159,6 +159,7 @@ class TestForecasters:
         f.fit(x, y[:, :1], epochs=1, batch_size=16)
         assert f.predict(x).dtype == np.float32
 
+    @pytest.mark.slow  # ~17s: trains MTNet under the bf16 policy
     def test_mtnet_mixed_precision(self):
         """MTNet under mixed_bfloat16: attention-GRU encoders run bf16,
         params stay fp32, forecasts come back fp32, and it still fits."""
@@ -182,6 +183,7 @@ class TestForecasters:
         f.fit(x, y, epochs=2, batch_size=16)
         assert f.predict(x).shape == (len(x), 3)
 
+    @pytest.mark.slow  # ~13s: full MTNet fit/predict cycle
     def test_mtnet_forecaster(self):
         # seq len must be (n+1)*T = (3+1)*4 = 16
         x, y = _xy(n=64, lookback=16, horizon=1)
