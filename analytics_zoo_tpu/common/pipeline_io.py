@@ -34,24 +34,41 @@ from typing import Any, Callable, Dict, Iterable, List, NamedTuple, Optional
 
 import numpy as np
 
+from analytics_zoo_tpu.common import telemetry
+
 
 class StageTimer:
     """Per-stage wall-time stats (ref serving/utils/Timer.scala:26), plus
-    unitless gauges (queue depth, overlap ratio) under ``values``."""
+    unitless gauges (queue depth, overlap ratio) under ``values``.
 
-    def __init__(self):
+    Re-backed onto the process-wide telemetry registry (ISSUE 2): every
+    ``record`` also lands in the ``zoo_stage_seconds`` histogram (labelled
+    by stage) and every ``record_value`` sets the ``zoo_stage_value``
+    gauge, so StageTimer consumers show up in ``GET /metrics`` Prometheus
+    exposition and BENCH snapshots for free. The local lists stay — the
+    exact-percentile ``summary()`` API is unchanged."""
+
+    def __init__(self, registry: Optional[telemetry.MetricsRegistry] = None):
         self._lock = threading.Lock()
         self.stats: Dict[str, List[float]] = {}
         self.values: Dict[str, List[float]] = {}
+        reg = registry if registry is not None else telemetry.get_registry()
+        self._hist = reg.histogram(
+            "zoo_stage_seconds", "Per-stage wall time", ("stage",))
+        self._gauge = reg.gauge(
+            "zoo_stage_value", "Unitless per-stage samples (queue depth, "
+            "overlap ratio, batch bucket)", ("stage",))
 
     def record(self, stage: str, dt: float):
         with self._lock:
             self.stats.setdefault(stage, []).append(dt)
+        self._hist.labels(stage).observe(dt)
 
     def record_value(self, name: str, v: float):
         """A unitless sample (queue depth, ratio) — reported un-scaled."""
         with self._lock:
             self.values.setdefault(name, []).append(float(v))
+        self._gauge.labels(name).set(v)
 
     def summary(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
@@ -71,18 +88,26 @@ class StageTimer:
 class Completed(NamedTuple):
     """One retired batch: host ``result`` (None if the batch failed),
     the caller's ``ctx`` passed at submit, the ``error`` raised by dispatch
-    or fetch (None on success), and timing for stage stats."""
+    or fetch (None on success), and timing for stage stats.
+
+    ``t_submit``/``dispatch_s`` place the batch on the process
+    ``perf_counter`` clock so consumers (the serving engine) can turn the
+    window residency into trace spans: the device span is
+    ``[t_submit, t_submit + inflight_s]`` and the dispatch sub-span is
+    ``[t_submit, t_submit + dispatch_s]``."""
 
     result: Any
     ctx: Any
     error: Optional[BaseException]
     inflight_s: float       # submit → retired (device window residency)
     fetch_s: float          # blocking part of the retirement only
+    t_submit: float = 0.0   # perf_counter at dispatch
+    dispatch_s: float = 0.0  # non-blocking dispatch call duration
 
 
 def _default_fetch(pending):
-    import jax
-    return jax.device_get(pending)
+    # d2h transfer bytes ride the zoo_device_transfer_bytes_total counter
+    return telemetry.traced_device_get(pending)
 
 
 class DevicePipeline:
@@ -137,18 +162,19 @@ class DevicePipeline:
             # a dispatch-time failure rides the window like any other batch
             # so it retires IN ORDER relative to its neighbours
             pending, err = None, e
+        dispatch_s = time.perf_counter() - t0
         if self._timer is not None:
-            self._timer.record(self._prefix + "dispatch",
-                               time.perf_counter() - t0)
+            self._timer.record(self._prefix + "dispatch", dispatch_s)
             self._timer.record_value(self._prefix + "window_depth",
                                      len(self._q) + 1)
-        self._q.append((pending, ctx, t0, err))
+        self._q.append((pending, ctx, t0, err, dispatch_s))
         return done
 
     def _retire(self) -> Completed:
-        pending, ctx, t0, err = self._q.popleft()
+        pending, ctx, t0, err, dispatch_s = self._q.popleft()
         if err is not None:
-            return Completed(None, ctx, err, time.perf_counter() - t0, 0.0)
+            return Completed(None, ctx, err, time.perf_counter() - t0, 0.0,
+                             t0, dispatch_s)
         t_fetch = time.perf_counter()
         try:
             host = self._fetch_fn(pending)
@@ -157,6 +183,8 @@ class DevicePipeline:
             host, err = None, e
         now = time.perf_counter()
         fetch_s, inflight_s = now - t_fetch, now - t0
+        # the blocked fetch is the device half of the device-vs-host split
+        telemetry.observe_device_block(fetch_s, self._prefix + "fetch")
         if self._timer is not None:
             self._timer.record(self._prefix + "fetch", fetch_s)
             # overlap ratio: how much of this batch's window residency the
@@ -165,7 +193,7 @@ class DevicePipeline:
             self._timer.record_value(
                 self._prefix + "overlap_ratio",
                 1.0 - fetch_s / max(inflight_s, 1e-9))
-        return Completed(host, ctx, err, inflight_s, fetch_s)
+        return Completed(host, ctx, err, inflight_s, fetch_s, t0, dispatch_s)
 
     def drain(self, max_n: Optional[int] = None) -> List[Completed]:
         """Retire up to ``max_n`` (default: all) in-flight batches, oldest

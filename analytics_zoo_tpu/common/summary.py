@@ -91,7 +91,7 @@ def _event(step: int, tag: str = None, value: float = None,
            file_version: str = None) -> bytes:
     # Event: wall_time f1 double, step f2 int64, file_version f3 string,
     # summary f5 message; Summary.value = repeated field 1
-    out = _pb_double(1, time.time())
+    out = _pb_double(1, time.time())  # wallclock: ok (event timestamp)
     out += _pb_int64(2, step)
     if file_version is not None:
         out += _pb_string(3, file_version.encode())
@@ -107,15 +107,35 @@ def _record(data: bytes) -> bytes:
             + data + struct.pack("<I", _masked_crc(data)))
 
 
-class SummaryWriter:
-    """Append-only scalar event writer (ref FileWriter.scala / EventWriter)."""
+#: buffered-writer thresholds: whichever trips first forces a flush
+FLUSH_BYTES = 64 * 1024
+FLUSH_EVERY = 128
 
-    def __init__(self, log_dir: str):
+
+class SummaryWriter:
+    """Append-only scalar event writer (ref FileWriter.scala / EventWriter).
+
+    Writes are buffered: events accumulate in memory and hit the file in
+    one syscall when either ``flush_bytes`` or ``flush_every`` (events) is
+    reached, on ``flush()``, or on ``close()`` — the per-record
+    write+flush pair used to dominate small-step training loops.
+    ``close()`` is idempotent and terminal: later ``add_scalar``/``flush``
+    calls are silently dropped (a trailing trigger after fit() closed the
+    writer must not crash training teardown)."""
+
+    def __init__(self, log_dir: str, flush_bytes: int = FLUSH_BYTES,
+                 flush_every: int = FLUSH_EVERY):
         os.makedirs(log_dir, exist_ok=True)
         self.log_dir = log_dir
-        fname = f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}"
+        fname = (f"events.out.tfevents.{int(time.time())}"  # wallclock: ok
+                 f".{socket.gethostname()}")
         self._path = os.path.join(log_dir, fname)
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
+        self._flush_bytes = int(flush_bytes)
+        self._flush_every = int(flush_every)
+        self._buf = bytearray()
+        self._buf_events = 0
+        self._closed = False
         self._fh = open(self._path, "ab")
         self._fh.write(_record(_event(0, file_version="brain.Event:2")))
         self._fh.flush()
@@ -125,18 +145,34 @@ class SummaryWriter:
 
     def add_scalar(self, tag: str, value: float, step: int):
         with self._lock:
-            self._fh.write(_record(_event(step, tag, float(value))))
+            if self._closed:
+                return
+            self._buf += _record(_event(step, tag, float(value)))
+            self._buf_events += 1
             self._scalars.setdefault(tag, []).append((step, float(value)))
+            if (len(self._buf) >= self._flush_bytes
+                    or self._buf_events >= self._flush_every):
+                self._flush_locked()
+
+    def _flush_locked(self):
+        if self._buf:
+            self._fh.write(bytes(self._buf))
+            self._buf.clear()
+            self._buf_events = 0
+        self._fh.flush()
 
     def flush(self):
         with self._lock:
-            self._fh.flush()
+            if not self._closed:
+                self._flush_locked()
 
     def close(self):
         with self._lock:
-            if not self._fh.closed:
-                self._fh.flush()
-                self._fh.close()
+            if self._closed:
+                return
+            self._flush_locked()
+            self._fh.close()
+            self._closed = True
 
     def get_scalar(self, tag: str) -> List[Tuple[int, float]]:
         return list(self._scalars.get(tag, []))

@@ -1,0 +1,665 @@
+"""Unified telemetry — process-wide metrics registry, span tracing, and
+JAX-specific hooks (ISSUE 2 tentpole).
+
+The reference stack ships a full observability surface: a JVM TF-events
+writer for training scalars (SURVEY: tensorboard/FileWriter.scala) and the
+Cluster Serving throughput/latency counters (serving/utils/Timer.scala:26),
+and the BigDL paper (arxiv 1804.05839) leans on exactly those signals to
+diagnose scaling bottlenecks. Our TPU rebuild had fragments — StageTimer
+dicts, ad-hoc timers, JSON-only ``/metrics`` — but no unified registry, no
+request tracing, and zero visibility into JIT recompiles or device-vs-host
+time. This module is the one seam every layer reports through:
+
+- **MetricsRegistry** — thread-safe counters, gauges, and histograms
+  (fixed Prometheus buckets + a bounded quantile reservoir), with
+  text-format exposition (``prometheus_text``) and a JSON-able
+  ``snapshot()``.
+- **Tracer** — span-based tracing with contextvar propagation and a
+  bounded per-trace-id span store. A serving record's uri is its trace id:
+  the FrontEnd HTTP handler, broker enqueue, the engine's
+  dequeue/preprocess/dispatch/device/postprocess stages and the
+  DevicePipeline submit/retire all record spans against it, so one
+  record's end-to-end latency decomposes into stages.
+- **JAX hooks** — ``instrument_jit`` (a jit wrapper that counts cache
+  misses per avals signature: the recompile counter), ``traced_device_put``
+  / ``traced_device_get`` (transfer-byte accounting), and
+  ``observe_device_block`` / ``timed_block_until_ready`` (the fenced
+  device-time vs host-time split).
+
+Everything is stdlib + optional-jax; importing this module never imports
+jax. All metric names carry the ``zoo_`` prefix; the stable catalog lives
+in docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import math
+import os
+import threading
+from collections import OrderedDict
+from contextlib import contextmanager
+from dataclasses import dataclass
+from time import perf_counter
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "Span", "Tracer",
+    "get_registry", "get_tracer", "prometheus_text", "snapshot",
+    "bench_snapshot", "instrument_jit", "traced_device_put",
+    "traced_device_get", "observe_device_block", "timed_block_until_ready",
+    "set_trace_sampling", "reset_for_tests",
+]
+
+# latency-shaped default buckets (seconds): 100µs .. 30s
+DEFAULT_BUCKETS = (1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+RESERVOIR_SIZE = 1024
+
+_NAME_OK = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_:")
+
+
+def _check_name(name: str) -> str:
+    if not name or name[0].isdigit() or not set(name) <= _NAME_OK:
+        raise ValueError(f"bad metric name {name!r}")
+    return name
+
+
+def _escape_label(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace("\n", r"\n").replace(
+        '"', r"\"")
+
+
+def _fmt_value(v: float) -> str:
+    if v != v:
+        return "NaN"
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _label_str(labelnames: Sequence[str], labelvalues: Sequence[str]) -> str:
+    if not labelnames:
+        return ""
+    pairs = ",".join(f'{k}="{_escape_label(v)}"'
+                     for k, v in zip(labelnames, labelvalues))
+    return "{" + pairs + "}"
+
+
+class _Child:
+    """One (metric, label-values) time series."""
+
+    def __init__(self, labelvalues: Tuple[str, ...]):
+        self._lock = threading.Lock()
+        self.labelvalues = labelvalues
+
+
+class Counter(_Child):
+    def __init__(self, labelvalues=()):
+        super().__init__(labelvalues)
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0):
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge(_Child):
+    def __init__(self, labelvalues=()):
+        super().__init__(labelvalues)
+        self._value = 0.0
+
+    def set(self, v: float):
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, amount: float = 1.0):
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0):
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram(_Child):
+    """Counts into fixed buckets + a bounded reservoir for quantiles.
+
+    The reservoir keeps the first ``RESERVOIR_SIZE`` samples then switches
+    to uniform replacement (algorithm R) with a cheap deterministic LCG —
+    no ``random`` module state touched, bounded memory forever."""
+
+    def __init__(self, labelvalues=(), buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(labelvalues)
+        self.buckets = tuple(sorted(buckets))
+        self._bucket_counts = [0] * (len(self.buckets) + 1)  # +Inf last
+        self._count = 0
+        self._sum = 0.0
+        self._reservoir: List[float] = []
+        self._rng = 0x9E3779B9
+
+    def observe(self, v: float):
+        v = float(v)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            i = 0
+            for b in self.buckets:
+                if v <= b:
+                    break
+                i += 1
+            self._bucket_counts[i] += 1
+            if len(self._reservoir) < RESERVOIR_SIZE:
+                self._reservoir.append(v)
+            else:
+                # LCG step (Numerical Recipes constants), then mod count
+                self._rng = (self._rng * 1664525 + 1013904223) & 0xFFFFFFFF
+                j = self._rng % self._count
+                if j < RESERVOIR_SIZE:
+                    self._reservoir[j] = v
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            if not self._reservoir:
+                return float("nan")
+            xs = sorted(self._reservoir)
+        idx = min(len(xs) - 1, max(0, int(math.ceil(q * len(xs))) - 1))
+        return xs[idx]
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def _state(self):
+        with self._lock:
+            return (list(self._bucket_counts), self._count, self._sum,
+                    list(self._reservoir))
+
+
+class _Family:
+    """A named metric plus its per-label-values children."""
+
+    def __init__(self, name: str, kind: str, help_: str,
+                 labelnames: Tuple[str, ...], **kwargs):
+        self.name = _check_name(name)
+        self.kind = kind
+        self.help = help_
+        self.labelnames = labelnames
+        self._kwargs = kwargs
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], _Child] = {}
+        self._cls = {"counter": Counter, "gauge": Gauge,
+                     "histogram": Histogram}[kind]
+
+    def labels(self, *labelvalues, **labelkw):
+        if labelkw:
+            if labelvalues:
+                raise ValueError("pass labels positionally or by name")
+            labelvalues = tuple(labelkw[k] for k in self.labelnames)
+        vals = tuple(str(v) for v in labelvalues)
+        if len(vals) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name} takes labels {self.labelnames}, got {vals}")
+        with self._lock:
+            child = self._children.get(vals)
+            if child is None:
+                child = self._cls(vals, **self._kwargs)
+                self._children[vals] = child
+            return child
+
+    def children(self) -> List[_Child]:
+        with self._lock:
+            return list(self._children.values())
+
+    # unlabelled convenience: family acts as its own single child
+    def _default(self):
+        return self.labels()
+
+    def inc(self, amount: float = 1.0):
+        self._default().inc(amount)
+
+    def dec(self, amount: float = 1.0):
+        self._default().dec(amount)
+
+    def set(self, v: float):
+        self._default().set(v)
+
+    def observe(self, v: float):
+        self._default().observe(v)
+
+    @property
+    def value(self):
+        return self._default().value
+
+    @property
+    def count(self):
+        return self._default().count
+
+    def quantile(self, q: float):
+        return self._default().quantile(q)
+
+
+class MetricsRegistry:
+    """Thread-safe registry of metric families. ``counter``/``gauge``/
+    ``histogram`` are get-or-create (idempotent for a matching kind, error
+    on a kind clash), so any module can grab its series without import-
+    order coupling."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: "OrderedDict[str, _Family]" = OrderedDict()
+
+    def _get(self, name: str, kind: str, help_: str,
+             labelnames: Iterable[str], **kwargs) -> _Family:
+        labelnames = tuple(labelnames)
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if fam.kind != kind or fam.labelnames != labelnames:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{fam.kind}{fam.labelnames}, not "
+                        f"{kind}{labelnames}")
+                return fam
+            fam = _Family(name, kind, help_, labelnames, **kwargs)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Iterable[str] = ()) -> _Family:
+        return self._get(name, "counter", help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Iterable[str] = ()) -> _Family:
+        return self._get(name, "gauge", help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Iterable[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> _Family:
+        return self._get(name, "histogram", help, labelnames,
+                         buckets=buckets)
+
+    def families(self) -> List[_Family]:
+        with self._lock:
+            return list(self._families.values())
+
+    # -------------------------------------------------------- exposition
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format 0.0.4 — one HELP/TYPE block
+        per family, histogram children as cumulative ``le`` buckets plus
+        ``_sum``/``_count``."""
+        lines: List[str] = []
+        for fam in self.families():
+            if fam.help:
+                lines.append(f"# HELP {fam.name} {fam.help}")
+            lines.append(f"# TYPE {fam.name} {fam.kind}")
+            for child in fam.children():
+                label_base = list(zip(fam.labelnames, child.labelvalues))
+                if fam.kind in ("counter", "gauge"):
+                    lines.append(
+                        fam.name
+                        + _label_str([k for k, _ in label_base],
+                                     [v for _, v in label_base])
+                        + " " + _fmt_value(child.value))
+                else:
+                    counts, total, s, _ = child._state()
+                    cum = 0
+                    for b, c in zip(child.buckets, counts):
+                        cum += c
+                        names = [k for k, _ in label_base] + ["le"]
+                        vals = [v for _, v in label_base] + [_fmt_value(b)]
+                        lines.append(f"{fam.name}_bucket"
+                                     + _label_str(names, vals)
+                                     + " " + str(cum))
+                    names = [k for k, _ in label_base] + ["le"]
+                    vals = [v for _, v in label_base] + ["+Inf"]
+                    lines.append(f"{fam.name}_bucket"
+                                 + _label_str(names, vals) + " " + str(total))
+                    ls = _label_str([k for k, _ in label_base],
+                                    [v for _, v in label_base])
+                    lines.append(f"{fam.name}_sum{ls} " + _fmt_value(s))
+                    lines.append(f"{fam.name}_count{ls} " + str(total))
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able view: counters/gauges as values, histograms as
+        {count, sum, mean, p50, p99} — what rides BENCH records and the
+        JSON ``/metrics`` response."""
+        out: Dict[str, Any] = {}
+        for fam in self.families():
+            entries = {}
+            for child in fam.children():
+                key = ",".join(f"{k}={v}" for k, v in
+                               zip(fam.labelnames, child.labelvalues)) or ""
+                if fam.kind in ("counter", "gauge"):
+                    entries[key] = child.value
+                else:
+                    _, total, s, res = child._state()
+                    mean = s / total if total else 0.0
+                    xs = sorted(res)
+
+                    def pq(q):
+                        if not xs:
+                            return None
+                        return xs[min(len(xs) - 1,
+                                      max(0, int(math.ceil(q * len(xs))) - 1))]
+
+                    entries[key] = {"count": total, "sum": s, "mean": mean,
+                                    "p50": pq(0.5), "p99": pq(0.99)}
+            if list(entries) == [""]:
+                out[fam.name] = entries[""]
+            elif entries:
+                out[fam.name] = entries
+        return out
+
+
+# ----------------------------------------------------------------- tracing
+
+@dataclass(frozen=True)
+class Span:
+    """One recorded interval on the process-wide ``perf_counter`` clock."""
+    name: str
+    trace_id: str
+    start: float
+    end: float
+    parent: Optional[str] = None
+
+    @property
+    def duration(self) -> float:
+        return self.end - self.start
+
+
+_current_span: "contextvars.ContextVar[Optional[Tuple[str, str]]]" = \
+    contextvars.ContextVar("zoo_current_span", default=None)
+
+
+class Tracer:
+    """Bounded in-memory span store keyed by trace id.
+
+    Serving uses the record uri as the trace id, so spans recorded by the
+    FrontEnd, the engine, and the DevicePipeline all land on one trace and
+    ``get(uri)`` returns the record's full stage decomposition. The store
+    holds the most recent ``capacity`` trace ids (LRU on insert)."""
+
+    def __init__(self, capacity: int = 1024, sample: float = 1.0):
+        self._lock = threading.Lock()
+        self._traces: "OrderedDict[str, List[Span]]" = OrderedDict()
+        self.capacity = int(capacity)
+        self._sample = float(sample)
+        self._acc = 1.0  # first decision samples (rate > 0)
+
+    # -------------------------------------------------------- sampling
+    def set_sampling(self, rate: float):
+        with self._lock:
+            self._sample = max(0.0, min(1.0, float(rate)))
+            self._acc = self._sample and 1.0
+
+    @property
+    def sampling(self) -> float:
+        return self._sample
+
+    def should_sample(self) -> bool:
+        """Deterministic rate limiter (no RNG): accumulate the rate and
+        fire whenever the accumulator crosses 1 — exactly ``rate`` of
+        calls return True, evenly spread."""
+        with self._lock:
+            if self._sample <= 0.0:
+                return False
+            self._acc += self._sample
+            if self._acc >= 1.0:
+                self._acc -= 1.0
+                return True
+            return False
+
+    # -------------------------------------------------------- recording
+    def record(self, trace_id: str, name: str, start: float, end: float,
+               parent: Optional[str] = None):
+        span = Span(name, trace_id, start, end, parent)
+        with self._lock:
+            spans = self._traces.get(trace_id)
+            if spans is None:
+                while len(self._traces) >= self.capacity:
+                    self._traces.popitem(last=False)
+                spans = []
+                self._traces[trace_id] = spans
+            spans.append(span)
+        return span
+
+    @contextmanager
+    def span(self, name: str, trace_id: Optional[str] = None):
+        """Context-propagating span: nested spans inherit the ambient
+        trace id and get the enclosing span's name as ``parent``."""
+        ambient = _current_span.get()
+        if trace_id is None:
+            if ambient is None:
+                raise ValueError(
+                    "span() without trace_id needs an enclosing span")
+            trace_id = ambient[0]
+        parent = ambient[1] if ambient and ambient[0] == trace_id else None
+        token = _current_span.set((trace_id, name))
+        t0 = perf_counter()
+        try:
+            yield
+        finally:
+            _current_span.reset(token)
+            self.record(trace_id, name, t0, perf_counter(), parent)
+
+    def current_trace_id(self) -> Optional[str]:
+        cur = _current_span.get()
+        return cur[0] if cur else None
+
+    def get(self, trace_id: str) -> List[Span]:
+        with self._lock:
+            return list(self._traces.get(trace_id, ()))
+
+    def clear(self):
+        with self._lock:
+            self._traces.clear()
+
+
+# ------------------------------------------------------------ process-wide
+
+_REGISTRY = MetricsRegistry()
+_TRACER = Tracer(
+    capacity=int(os.environ.get("ZOO_TELEMETRY_TRACES", "1024")),
+    sample=float(os.environ.get("ZOO_TELEMETRY_SAMPLE", "1.0")))
+
+
+def get_registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def get_tracer() -> Tracer:
+    return _TRACER
+
+
+def prometheus_text() -> str:
+    return _REGISTRY.prometheus_text()
+
+
+def snapshot() -> Dict[str, Any]:
+    return _REGISTRY.snapshot()
+
+
+def set_trace_sampling(rate: float):
+    _TRACER.set_sampling(rate)
+
+
+def reset_for_tests():
+    """Swap in a fresh registry/trace store (same objects, cleared state)
+    — test isolation for the process-wide singletons."""
+    global _REGISTRY
+    _REGISTRY = MetricsRegistry()
+    _TRACER.clear()
+    _TRACER.set_sampling(
+        float(os.environ.get("ZOO_TELEMETRY_SAMPLE", "1.0")))
+
+
+def bench_snapshot() -> Dict[str, Any]:
+    """Trimmed snapshot for the one-line BENCH JSON: every counter/gauge,
+    histograms as compact stats, plus the trace-store size — small enough
+    to ride the record, complete enough to reconstruct the perf story."""
+    snap = snapshot()
+    with _TRACER._lock:
+        snap["trace_ids_held"] = len(_TRACER._traces)
+    return snap
+
+
+# ------------------------------------------------------------- JAX hooks
+
+def _leaf_sig(x) -> Tuple:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("arr", tuple(shape), str(dtype))
+    if isinstance(x, (int, float, bool, str, bytes, type(None))):
+        return ("py", type(x).__name__, x)
+    return ("other", type(x).__name__)
+
+
+class _InstrumentedJit:
+    """``jax.jit`` wrapper that counts calls and cache misses.
+
+    The avals signature — pytree structure plus (shape, dtype) of every
+    array leaf and the value of every hashable static leaf — keys a local
+    set; a signature never seen before is a compile (cache miss) and
+    increments ``zoo_jit_cache_misses_total{fn=...}``. Steady-state calls
+    re-use a seen signature and leave the counter flat, so the counter IS
+    the recompile detector the ROADMAP perf PRs read. Signatures are read
+    BEFORE the call, so donated buffers are still valid.
+
+    Delegates everything else (``lower``, ``clear_cache``...) to the
+    underlying jitted callable."""
+
+    def __init__(self, fn, name: str, registry: MetricsRegistry, jit_kwargs):
+        import jax
+        self._jitted = jax.jit(fn, **jit_kwargs)
+        self.name = name
+        self._lock = threading.Lock()
+        self._signatures: set = set()
+        self._calls = registry.counter(
+            "zoo_jit_calls_total", "Calls into instrumented jitted "
+            "functions", ("fn",)).labels(name)
+        self._misses = registry.counter(
+            "zoo_jit_cache_misses_total", "JIT cache misses (compiles + "
+            "recompiles) per avals signature", ("fn",)).labels(name)
+
+    def signature(self, args, kwargs) -> Tuple:
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        return (treedef, tuple(_leaf_sig(leaf) for leaf in leaves))
+
+    @property
+    def cache_misses(self) -> int:
+        return int(self._misses.value)
+
+    def __call__(self, *args, **kwargs):
+        sig = self.signature(args, kwargs)
+        with self._lock:
+            new = sig not in self._signatures
+            if new:
+                self._signatures.add(sig)
+        self._calls.inc()
+        if new:
+            self._misses.inc()
+        return self._jitted(*args, **kwargs)
+
+    def __getattr__(self, item):
+        return getattr(self._jitted, item)
+
+
+def instrument_jit(fn=None, *, name: Optional[str] = None,
+                   registry: Optional[MetricsRegistry] = None,
+                   **jit_kwargs):
+    """Drop-in ``jax.jit`` replacement with recompile accounting. Usable
+    bare (``instrument_jit(f)``) or parameterized
+    (``instrument_jit(name="train_step", donate_argnums=0)(f)``)."""
+    def wrap(f):
+        return _InstrumentedJit(
+            f, name or getattr(f, "__name__", "jit_fn"),
+            registry if registry is not None else get_registry(),
+            jit_kwargs)
+
+    return wrap(fn) if fn is not None else wrap
+
+
+def _tree_nbytes(tree) -> int:
+    import jax
+    return sum(int(getattr(leaf, "nbytes", 0))
+               for leaf in jax.tree_util.tree_leaves(tree))
+
+
+def _transfer_counter(direction: str):
+    return get_registry().counter(
+        "zoo_device_transfer_bytes_total",
+        "Bytes explicitly moved across the host-device boundary",
+        ("direction",)).labels(direction)
+
+
+def _transfer_gauge(direction: str):
+    return get_registry().gauge(
+        "zoo_device_last_transfer_bytes",
+        "Size of the most recent explicit host-device transfer",
+        ("direction",)).labels(direction)
+
+
+def traced_device_put(x, *args, **kwargs):
+    """``jax.device_put`` with h2d byte accounting."""
+    import jax
+    n = _tree_nbytes(x)
+    _transfer_counter("h2d").inc(n)
+    _transfer_gauge("h2d").set(n)
+    return jax.device_put(x, *args, **kwargs)
+
+
+def traced_device_get(x):
+    """``jax.device_get`` with d2h byte accounting (counted from the
+    fetched host arrays, so lazy/deduped device values are billed at what
+    actually crossed)."""
+    import jax
+    out = jax.device_get(x)
+    n = _tree_nbytes(out)
+    _transfer_counter("d2h").inc(n)
+    _transfer_gauge("d2h").set(n)
+    return out
+
+
+def observe_device_block(seconds: float, site: str = ""):
+    """Record time the host spent *blocked* on device results at ``site``
+    — the device half of the device-vs-host split. The host half is
+    whatever wall time the surrounding stage spans carry."""
+    get_registry().histogram(
+        "zoo_device_block_seconds",
+        "Host time blocked in fetch/block_until_ready, by call site",
+        ("site",)).labels(site).observe(seconds)
+
+
+def timed_block_until_ready(x, site: str = ""):
+    """Fence ``x`` and record the blocked time under ``site``."""
+    import jax
+    t0 = perf_counter()
+    out = jax.block_until_ready(x)
+    observe_device_block(perf_counter() - t0, site)
+    return out

@@ -35,6 +35,8 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from analytics_zoo_tpu.common import telemetry
+
 
 def _as_tuple(x):
     return tuple(x) if isinstance(x, (list, tuple)) else (x,)
@@ -228,12 +230,14 @@ class InferenceModel:
         return self
 
     def _install(self, apply_fn, params, n_inputs):
-        import jax
         with self._lock:
             self._apply = apply_fn
             self._params = params
             self._n_inputs = n_inputs
-            self._jitted = jax.jit(apply_fn)
+            # recompile accounting: every new shape bucket shows up in
+            # zoo_jit_cache_misses_total{fn="inference_model"}
+            self._jitted = telemetry.instrument_jit(
+                apply_fn, name="inference_model")
 
     # ------------------------------------------------------------- predict
     def _snapshot(self):
@@ -344,8 +348,7 @@ class InferenceModel:
 
     def predict_fetch(self, pending):
         """Blocking host side of ``predict_async``."""
-        import jax
-        return jax.device_get(pending)
+        return telemetry.traced_device_get(pending)
 
     def predict_classes(self, x, batch_size: Optional[int] = None,
                         zero_based_label: bool = True) -> np.ndarray:

@@ -42,7 +42,8 @@ def save_checkpoint(ckpt_dir: str, state: Any, iteration: int, epoch: int,
     with open(os.path.join(tmp, "state.msgpack"), "wb") as fh:
         fh.write(serialization.to_bytes(_to_host(state)))
     with open(os.path.join(tmp, "meta.json"), "w") as fh:
-        json.dump({"iteration": iteration, "epoch": epoch, "time": time.time()}, fh)
+        json.dump({"iteration": iteration, "epoch": epoch,
+                   "time": time.time()}, fh)  # wallclock: ok (metadata)
     if os.path.exists(path):
         shutil.rmtree(path)
     os.replace(tmp, path)

@@ -34,6 +34,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from analytics_zoo_tpu.common import telemetry
 from analytics_zoo_tpu.data.dataset import ShardedDataset, to_sharded_dataset
 from analytics_zoo_tpu.data.shard import HostXShards, XShards
 from analytics_zoo_tpu.learn import checkpoint as ckpt_lib
@@ -506,7 +507,12 @@ class JaxEstimator:
                          "model_state": new_mut}
             return new_state, {"loss": loss_val.astype(jnp.float32)}
 
-        self._train_step = jax.jit(step_fn, donate_argnums=0)
+        # instrument_jit = jax.jit + recompile accounting: the
+        # zoo_jit_cache_misses_total{fn=...} counter stays flat across
+        # steady-state steps and increments exactly when the avals
+        # signature changes (new batch bucket, dtype drift)
+        self._train_step = telemetry.instrument_jit(
+            step_fn, name="estimator_train_step", donate_argnums=0)
 
         def scan_fn(state, batches):
             # K steps in ONE dispatch: for small models per-step launch
@@ -520,7 +526,8 @@ class JaxEstimator:
             state, losses = jax.lax.scan(body, state, batches)
             return state, losses
 
-        self._train_scan = jax.jit(scan_fn, donate_argnums=0)
+        self._train_scan = telemetry.instrument_jit(
+            scan_fn, name="estimator_train_scan", donate_argnums=0)
 
         def epoch_fn(state, x_full, y_full, key, bs, do_shuffle):
             # HBM-cached epoch: the WHOLE dataset is device-resident, the
@@ -544,8 +551,9 @@ class JaxEstimator:
             state, losses = jax.lax.scan(body, state, idx)
             return state, losses
 
-        self._train_epoch_cached = jax.jit(
-            epoch_fn, donate_argnums=0, static_argnums=(4, 5))
+        self._train_epoch_cached = telemetry.instrument_jit(
+            epoch_fn, name="estimator_epoch_cached", donate_argnums=0,
+            static_argnums=(4, 5))
 
     def _build_eval_step(self):
         import jax
@@ -580,7 +588,8 @@ class JaxEstimator:
                                      x, False, None)
             return preds
 
-        self._predict_fn = jax.jit(pred_fn)
+        self._predict_fn = telemetry.instrument_jit(
+            pred_fn, name="estimator_predict")
 
     # ------------- public API --------------------------------------------
     def fit(self, data, epochs: int = 1, batch_size: int = 32,
@@ -700,6 +709,42 @@ class JaxEstimator:
     def _iteration(self) -> int:
         return int(np.asarray(self._state["step"]))
 
+    def _current_lr(self, step: int) -> Optional[float]:
+        """Best-effort current learning rate: the optimizer wrappers carry
+        ``lr`` (+ optional ``schedule``); optax schedules are callables of
+        the step. None when the optimizer doesn't expose one (raw optax
+        transforms)."""
+        from analytics_zoo_tpu.learn.optimizers import _lr as resolve_lr
+        opt = self.optimizer
+        base = getattr(opt, "lr", None)
+        if base is None:
+            return None
+        try:
+            val = resolve_lr(base, getattr(opt, "schedule", None))
+            return float(val(step)) if callable(val) else float(val)
+        except Exception:
+            return None
+
+    def _mirror_train_scalars(self, writer, step: int, loss: float,
+                              throughput: float, step_seconds: float):
+        """One window's training scalars go BOTH ways: TF-events (the
+        existing TensorBoard surface) and the telemetry registry (the
+        Prometheus/BENCH surface) — same numbers, one call site."""
+        reg = telemetry.get_registry()
+        reg.gauge("zoo_training_loss",
+                  "Last flushed training loss").set(loss)
+        reg.gauge("zoo_training_throughput_samples_per_sec",
+                  "Training throughput over the last summary window"
+                  ).set(throughput)
+        reg.histogram("zoo_training_step_seconds",
+                      "Mean per-step wall time per summary window"
+                      ).observe(step_seconds)
+        lr = self._current_lr(step)
+        if lr is not None:
+            writer.add_scalar("LearningRate", lr, step)
+            reg.gauge("zoo_training_learning_rate",
+                      "Learning rate at the last flushed step").set(lr)
+
     def _run_epoch_cached(self, ds, mesh, batch_size, shuffle,
                           writer) -> float:
         """One fused on-device epoch over the HBM-resident dataset."""
@@ -723,24 +768,31 @@ class JaxEstimator:
         # freed dataset's address and silently train on stale device data
         if getattr(self, "_cached_ds", None) is not ds:
             repl = NamedSharding(mesh, P())
-            self._cached_x = jax.device_put(ds.x, repl)
-            self._cached_y = jax.device_put(ds.y, repl)
+            self._cached_x = telemetry.traced_device_put(ds.x, repl)
+            self._cached_y = telemetry.traced_device_put(ds.y, repl)
             self._cached_ds = ds
         key = jax.random.fold_in(self._base_rng, 977 + self._epoch)
         n_steps = ds.n // batch_size
         if n_steps < 1:
             raise ValueError(f"batch_size {batch_size} > dataset {ds.n}")
-        t0 = time.time()
+        t0 = time.perf_counter()
         self._state, losses = self._train_epoch_cached(
             self._state, self._cached_x, self._cached_y, key,
             int(batch_size), bool(shuffle))
-        losses = np.asarray(jax.device_get(losses), np.float64)
-        dt = time.time() - t0
+        t_fetch = time.perf_counter()
+        losses = np.asarray(telemetry.traced_device_get(losses), np.float64)
+        dt = time.perf_counter() - t0
+        # the fetch is the only host-blocked part of the fused epoch —
+        # everything before it is one async dispatch
+        telemetry.observe_device_block(time.perf_counter() - t_fetch,
+                                       "train_epoch_cached")
         self._py_step += n_steps
+        throughput = n_steps * batch_size / max(dt, 1e-9)
         writer.add_scalar("Loss", float(losses[-1]), self._py_step)
-        writer.add_scalar("Throughput",
-                          n_steps * batch_size / max(dt, 1e-9),
-                          self._py_step)
+        writer.add_scalar("Throughput", throughput, self._py_step)
+        self._mirror_train_scalars(writer, self._py_step,
+                                   float(losses[-1]), throughput,
+                                   dt / max(n_steps, 1))
         logger.info("cached epoch %d: %d steps in %.3fs (%.0f samples/s)",
                     self._epoch, n_steps, dt,
                     n_steps * batch_size / max(dt, 1e-9))
@@ -759,26 +811,31 @@ class JaxEstimator:
         losses: List[Any] = []
         pending: List[Any] = []
         pending_steps = 0
-        t_epoch = time.time()
+        t_epoch = time.perf_counter()
         samples = 0
-        t_window = time.time()
+        t_window = time.perf_counter()
 
         def flush_window():
             # one host sync per window: fetch the buffered device scalars
             nonlocal pending, pending_steps, t_window
             if not pending:
                 return
+            t_fetch = time.perf_counter()
             vals = list(np.concatenate(
-                [np.atleast_1d(np.asarray(v)) for v in jax.device_get(pending)]
+                [np.atleast_1d(np.asarray(v))
+                 for v in telemetry.traced_device_get(pending)]
             ).astype(float))
+            telemetry.observe_device_block(
+                time.perf_counter() - t_fetch, "train_flush")
             losses.extend(vals)
             step = self._py_step
             writer.add_scalar("Loss", vals[-1], step)
-            dt = time.time() - t_window
-            writer.add_scalar("Throughput",
-                              pending_steps * batch_size / max(dt, 1e-9),
-                              step)
-            t_window = time.time()
+            dt = time.perf_counter() - t_window
+            throughput = pending_steps * batch_size / max(dt, 1e-9)
+            writer.add_scalar("Throughput", throughput, step)
+            self._mirror_train_scalars(writer, step, vals[-1], throughput,
+                                       dt / max(pending_steps, 1))
+            t_window = time.perf_counter()
             pending = []
             pending_steps = 0
 
@@ -819,7 +876,7 @@ class JaxEstimator:
                 pending.append(logs["loss"])
                 after_steps(1)
         flush_window()
-        dt = time.time() - t_epoch
+        dt = time.perf_counter() - t_epoch
         logger.info("epoch %d: %d samples in %.2fs (%.0f samples/s)",
                     self._epoch, samples, dt, samples / max(dt, 1e-9))
         return float(np.mean(losses)) if losses else float("nan")

@@ -1,0 +1,60 @@
+"""Thin observability helpers over :mod:`analytics_zoo_tpu.common.telemetry`.
+
+One import surface for operators and notebooks::
+
+    from analytics_zoo_tpu import observability as obs
+    obs.scrape()            # Prometheus text exposition of everything
+    obs.metrics()           # JSON-able snapshot (counters/gauges/hist stats)
+    obs.trace("my-uri")     # a served record's stage decomposition
+    obs.trace_table("uri")  # ... pretty-printed
+
+The serving FrontEnd exposes the same data over HTTP (``GET /metrics``
+content-negotiated JSON/Prometheus, ``GET /healthz``); see
+docs/observability.md for the stable metric catalog.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from analytics_zoo_tpu.common.telemetry import (  # noqa: F401  (re-exports)
+    MetricsRegistry, Span, Tracer, bench_snapshot, get_registry, get_tracer,
+    instrument_jit, observe_device_block, prometheus_text, set_trace_sampling,
+    snapshot, timed_block_until_ready, traced_device_get, traced_device_put,
+)
+
+__all__ = [
+    "scrape", "metrics", "trace", "trace_table", "get_registry",
+    "get_tracer", "instrument_jit", "set_trace_sampling", "bench_snapshot",
+    "prometheus_text", "snapshot", "traced_device_put", "traced_device_get",
+    "observe_device_block", "timed_block_until_ready",
+]
+
+
+def scrape() -> str:
+    """Prometheus text exposition of the process-wide registry."""
+    return prometheus_text()
+
+
+def metrics() -> Dict:
+    """JSON-able snapshot of the process-wide registry."""
+    return snapshot()
+
+
+def trace(trace_id: str) -> List[Span]:
+    """All spans recorded for ``trace_id`` (a serving record's uri)."""
+    return get_tracer().get(trace_id)
+
+
+def trace_table(trace_id: str) -> str:
+    """The trace as an aligned text table (offsets relative to the first
+    span's start, durations in ms) — the quick-look CLI view."""
+    spans = sorted(trace(trace_id), key=lambda s: s.start)
+    if not spans:
+        return f"(no trace for {trace_id!r})"
+    t0 = spans[0].start
+    rows = [f"{'span':<16} {'start_ms':>10} {'dur_ms':>10}  parent"]
+    for s in spans:
+        rows.append(f"{s.name:<16} {(s.start - t0) * 1e3:>10.3f} "
+                    f"{s.duration * 1e3:>10.3f}  {s.parent or '-'}")
+    return "\n".join(rows)

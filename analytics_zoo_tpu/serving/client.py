@@ -121,14 +121,16 @@ class OutputQueue:
         (the reference client polls the Redis hash the same way).
         ``delete=True`` removes the entry once fetched — one-shot consumers
         (the HTTP frontend) use it so the result hash stays bounded."""
-        deadline = time.time() + timeout
+        # monotonic clock: a wall-clock step (NTP) must not stretch or
+        # collapse the polling deadline
+        deadline = time.monotonic() + timeout
         while True:
             val = self._client.hget(self.result_key, uri)
             if val is not None:
                 if delete:
                     self._client.hdel(self.result_key, uri)
                 return schema.decode_result(val, self.cipher)
-            if time.time() >= deadline:
+            if time.monotonic() >= deadline:
                 return None
             time.sleep(poll_interval)
 
@@ -141,7 +143,7 @@ class OutputQueue:
         deadline."""
         pending = list(dict.fromkeys(uris))
         out: Dict[str, Optional[np.ndarray]] = {u: None for u in pending}
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         while pending:
             vals = self._client.pipeline(
                 ("HGET", self.result_key, u) for u in pending)
@@ -152,7 +154,7 @@ class OutputQueue:
                 self._client.pipeline(
                     ("HDEL", self.result_key, u) for u, _ in hits)
             pending = [u for u in pending if out[u] is None]
-            if not pending or time.time() >= deadline:
+            if not pending or time.monotonic() >= deadline:
                 break
             time.sleep(poll_interval)
         return out

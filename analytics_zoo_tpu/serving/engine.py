@@ -33,6 +33,7 @@ import numpy as np
 
 # StageTimer moved to the shared pipeline layer; re-exported here because
 # the engine is its historical home.
+from analytics_zoo_tpu.common import telemetry
 from analytics_zoo_tpu.common.pipeline_io import (  # noqa: F401
     Completed,
     DevicePipeline,
@@ -131,6 +132,23 @@ class ClusterServing:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.records_out = 0
+        # process-wide telemetry: the registry counters feed the Prometheus
+        # /metrics exposition; traces are keyed by record uri so one
+        # record's latency decomposes into engine stages (sampled per
+        # batch at the tracer's rate, default ZOO_TELEMETRY_SAMPLE=1.0)
+        self._tracer = telemetry.get_tracer()
+        reg = telemetry.get_registry()
+        self._rec_counter = reg.counter(
+            "zoo_serving_records_total",
+            "Records with a flushed result", ("stream",)).labels(stream)
+        self._err_counter = reg.counter(
+            "zoo_serving_record_errors_total",
+            "Records that got an error result", ("stream",)).labels(stream)
+        self._batch_gauge = reg.gauge(
+            "zoo_serving_batch_bucket",
+            "Current adaptive compile-bucket batch size",
+            ("stream",)).labels(stream)
+        self._batch_gauge.set(self.batch_size)
 
     def _decode_images(self, inputs):
         """Decode any raw-image entries and run the preprocessing chain
@@ -156,15 +174,18 @@ class ClusterServing:
         """Host stage: dequeue + decode + preprocess + stack/pad ONE batch.
         Returns ``(x, ctx)`` ready for dispatch, or None when nothing
         servable arrived (per-record errors are flushed here)."""
-        t0 = time.time()
+        t_dq0 = time.perf_counter()
         # recover entries a dead/crashed consumer never acked (ref: the
         # Redis-streams recovery path the reference LACKS an analog of —
         # XPENDING counts them but they were lost forever; here XCLAIM
         # re-delivers once they have been idle claim_min_idle_ms).
         # Rate-limited: recovery polling must not tax the hot read loop.
+        # All stage timing is on the monotonic perf_counter clock — wall-
+        # clock stamps let NTP slew corrupt stage stats AND the claim-
+        # interval rate limiter.
         entries = []
-        if time.time() - self._last_claim >= self._claim_interval_s:
-            self._last_claim = time.time()
+        if t_dq0 - self._last_claim >= self._claim_interval_s:
+            self._last_claim = t_dq0
             entries = client.xclaim(self.stream, self.group, self.consumer,
                                     self.claim_min_idle_ms, self.batch_size)
         if not entries:
@@ -174,10 +195,11 @@ class ClusterServing:
         if not entries:
             self._full_streak = 0
             return None
-        self.timer.record("dequeue", time.time() - t0)
+        t_dq1 = time.perf_counter()
+        self.timer.record("dequeue", t_dq1 - t_dq0)
         self._grow_batch_on_backlog(len(entries))
 
-        t0 = time.time()
+        t0 = time.perf_counter()
         # per-record error HSETs accumulate here and ride the same
         # pipelined flush as the batch results — per-record round-trips
         # dominated host time at large batch sizes. Every exit path below
@@ -231,6 +253,8 @@ class ClusterServing:
                             self.cipher)))
             uris, rows = kept_uris, kept
         if not rows:
+            if err_cmds:
+                self._err_counter.inc(len(err_cmds))
             client.pipeline(err_cmds + ack_cmds)
             return None
         cols = self.input_cols or sorted(rows[0].keys())
@@ -240,9 +264,15 @@ class ClusterServing:
             batch = [np.concatenate(
                 [b, np.repeat(b[-1:], self.batch_size - n, axis=0)])
                 for b in batch]
-        self.timer.record("preprocess", time.time() - t0)
+        t_pp1 = time.perf_counter()
+        self.timer.record("preprocess", t_pp1 - t0)
         x = batch[0] if len(batch) == 1 else tuple(batch)
-        return x, (uris, err_cmds, ack_cmds, n)
+        # trace=(dequeue start/end, preprocess start/end) when this batch
+        # is sampled — _finish turns the stamps plus the Completed's
+        # dispatch/device timing into per-uri spans
+        trace = (t_dq0, t_dq1, t0, t_pp1) \
+            if self._tracer.should_sample() else None
+        return x, (uris, err_cmds, ack_cmds, n, trace)
 
     def _grow_batch_on_backlog(self, dequeued: int):
         """Adaptive batch growth: every dequeue coming back full means the
@@ -257,6 +287,7 @@ class ClusterServing:
             self.batch_size = min(2 * self.batch_size, self.max_batch_size)
             self._full_streak = 0
             self.timer.record_value("batch_size", self.batch_size)
+            self._batch_gauge.set(self.batch_size)
             logger.info("sustained backlog: batch bucket grown to %d",
                         self.batch_size)
 
@@ -274,7 +305,9 @@ class ClusterServing:
     def _finish(self, client: BrokerClient, comp: Completed) -> int:
         """Drain stage: postprocess + result/ack flush for one retired
         batch."""
-        uris, err_cmds, ack_cmds, n = comp.ctx
+        uris, err_cmds, ack_cmds, n, trace = comp.ctx
+        if err_cmds:
+            self._err_counter.inc(len(err_cmds))
         if comp.error is not None:
             # model incompatibility: every record gets an error result and
             # the entries are acked — losing them silently would hang the
@@ -288,10 +321,11 @@ class ClusterServing:
                 + [("HSET", self.result_key, uri, err) for uri in uris]
                 + ack_cmds)
             self.timer.record("inference_error", comp.inflight_s)
+            self._err_counter.inc(n)
             return 0
         self.timer.record("inference", comp.inflight_s)
         preds = np.asarray(comp.result)[:n]
-        t0 = time.time()
+        t0 = time.perf_counter()
         cmds = list(err_cmds)
         for uri, pred in zip(uris, preds):
             # a postprocess/encode failure on ONE record must not discard
@@ -310,10 +344,35 @@ class ClusterServing:
         # polling clients before it answers the pipelined write, so a
         # client that sees its result and immediately reads /metrics must
         # find the batch already counted
-        self.timer.record("postprocess", time.time() - t0)
+        t_pp_end = time.perf_counter()
+        self.timer.record("postprocess", t_pp_end - t0)
         self.records_out += n
+        self._rec_counter.inc(n)
+        if trace is not None:
+            self._record_batch_trace(uris, trace, comp, t0, t_pp_end)
         client.pipeline(cmds + ack_cmds)
         return n
+
+    def _record_batch_trace(self, uris, trace, comp: Completed,
+                            t_post0: float, t_post1: float):
+        """Turn the sampled batch's stage stamps into per-uri spans. The
+        record's uri is the trace id, so ``observability.trace(uri)`` (or a
+        frontend caller that kept its uri) gets the full decomposition:
+        ``serve`` (root, dequeue start → postprocess end) over contiguous
+        ``dequeue``/``preprocess``/``device``/``postprocess`` children,
+        with ``dispatch`` a sub-span of ``device``. Batch-level stages are
+        shared verbatim by every uri in the batch."""
+        t_dq0, t_dq1, t_pp0, t_pp1 = trace
+        tr = self._tracer
+        for uri in uris:
+            tr.record(uri, "dequeue", t_dq0, t_dq1, parent="serve")
+            tr.record(uri, "preprocess", t_pp0, t_pp1, parent="serve")
+            tr.record(uri, "dispatch", comp.t_submit,
+                      comp.t_submit + comp.dispatch_s, parent="device")
+            tr.record(uri, "device", comp.t_submit,
+                      comp.t_submit + comp.inflight_s, parent="serve")
+            tr.record(uri, "postprocess", t_post0, t_post1, parent="serve")
+            tr.record(uri, "serve", t_dq0, t_post1)
 
     def _serve_once(self, client: BrokerClient,
                     pipe: Optional[DevicePipeline] = None) -> int:

@@ -6,7 +6,16 @@ enqueues to Redis and awaits the result). Endpoints:
 
 - ``POST /predict``  body = JSON ``{"inputs": {name: {dtype, shape, data}}}``
   (schema.py tensor encoding) → ``{"uri", "result": tensor}``
-- ``GET  /metrics``  → engine metrics JSON
+- ``GET  /metrics``  → engine metrics JSON by default; Prometheus text
+  exposition (format 0.0.4) when the request asks for it — ``Accept:``
+  containing ``text/plain`` or ``openmetrics``, or ``?format=prometheus``.
+  The Prometheus view is the process-wide telemetry registry, so engine
+  counters, stage histograms, JIT/transfer metrics and frontend request
+  counters all scrape from one endpoint.
+- ``GET  /healthz``  → readiness JSON: broker reachability, input queue
+  depth, consumer-group backlog. 503 when the broker is unreachable or
+  the queue depth exceeds ``max_backlog`` — load balancers use this to
+  stop routing to a drowning replica.
 - ``GET  /``         → liveness
 
 stdlib ``ThreadingHTTPServer`` — no framework dependency; each request
@@ -17,38 +26,109 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from analytics_zoo_tpu.common import telemetry
 from analytics_zoo_tpu.serving import schema
-from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.broker import BrokerClient
+from analytics_zoo_tpu.serving.client import (INPUT_STREAM, InputQueue,
+                                              OutputQueue)
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet
         pass
 
-    def _json(self, code: int, obj):
+    def _count(self, path: str, code: int):
+        self.server.http_counter.labels(  # type: ignore[attr-defined]
+            path, str(code)).inc()
+
+    def _json(self, code: int, obj, path: str = ""):
         body = json.dumps(obj).encode()
+        self._count(path or self.path, code)
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
 
-    def do_GET(self):
-        srv = self.server  # type: ignore[assignment]
-        if self.path == "/metrics":
-            engine = srv.engine
-            self._json(200, engine.metrics() if engine else {})
-        else:
-            self._json(200, {"status": "ok"})
+    def _text(self, code: int, text: str, content_type: str):
+        body = text.encode("utf-8")
+        self._count(self.path.split("?", 1)[0], code)
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
+    # ----------------------------------------------------------------- GET
+    def _wants_prometheus(self) -> bool:
+        if "format=prometheus" in self.path:
+            return True
+        accept = (self.headers.get("Accept") or "").lower()
+        return "text/plain" in accept or "openmetrics" in accept
+
+    def _metrics(self):
+        if self._wants_prometheus():
+            self._text(200, telemetry.prometheus_text(),
+                       PROMETHEUS_CONTENT_TYPE)
+            return
+        engine = self.server.engine  # type: ignore[attr-defined]
+        self._json(200, engine.metrics() if engine else {},
+                   path="/metrics")
+
+    def _healthz(self):
+        srv = self.server  # type: ignore[assignment]
+        engine = srv.engine
+        stream = engine.stream if engine else INPUT_STREAM
+        group = engine.group if engine else "serving"
+        out = {"status": "ok", "broker": "up",
+               "queue_depth": 0, "backlog": 0,
+               "engine": bool(engine and engine._thread is not None)}
+        code = 200
+        client = None
+        try:
+            client = BrokerClient(host=srv.broker_host,
+                                  port=srv.broker_port)
+            out["queue_depth"] = client.xlen(stream)
+            try:
+                out["backlog"] = client.xpending(stream, group)
+            except Exception:
+                # group not created yet (no engine started): not an error
+                out["backlog"] = 0
+        except (ConnectionError, OSError) as e:
+            out.update(status="unavailable", broker=f"down: {e}")
+            code = 503
+        finally:
+            if client is not None:
+                client.close()
+        if code == 200 and out["queue_depth"] > srv.max_backlog:
+            out["status"] = "overloaded"
+            code = 503
+        self._json(code, out, path="/healthz")
+
+    def do_GET(self):
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            self._metrics()
+        elif path == "/healthz":
+            self._healthz()
+        else:
+            self._json(200, {"status": "ok"}, path=path)
+
+    # ---------------------------------------------------------------- POST
     def do_POST(self):
         srv = self.server  # type: ignore[assignment]
         if self.path != "/predict":
             self._json(404, {"error": "unknown path"})
             return
+        tracer = telemetry.get_tracer()
+        sampled = tracer.should_sample()
+        t_req0 = time.perf_counter()
         in_q = out_q = None
         try:
             n = int(self.headers.get("Content-Length", 0))
@@ -57,7 +137,9 @@ class _Handler(BaseHTTPRequestHandler):
                       for k, v in payload["inputs"].items()}
             in_q = InputQueue(host=srv.broker_host,
                               port=srv.broker_port, cipher=srv.cipher)
+            t_enq0 = time.perf_counter()
             uri = in_q.enqueue(payload.get("uri"), **inputs)
+            t_enq1 = time.perf_counter()
         except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
             self._json(400, {"error": f"bad request: {e}"})
             return
@@ -67,13 +149,25 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             out_q = OutputQueue(host=srv.broker_host,
                                 port=srv.broker_port, cipher=srv.cipher)
+            t_wait0 = time.perf_counter()
             result = out_q.query(uri, timeout=srv.timeout_s, delete=True)
+            t_wait1 = time.perf_counter()
         except schema.ServingError as e:
             self._json(422, {"uri": uri, "error": str(e)})
             return
         finally:
             if out_q is not None:
                 out_q.close()
+        if sampled:
+            # the record's uri keys the trace, so these HTTP-side spans
+            # land in the same trace as the engine's stage spans — the
+            # "wait" span brackets the engine's whole "serve" span plus
+            # both broker hops
+            tracer.record(uri, "enqueue", t_enq0, t_enq1,
+                          parent="http_predict")
+            tracer.record(uri, "wait", t_wait0, t_wait1,
+                          parent="http_predict")
+            tracer.record(uri, "http_predict", t_req0, time.perf_counter())
         if result is None:
             self._json(504, {"uri": uri, "error": "timed out"})
         else:
@@ -87,7 +181,8 @@ class FrontEnd:
     def __init__(self, broker_port: int, engine=None, port: int = 0,
                  timeout: float = 30.0, cipher: schema.Cipher = None,
                  host: str = "127.0.0.1",
-                 broker_host: str = "127.0.0.1"):
+                 broker_host: str = "127.0.0.1",
+                 max_backlog: int = 10000):
         # host="0.0.0.0" for containers (the EXPOSEd port must bind
         # beyond loopback to be reachable through docker port mapping)
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
@@ -96,6 +191,12 @@ class FrontEnd:
         self._httpd.engine = engine                 # type: ignore[attr-defined]
         self._httpd.timeout_s = timeout             # type: ignore[attr-defined]
         self._httpd.cipher = cipher                 # type: ignore[attr-defined]
+        # /healthz flips to 503 "overloaded" past this input-queue depth
+        self._httpd.max_backlog = int(max_backlog)  # type: ignore[attr-defined]
+        self._httpd.http_counter = (                # type: ignore[attr-defined]
+            telemetry.get_registry().counter(
+                "zoo_http_requests_total", "Frontend HTTP requests",
+                ("path", "code")))
         # BaseHTTPRequestHandler reads .timeout off the server for socket
         # timeouts; keep our own name distinct
         self._httpd.timeout = None                  # type: ignore[attr-defined]
@@ -103,13 +204,22 @@ class FrontEnd:
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "FrontEnd":
+        # idempotent (like ClusterServing.start): ``with FrontEnd().start()``
+        # calls start twice; a second serve_forever loop on the same socket
+        # races the first into a blocking accept() that shutdown() cannot
+        # reach, leaking the thread past stop()
+        if self._thread is not None:
+            return self
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
         return self
 
     def stop(self):
-        self._httpd.shutdown()
+        t, self._thread = self._thread, None
+        if t is not None:
+            self._httpd.shutdown()
+            t.join(timeout=5)
         self._httpd.server_close()
 
     def __enter__(self):

@@ -730,6 +730,15 @@ def _assemble_record(out: dict, parts, current: dict | None = None) -> dict:
             out.update(part())
         except Exception as e:
             out[part.__name__ + "_error"] = repr(e)[:200]
+    # the record is self-describing: every counter/gauge/histogram the run
+    # touched (JIT recompiles, transfer bytes, stage times, serving
+    # counters) rides along, so a perf regression can be read off the
+    # BENCH line without rerunning
+    try:
+        from analytics_zoo_tpu.common import telemetry
+        out["telemetry"] = telemetry.bench_snapshot()
+    except Exception as e:
+        out["telemetry_error"] = repr(e)[:120]
     if current is not None:
         current["part"] = "done"
     return out
@@ -841,7 +850,31 @@ def _device_watchdog(timeout_s: float = 180.0):
         _emit_cpu_fallback_and_exit(note)
 
 
+def _smoke():
+    """--smoke: tiny CPU-safe end-to-end pass (NCF + serving) that prints
+    the same one-line JSON shape, telemetry snapshot included — the tier-1
+    smoke test asserts on it without paying the full bench."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    global N_ROWS, BATCH, WARMUP_STEPS, MEASURE_STEPS, STEPS_PER_LOOP
+    global SERVE_N, SERVE_BATCH, SERVE_HIDDEN, SERVE_WINDOW, SERVE_REPS
+    N_ROWS, BATCH = 2048, 256
+    WARMUP_STEPS, MEASURE_STEPS, STEPS_PER_LOOP = 2, 4, 2
+    SERVE_N, SERVE_BATCH, SERVE_HIDDEN = 64, 8, 32
+    SERVE_WINDOW, SERVE_REPS = 2, 1
+    out = {
+        "metric": "ncf_train_samples_per_sec",
+        "value": 0.0, "unit": "samples/s", "vs_baseline": 0.0,
+        "mode": "smoke",
+        "device": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(_assemble_record(out, (measure_serving,))))
+
+
 def main():
+    if "--smoke" in sys.argv:
+        _smoke()
+        return
     if "--cpu-emit" in sys.argv:
         _cpu_emit()
         return

@@ -15,11 +15,34 @@ lane="${1:-all}"
 
 run() { echo "== pytest $*"; python -m pytest -q "$@"; }
 
+# Grep lint: no wall-clock timing in the serving/common/learn hot paths —
+# time.time() there corrupts stage stats and deadlines under NTP slew
+# (use time.perf_counter()/time.monotonic()). Legitimate wall-clock uses
+# (event timestamps, filenames, checkpoint metadata) carry a
+# "wallclock: ok" marker on the same line.
+lint_wallclock() {
+  echo "== lint: time.time() in hot paths"
+  local hits
+  hits=$(grep -rnE 'time\.time\(\)' \
+           analytics_zoo_tpu/serving analytics_zoo_tpu/common \
+           analytics_zoo_tpu/learn --include='*.py' \
+         | grep -v 'wallclock: ok' || true)
+  if [[ -n "$hits" ]]; then
+    echo "$hits"
+    echo "lint: time.time() found in hot paths (use time.perf_counter()" \
+         "or time.monotonic(); mark legitimate wall-clock uses with" \
+         "'# wallclock: ok')" >&2
+    exit 1
+  fi
+}
+
 case "$lane" in
+  lint)     lint_wallclock ;;
   # fast cross-subsystem sweep for the edit loop: serving end-to-end,
   # the dispatch pipeline, estimator, inference + quantize, attention
   # ops — everything marked slow stays out
-  smoke)    run -m "not slow" tests/test_pipeline_io.py \
+  smoke)    lint_wallclock
+            run -m "not slow" tests/test_pipeline_io.py \
                 tests/test_serving.py tests/test_inference_net.py \
                 tests/test_estimator.py tests/test_attention.py ;;
   core)     run tests/test_context.py tests/test_estimator.py \
@@ -43,6 +66,7 @@ case "$lane" in
                 tests/test_openvino.py ;;
   examples) run tests/test_examples.py ;;
   release)  bash "$(dirname "$0")/release.sh" ;;
-  all)      run tests/ ;;
+  all)      lint_wallclock
+            run tests/ ;;
   *) echo "unknown lane: $lane" >&2; exit 2 ;;
 esac

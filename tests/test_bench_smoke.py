@@ -174,6 +174,61 @@ def test_run_with_deadline_emits_partial_on_stall(tiny_bench, monkeypatch,
     assert "stall" in rec["error"]
 
 
+def test_smoke_mode_embeds_telemetry_snapshot(tiny_bench, monkeypatch,
+                                              capsys):
+    """``bench.py --smoke`` must print the one-line JSON record with the
+    telemetry snapshot riding along (ISSUE 2: the BENCH line is
+    self-describing — recompiles, transfer bytes, stage times)."""
+    from analytics_zoo_tpu.common import telemetry
+
+    bench = tiny_bench
+    telemetry.reset_for_tests()
+
+    def fake_ncf():
+        # what the real measures do: report through the registry
+        telemetry.get_registry().counter(
+            "zoo_jit_cache_misses_total", labelnames=("fn",)).labels(
+            "bench_stub").inc(3)
+        return {"best": 9.0, "staged": 9.0, "cached": None}
+
+    def fake_serving():
+        telemetry.get_tracer().record("bench-uri", "serve", 0.0, 0.01)
+        return {"serving_records_per_sec": 5.0}
+
+    # SERVE_* restored by monkeypatch even though _smoke assigns globals
+    for k in ("SERVE_N", "SERVE_BATCH", "SERVE_HIDDEN", "SERVE_WINDOW",
+              "SERVE_REPS"):
+        monkeypatch.setattr(bench, k, getattr(bench, k))
+    monkeypatch.setattr(bench, "measure_ncf", fake_ncf)
+    monkeypatch.setattr(bench, "measure_serving", fake_serving)
+    bench._smoke()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["mode"] == "smoke"
+    assert rec["value"] == 9.0
+    assert rec["serving_records_per_sec"] == 5.0
+    snap = rec["telemetry"]
+    assert snap["zoo_jit_cache_misses_total"]["fn=bench_stub"] == 3
+    assert snap["trace_ids_held"] >= 1
+    json.dumps(snap)  # the whole snapshot stays JSON-able
+
+
+def test_assemble_record_reports_telemetry_failure_softly(tiny_bench,
+                                                          monkeypatch):
+    """A broken snapshot must not kill the BENCH line (one failure, one
+    error field)."""
+    from analytics_zoo_tpu.common import telemetry
+    bench = tiny_bench
+    monkeypatch.setattr(
+        bench, "measure_ncf",
+        lambda: {"best": 1.0, "staged": 1.0, "cached": None})
+    monkeypatch.setattr(telemetry, "bench_snapshot",
+                        lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    rec = bench._assemble_record({"metric": "x"}, ())
+    assert "telemetry" not in rec
+    assert "boom" in rec["telemetry_error"]
+    assert rec["value"] == 1.0
+
+
 def test_run_with_deadline_completes_normally(tiny_bench, monkeypatch,
                                               capsys):
     bench = tiny_bench

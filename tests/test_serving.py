@@ -3,6 +3,7 @@ serving specs): broker protocol, wire schema, end-to-end stream → inference
 → result, HTTP frontend, config parsing."""
 
 import json
+import re
 import threading
 import time
 import urllib.error
@@ -11,6 +12,8 @@ import urllib.request
 import numpy as np
 import pytest
 
+from analytics_zoo_tpu import observability as obs
+from analytics_zoo_tpu.common import telemetry
 from analytics_zoo_tpu.serving import (
     Broker, ClusterServing, FrontEnd, InputQueue, OutputQueue, ServingConfig,
 )
@@ -771,6 +774,270 @@ class TestArrowWireFormat:
         _, inputs = schema.decode_record(payload)
         assert not isinstance(inputs["words"], schema.ImageBytes)
         assert list(inputs["words"]) == ["Qk1hcmtldA=="]
+
+
+def _scrape(port: int, accept: str = None, query: str = ""):
+    """GET /metrics and return (status, content_type, body_text)."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/metrics{query}",
+        headers={"Accept": accept} if accept else {})
+    resp = urllib.request.urlopen(req, timeout=10)
+    return resp.status, resp.headers.get("Content-Type"), \
+        resp.read().decode()
+
+
+_PROM_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})? (NaN|[+-]Inf|-?[0-9][0-9.e+-]*)$")
+
+
+def _parse_prometheus(text):
+    """(types, samples) from the 0.0.4 text format; asserts every line is
+    well-formed (same checks as tests/test_telemetry.py)."""
+    types, samples = {}, {}
+    for line in text.rstrip("\n").split("\n"):
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ")
+            types[name] = kind
+        elif not line.startswith("#"):
+            m = _PROM_SAMPLE.match(line)
+            assert m, f"malformed exposition line: {line!r}"
+            name, braced, val = m.groups()
+            samples[(name, braced or "")] = float(val)
+    return types, samples
+
+
+class TestTelemetryServing:
+    """ISSUE 2 tentpole: Prometheus exposition, /healthz readiness, and
+    per-record trace decomposition from a LIVE serve loop."""
+
+    def test_prometheus_scrape_from_live_serve(self, broker):
+        telemetry.reset_for_tests()
+        im, _ = _make_model()
+        rng = np.random.RandomState(0)
+        with ClusterServing(im, broker.port, batch_size=4).start() as eng, \
+                FrontEnd(broker.port, engine=eng, timeout=20.0).start() as fe:
+            in_q = InputQueue(port=broker.port)
+            out_q = OutputQueue(port=broker.port)
+            for i in range(8):
+                in_q.enqueue(f"prom{i}", x=rng.randn(4).astype(np.float32))
+            for i in range(8):
+                assert out_q.query(f"prom{i}", timeout=20.0) is not None
+
+            # content negotiation: Accept selects Prometheus...
+            status, ctype, text = _scrape(fe.port, accept="text/plain")
+            assert status == 200
+            assert ctype == "text/plain; version=0.0.4; charset=utf-8"
+            # ...so does ?format=prometheus with no Accept header
+            _, ctype2, text2 = _scrape(fe.port, query="?format=prometheus")
+            assert ctype2 == ctype and "zoo_" in text2
+            # default stays the JSON engine metrics (existing surface)
+            _, jtype, jbody = _scrape(fe.port)
+            assert jtype == "application/json"
+            assert json.loads(jbody)["records_out"] >= 8
+
+            types, samples = _parse_prometheus(text)
+            # a live serve loop populates all three metric kinds
+            assert types["zoo_serving_records_total"] == "counter"
+            assert types["zoo_serving_batch_bucket"] == "gauge"
+            assert types["zoo_stage_seconds"] == "histogram"
+            assert samples[("zoo_serving_records_total",
+                            '{stream="serving_stream"}')] >= 8
+            assert samples[("zoo_serving_batch_bucket",
+                            '{stream="serving_stream"}')] == 4
+            # stage histogram carries cumulative buckets + sum/count
+            assert samples[("zoo_stage_seconds_count",
+                            '{stage="inference"}')] >= 1
+            infer_buckets = [v for (n, lbl), v in samples.items()
+                             if n == "zoo_stage_seconds_bucket"
+                             and 'stage="inference"' in lbl]
+            assert infer_buckets and max(infer_buckets) >= 1
+            # the frontend's own request counter scrapes too (visible from
+            # the second scrape on: a response can't count itself)
+            _, samples2 = _parse_prometheus(text2)
+            assert samples2[("zoo_http_requests_total",
+                             '{path="/metrics",code="200"}')] >= 1
+
+    def test_records_counter_is_monotonic_and_never_behind_results(
+            self, broker):
+        """A client that sees its result and immediately scrapes must find
+        the record already counted (count-before-flush ordering), and the
+        counter never decreases across scrapes."""
+        telemetry.reset_for_tests()
+        im, _ = _make_model()
+        with ClusterServing(im, broker.port, batch_size=2).start() as eng, \
+                FrontEnd(broker.port, engine=eng, timeout=20.0).start() as fe:
+            in_q = InputQueue(port=broker.port)
+            out_q = OutputQueue(port=broker.port)
+            last = 0.0
+            for i in range(6):
+                in_q.enqueue(f"mono{i}", x=np.zeros(4, np.float32))
+                assert out_q.query(f"mono{i}", timeout=20.0) is not None
+                _, _, text = _scrape(fe.port, accept="text/plain")
+                _, samples = _parse_prometheus(text)
+                n = samples[("zoo_serving_records_total",
+                             '{stream="serving_stream"}')]
+                assert n >= i + 1, "result visible before it was counted"
+                assert n >= last
+                last = n
+                m = json.loads(_scrape(fe.port)[2])
+                assert m["records_out"] >= i + 1
+
+    def test_healthz_ready_and_overloaded(self, broker):
+        telemetry.reset_for_tests()
+        im, _ = _make_model()
+        with ClusterServing(im, broker.port, batch_size=2).start() as eng, \
+                FrontEnd(broker.port, engine=eng, timeout=20.0).start() as fe:
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{fe.port}/healthz", timeout=10)
+            out = json.loads(resp.read())
+            assert resp.status == 200
+            assert out["status"] == "ok" and out["broker"] == "up"
+            assert out["engine"] is True
+            assert "queue_depth" in out and "backlog" in out
+        # a drowning replica: deep input queue, no engine draining it
+        with FrontEnd(broker.port, engine=None, max_backlog=2).start() as fe:
+            in_q = InputQueue(port=broker.port)
+            for i in range(5):
+                in_q.enqueue(f"over{i}", x=np.zeros(4, np.float32))
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{fe.port}/healthz", timeout=10)
+            assert ei.value.code == 503
+            body = json.loads(ei.value.read())
+            assert body["status"] == "overloaded"
+            assert body["queue_depth"] >= 5
+
+    def test_healthz_broker_down_is_503(self):
+        import socket
+        with socket.socket() as s:          # a port nothing listens on
+            s.bind(("127.0.0.1", 0))
+            dead_port = s.getsockname()[1]
+        with FrontEnd(dead_port).start() as fe:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{fe.port}/healthz", timeout=10)
+            assert ei.value.code == 503
+            body = json.loads(ei.value.read())
+            assert body["status"] == "unavailable"
+            assert body["broker"].startswith("down")
+
+    def test_concurrent_scrape_while_serving(self, broker):
+        """Scrapers hammer /metrics (both formats) + /healthz while records
+        stream through: every response parses, nothing deadlocks."""
+        telemetry.reset_for_tests()
+        im, _ = _make_model()
+        with ClusterServing(im, broker.port, batch_size=4).start() as eng, \
+                FrontEnd(broker.port, engine=eng, timeout=20.0).start() as fe:
+            errs = []
+            stop = threading.Event()
+
+            def scrape_loop():
+                try:
+                    while not stop.is_set():
+                        _, _, text = _scrape(fe.port, accept="text/plain")
+                        _parse_prometheus(text)
+                        json.loads(_scrape(fe.port)[2])
+                        urllib.request.urlopen(
+                            f"http://127.0.0.1:{fe.port}/healthz",
+                            timeout=10).read()
+                except Exception as e:  # pragma: no cover
+                    errs.append(e)
+
+            scrapers = [threading.Thread(target=scrape_loop)
+                        for _ in range(3)]
+            [t.start() for t in scrapers]
+            in_q = InputQueue(port=broker.port)
+            out_q = OutputQueue(port=broker.port)
+            for i in range(40):
+                in_q.enqueue(f"c{i}", x=np.full(4, i / 40, np.float32))
+            for i in range(40):
+                assert out_q.query(f"c{i}", timeout=30.0) is not None
+            stop.set()
+            [t.join(timeout=15) for t in scrapers]
+            assert not errs
+            _, samples = _parse_prometheus(
+                _scrape(fe.port, accept="text/plain")[2])
+            assert samples[("zoo_serving_records_total",
+                            '{stream="serving_stream"}')] >= 40
+
+    def test_single_record_trace_decomposes_end_to_end(self, broker):
+        """Acceptance: one served record's trace has contiguous stage spans
+        whose durations sum (±tolerance) to the root serve span, and the
+        root stays within the client-observed latency plus the broker
+        block window."""
+        telemetry.reset_for_tests()
+        im, _ = _make_model()
+        with ClusterServing(im, broker.port, batch_size=2).start():
+            in_q = InputQueue(port=broker.port)
+            out_q = OutputQueue(port=broker.port)
+            t_c0 = time.perf_counter()
+            in_q.enqueue("traced-1", x=np.ones(4, np.float32))
+            assert out_q.query("traced-1", timeout=20.0) is not None
+            client_e2e = time.perf_counter() - t_c0
+
+        spans = {s.name: s for s in obs.trace("traced-1")}
+        assert set(spans) == {"dequeue", "preprocess", "dispatch",
+                              "device", "postprocess", "serve"}
+        root = spans["serve"]
+        children = [spans[n] for n in ("dequeue", "preprocess", "device",
+                                       "postprocess")]
+        for c in children:
+            assert c.parent == "serve"
+            assert root.start <= c.start <= c.end <= root.end + 1e-9
+        # contiguous stages: the children tile the root span
+        child_sum = sum(c.duration for c in children)
+        assert child_sum <= root.duration + 1e-9
+        assert root.duration - child_sum <= 0.05, \
+            f"stage spans leave {root.duration - child_sum:.4f}s unexplained"
+        # dispatch is the non-blocking prefix of the device span
+        d = spans["dispatch"]
+        assert d.parent == "device"
+        assert d.start == spans["device"].start
+        assert d.end <= spans["device"].end + 1e-9
+        # the engine-side latency is bounded by what the client saw plus
+        # the blocked broker read the dequeue span includes (block_ms=50)
+        assert root.duration <= client_e2e + 0.5
+        assert obs.trace_table("traced-1").count("\n") >= 6
+
+    def test_http_predict_trace_joins_engine_trace(self, broker):
+        """The frontend's enqueue/wait spans land on the SAME trace as the
+        engine's stage spans (the record uri is the trace id)."""
+        telemetry.reset_for_tests()
+        im, _ = _make_model()
+        x = np.ones(4, np.float32)
+        with ClusterServing(im, broker.port, batch_size=2).start() as eng, \
+                FrontEnd(broker.port, engine=eng, timeout=20.0).start() as fe:
+            body = json.dumps({"uri": "http-traced",
+                               "inputs": {"x": schema.encode_tensor(x)}}
+                              ).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{fe.port}/predict", data=body,
+                headers={"Content-Type": "application/json"})
+            resp = json.loads(urllib.request.urlopen(req, timeout=30).read())
+            assert resp["uri"] == "http-traced"
+        spans = {s.name: s for s in obs.trace("http-traced")}
+        assert {"http_predict", "enqueue", "wait", "serve"} <= set(spans)
+        assert spans["enqueue"].parent == "http_predict"
+        assert spans["wait"].parent == "http_predict"
+        # the wait span brackets the engine's flush: it can only end after
+        # the serve span did
+        assert spans["wait"].end >= spans["serve"].end
+        assert spans["http_predict"].start <= spans["enqueue"].start
+        assert spans["http_predict"].end >= spans["wait"].end
+
+    def test_trace_sampling_zero_records_nothing(self, broker):
+        telemetry.reset_for_tests()
+        telemetry.set_trace_sampling(0.0)
+        try:
+            im, _ = _make_model()
+            with ClusterServing(im, broker.port, batch_size=2).start():
+                in_q = InputQueue(port=broker.port)
+                out_q = OutputQueue(port=broker.port)
+                in_q.enqueue("unsampled", x=np.zeros(4, np.float32))
+                assert out_q.query("unsampled", timeout=20.0) is not None
+            assert obs.trace("unsampled") == []
+        finally:
+            telemetry.set_trace_sampling(1.0)
 
 
 class TestPostprocessFailure:

@@ -1,0 +1,425 @@
+"""Unified telemetry layer tests (ISSUE 2 tentpole): metrics registry,
+Prometheus exposition, span tracing, JIT recompile accounting, transfer
+byte accounting, StageTimer re-backing, and the buffered SummaryWriter."""
+
+import os
+import re
+import threading
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import observability as obs
+from analytics_zoo_tpu.common import summary, telemetry
+from analytics_zoo_tpu.common.pipeline_io import StageTimer
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    telemetry.reset_for_tests()
+    yield
+    telemetry.reset_for_tests()
+
+
+# One sample line: name{labels} value
+_PROM_LINE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})? (NaN|[+-]Inf|-?[0-9][0-9.e+-]*)$")
+
+
+def parse_prometheus(text):
+    """Strict parse of the 0.0.4 text format → (types, samples). Asserts
+    every line is a HELP/TYPE comment or a well-formed sample."""
+    types, samples = {}, {}
+    assert text.endswith("\n")
+    for line in text.rstrip("\n").split("\n"):
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ")
+            assert kind in ("counter", "gauge", "histogram")
+            types[name] = kind
+        elif line.startswith("#"):
+            assert line.startswith("# HELP "), line
+        else:
+            m = _PROM_LINE.match(line)
+            assert m, f"malformed exposition line: {line!r}"
+            name, braced, _, val = m.groups()
+            samples[(name, braced or "")] = float(val)
+    return types, samples
+
+
+class TestRegistry:
+    def test_counter_and_gauge_basics(self):
+        reg = telemetry.MetricsRegistry()
+        c = reg.counter("zoo_t_total", "help", ("k",))
+        c.labels("a").inc()
+        c.labels("a").inc(2.5)
+        c.labels(k="b").inc()
+        assert c.labels("a").value == 3.5
+        assert c.labels("b").value == 1.0  # kw and positional hit same child
+        with pytest.raises(ValueError, match="only go up"):
+            c.labels("a").inc(-1)
+        g = reg.gauge("zoo_t_gauge")
+        g.set(4)
+        g.inc()
+        g.dec(2)
+        assert g.value == 3.0
+
+    def test_get_or_create_is_idempotent_but_clashes_raise(self):
+        reg = telemetry.MetricsRegistry()
+        c1 = reg.counter("zoo_x_total", "h", ("a",))
+        assert reg.counter("zoo_x_total", labelnames=("a",)) is c1
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("zoo_x_total", labelnames=("a",))  # kind clash
+        with pytest.raises(ValueError, match="already registered"):
+            reg.counter("zoo_x_total", labelnames=("b",))  # label clash
+        with pytest.raises(ValueError, match="bad metric name"):
+            reg.counter("0starts_with_digit")
+        with pytest.raises(ValueError, match="bad metric name"):
+            reg.counter("has-dash")
+
+    def test_histogram_counts_sum_and_quantiles(self):
+        reg = telemetry.MetricsRegistry()
+        h = reg.histogram("zoo_h_seconds", buckets=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.05, 0.5, 5.0):
+            h.observe(v)
+        child = h.labels()
+        assert child.count == 4
+        assert child.sum == pytest.approx(5.555)
+        counts, total, _, _ = child._state()
+        assert counts == [1, 1, 1, 1] and total == 4
+        assert h.quantile(0.5) in (0.05, 0.5)
+        assert h.quantile(0.99) == 5.0
+
+    def test_histogram_reservoir_is_bounded(self):
+        reg = telemetry.MetricsRegistry()
+        h = reg.histogram("zoo_big_seconds", buckets=(1.0,))
+        for i in range(5 * telemetry.RESERVOIR_SIZE):
+            h.observe(i / 1000.0)
+        child = h.labels()
+        _, total, _, res = child._state()
+        assert total == 5 * telemetry.RESERVOIR_SIZE
+        assert len(res) == telemetry.RESERVOIR_SIZE  # bounded forever
+        q = h.quantile(0.5)
+        assert 0.0 <= q <= 5.12  # sane value drawn from the stream
+
+    def test_snapshot_shapes(self):
+        reg = telemetry.MetricsRegistry()
+        reg.counter("zoo_c_total", "h", ("s",)).labels("a").inc(3)
+        reg.gauge("zoo_g").set(7)
+        reg.histogram("zoo_h_seconds").observe(0.2)
+        snap = reg.snapshot()
+        assert snap["zoo_c_total"] == {"s=a": 3.0}
+        assert snap["zoo_g"] == 7.0  # unlabelled family collapses to value
+        h = snap["zoo_h_seconds"]
+        assert h["count"] == 1 and h["sum"] == pytest.approx(0.2)
+        assert h["p50"] == pytest.approx(0.2)
+
+
+class TestPrometheusExposition:
+    def test_golden_text(self):
+        reg = telemetry.MetricsRegistry()
+        reg.counter("zoo_a_total", "A counter", ("s",)).labels(
+            'x"y\n').inc(2)
+        reg.gauge("zoo_g", "G").set(1.5)
+        h = reg.histogram("zoo_h_seconds", "H", buckets=(0.3, 1.0))
+        for v in (0.25, 0.5, 4.0):
+            h.observe(v)
+        want = (
+            "# HELP zoo_a_total A counter\n"
+            "# TYPE zoo_a_total counter\n"
+            'zoo_a_total{s="x\\"y\\n"} 2\n'
+            "# HELP zoo_g G\n"
+            "# TYPE zoo_g gauge\n"
+            "zoo_g 1.5\n"
+            "# HELP zoo_h_seconds H\n"
+            "# TYPE zoo_h_seconds histogram\n"
+            'zoo_h_seconds_bucket{le="0.3"} 1\n'
+            'zoo_h_seconds_bucket{le="1"} 2\n'
+            'zoo_h_seconds_bucket{le="+Inf"} 3\n'
+            "zoo_h_seconds_sum 4.75\n"
+            "zoo_h_seconds_count 3\n")
+        assert reg.prometheus_text() == want
+
+    def test_exposition_parses_and_buckets_are_cumulative(self):
+        reg = telemetry.MetricsRegistry()
+        h = reg.histogram("zoo_lat_seconds", "latency", ("stage",))
+        for i in range(200):
+            h.labels("fetch").observe(i / 100.0)
+        reg.counter("zoo_n_total", "n").inc(5)
+        types, samples = parse_prometheus(reg.prometheus_text())
+        assert types["zoo_lat_seconds"] == "histogram"
+        assert types["zoo_n_total"] == "counter"
+        buckets = sorted(
+            ((float(re.search(r'le="([^"]+)"', lbl).group(1)
+                    .replace("+Inf", "inf")), v)
+             for (name, lbl), v in samples.items()
+             if name == "zoo_lat_seconds_bucket"))
+        cum = [v for _, v in buckets]
+        assert cum == sorted(cum), "bucket counts must be cumulative"
+        assert buckets[-1][0] == float("inf")
+        assert buckets[-1][1] == 200  # +Inf bucket == _count
+        assert samples[("zoo_lat_seconds_count",
+                        '{stage="fetch"}')] == 200
+        assert samples[("zoo_n_total", "")] == 5
+
+
+class TestTracer:
+    def test_record_get_and_lru_bound(self):
+        tr = telemetry.Tracer(capacity=3)
+        for i in range(5):
+            tr.record(f"t{i}", "work", 0.0, 1.0)
+        assert tr.get("t0") == [] and tr.get("t1") == []
+        assert [s.name for s in tr.get("t4")] == ["work"]
+        assert tr.get("t4")[0].duration == 1.0
+        tr.clear()
+        assert tr.get("t4") == []
+
+    def test_span_contextmanager_propagates_trace_and_parent(self):
+        tr = telemetry.Tracer()
+        with tr.span("root", "tid"):
+            assert tr.current_trace_id() == "tid"
+            with tr.span("child"):       # inherits tid, parent=root
+                pass
+        spans = {s.name: s for s in tr.get("tid")}
+        assert spans["root"].parent is None
+        assert spans["child"].parent == "root"
+        assert (spans["root"].start <= spans["child"].start
+                <= spans["child"].end <= spans["root"].end)
+        with pytest.raises(ValueError, match="needs an enclosing span"):
+            with tr.span("orphan"):
+                pass
+
+    def test_sampling_is_deterministic_and_exact(self):
+        tr = telemetry.Tracer(sample=0.25)
+        # the accumulator starts with one sample of credit (the first
+        # decision fires), then settles to exactly rate * calls
+        hits = sum(tr.should_sample() for _ in range(100))
+        assert hits == 26
+        hits = sum(tr.should_sample() for _ in range(100))
+        assert hits == 25
+        tr.set_sampling(0.0)
+        assert not any(tr.should_sample() for _ in range(20))
+        tr.set_sampling(1.0)
+        assert all(tr.should_sample() for _ in range(20))
+
+    def test_global_sampling_helper(self):
+        telemetry.set_trace_sampling(0.0)
+        assert not telemetry.get_tracer().should_sample()
+        telemetry.set_trace_sampling(1.0)
+        assert telemetry.get_tracer().should_sample()
+
+
+class TestJitInstrumentation:
+    def test_recompile_counter_increments_then_stays_flat(self):
+        """Acceptance: the counter increments on an avals-signature change
+        and stays FLAT at steady state."""
+        import jax.numpy as jnp
+        reg = telemetry.MetricsRegistry()
+        jf = telemetry.instrument_jit(lambda x: x * 2, name="f",
+                                      registry=reg)
+        x8 = jnp.ones(8, jnp.float32)
+        for _ in range(5):
+            jf(x8)
+        assert jf.cache_misses == 1           # one compile
+        jf(jnp.ones(16, jnp.float32))         # shape change → recompile
+        assert jf.cache_misses == 2
+        jf(jnp.ones(8, jnp.int32))            # dtype change → recompile
+        assert jf.cache_misses == 3
+        for _ in range(10):                   # steady state: flat
+            jf(x8)
+        assert jf.cache_misses == 3
+        calls = reg.counter("zoo_jit_calls_total",
+                            labelnames=("fn",)).labels("f").value
+        misses = reg.counter("zoo_jit_cache_misses_total",
+                             labelnames=("fn",)).labels("f").value
+        assert calls == 17 and misses == 3
+
+    def test_python_leaf_value_change_is_a_miss(self):
+        import jax.numpy as jnp
+        reg = telemetry.MetricsRegistry()
+        jf = telemetry.instrument_jit(lambda x, n: x * n, name="g",
+                                      registry=reg, static_argnums=1)
+        x = jnp.ones(4)
+        jf(x, 2)
+        jf(x, 2)
+        assert jf.cache_misses == 1
+        jf(x, 3)  # static value change recompiles for real — counted
+        assert jf.cache_misses == 2
+
+    def test_decorator_forms_and_delegation(self):
+        import jax.numpy as jnp
+        reg = telemetry.MetricsRegistry()
+
+        @telemetry.instrument_jit
+        def double(x):
+            return x + x
+
+        assert float(double(jnp.float32(2.0))) == 4.0
+        jf = telemetry.instrument_jit(name="h", registry=reg)(
+            lambda x: x - 1)
+        x = jnp.ones(3)
+        np.testing.assert_allclose(np.asarray(jf(x)), 0.0)
+        # delegation: .lower() reaches the underlying jitted callable
+        assert jf.lower(x).compile() is not None
+
+
+class TestDeviceAccounting:
+    def test_transfer_byte_accounting(self):
+        x = np.ones((4, 4), np.float32)  # 64 bytes
+        dev = telemetry.traced_device_put(x)
+        back = telemetry.traced_device_get(dev)
+        np.testing.assert_array_equal(back, x)
+        snap = telemetry.snapshot()
+        assert snap["zoo_device_transfer_bytes_total"]["direction=h2d"] == 64
+        assert snap["zoo_device_transfer_bytes_total"]["direction=d2h"] == 64
+        assert snap["zoo_device_last_transfer_bytes"]["direction=h2d"] == 64
+        # pytrees are billed at the sum of their leaves
+        telemetry.traced_device_put({"a": x, "b": np.ones(2, np.float64)})
+        snap = telemetry.snapshot()
+        assert snap["zoo_device_transfer_bytes_total"]["direction=h2d"] \
+            == 64 + 64 + 16
+        assert snap["zoo_device_last_transfer_bytes"]["direction=h2d"] == 80
+
+    def test_timed_block_until_ready_records_site(self):
+        import jax.numpy as jnp
+        out = telemetry.timed_block_until_ready(jnp.ones(8) * 3,
+                                                site="test_site")
+        np.testing.assert_allclose(np.asarray(out), 3.0)
+        snap = telemetry.snapshot()
+        entry = snap["zoo_device_block_seconds"]["site=test_site"]
+        assert entry["count"] == 1 and entry["sum"] >= 0.0
+
+
+class TestStageTimer:
+    def test_forwards_to_registry_and_keeps_summary_api(self):
+        t = StageTimer()
+        t.record("fetch", 0.01)
+        t.record("fetch", 0.03)
+        t.record_value("batch_size", 16)
+        # legacy dict API unchanged
+        s = t.summary()
+        assert s["fetch"]["count"] == 2
+        assert s["fetch"]["mean_ms"] == pytest.approx(20.0)
+        assert s["batch_size"]["mean"] == 16.0
+        # and the same observations landed in the process registry
+        snap = telemetry.snapshot()
+        assert snap["zoo_stage_seconds"]["stage=fetch"]["count"] == 2
+        assert snap["zoo_stage_seconds"]["stage=fetch"]["sum"] \
+            == pytest.approx(0.04)
+        assert snap["zoo_stage_value"]["stage=batch_size"] == 16.0
+
+    def test_observability_helpers_surface_registry(self):
+        t = StageTimer()
+        t.record("inference", 0.2)
+        assert "zoo_stage_seconds" in obs.scrape()
+        assert obs.metrics()["zoo_stage_seconds"]["stage=inference"][
+            "count"] == 1
+        obs.get_tracer().record("u1", "serve", 0.0, 0.5)
+        assert [s.name for s in obs.trace("u1")] == ["serve"]
+        assert "serve" in obs.trace_table("u1")
+        assert "no trace" in obs.trace_table("nonexistent")
+
+
+class TestSummaryWriter:
+    def test_writes_are_buffered_until_flush(self, tmp_path):
+        w = summary.SummaryWriter(str(tmp_path), flush_bytes=1 << 30,
+                                  flush_every=1 << 30)
+        size0 = os.path.getsize(w._path)  # header record only
+        for i in range(50):
+            w.add_scalar("Loss", float(i), i)
+        assert os.path.getsize(w._path) == size0  # nothing hit disk yet
+        assert "Loss" not in summary.read_scalars(w._path)
+        w.flush()
+        scalars = summary.read_scalars(w._path)
+        assert [s for s, _ in scalars["Loss"]] == list(range(50))
+        assert w.get_scalar("Loss")[0] == (0, 0.0)
+        w.close()
+
+    def test_event_count_threshold_forces_flush(self, tmp_path):
+        w = summary.SummaryWriter(str(tmp_path), flush_bytes=1 << 30,
+                                  flush_every=8)
+        for i in range(7):
+            w.add_scalar("x", float(i), i)
+        assert "x" not in summary.read_scalars(w._path)
+        w.add_scalar("x", 7.0, 7)  # 8th event trips the threshold
+        assert len(summary.read_scalars(w._path)["x"]) == 8
+        w.close()
+
+    def test_byte_threshold_forces_flush(self, tmp_path):
+        w = summary.SummaryWriter(str(tmp_path), flush_bytes=1,
+                                  flush_every=1 << 30)
+        for i in range(3):
+            w.add_scalar("y", float(i), i)
+        assert len(summary.read_scalars(w._path)["y"]) == 3
+        w.close()
+
+    def test_close_is_idempotent_and_terminal(self, tmp_path):
+        w = summary.SummaryWriter(str(tmp_path))
+        w.add_scalar("z", 1.0, 0)
+        w.close()
+        w.close()  # second close: no ValueError on a closed file
+        w.flush()  # flush after close: silently ignored
+        w.add_scalar("z", 2.0, 1)  # dropped, not crashed
+        scalars = summary.read_scalars(w._path)
+        assert scalars["z"] == [(0, 1.0)]
+        assert w.get_scalar("z") == [(0, 1.0)]  # mirror not polluted either
+
+    def test_concurrent_add_scalar_is_safe(self, tmp_path):
+        """4 threads interleave adds through the flush threshold; the
+        events file must stay well-framed and lose nothing."""
+        w = summary.SummaryWriter(str(tmp_path), flush_every=7)
+        n_threads, n_each = 4, 200
+        errs = []
+
+        def work(t):
+            try:
+                for i in range(n_each):
+                    w.add_scalar(f"tag{t}", float(i), i)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=work, args=(t,))
+                   for t in range(n_threads)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        w.close()
+        assert not errs
+        scalars = summary.read_scalars(w._path)
+        for t in range(n_threads):
+            assert [s for s, _ in scalars[f"tag{t}"]] == list(range(n_each))
+            assert len(w.get_scalar(f"tag{t}")) == n_each
+
+
+class TestEstimatorMirroring:
+    def test_fit_mirrors_scalars_into_registry(self, orca_ctx, tmp_path):
+        """The fit loop reports step time / throughput / loss / LR into
+        BOTH the TF-events writer and the telemetry registry."""
+        import flax.linen as nn
+
+        from analytics_zoo_tpu.learn.estimator import Estimator
+        from analytics_zoo_tpu.learn.optimizers import Adam
+
+        class Tiny(nn.Module):
+            @nn.compact
+            def __call__(self, x, train: bool = False):
+                return nn.Dense(1)(x)
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        y = (x @ np.ones((4, 1), np.float32))
+        est = Estimator.from_flax(model=Tiny(), loss="mse",
+                                  optimizer=Adam(1e-2), sample_input=x[:2],
+                                  model_dir=str(tmp_path / "m"))
+        est.fit((x, y), epochs=3, batch_size=32)
+        snap = telemetry.snapshot()
+        assert snap["zoo_training_loss"] >= 0.0
+        assert snap["zoo_training_throughput_samples_per_sec"] > 0.0
+        assert snap["zoo_training_step_seconds"]["count"] >= 1
+        assert snap["zoo_training_learning_rate"] == pytest.approx(1e-2)
+        # events writer got the same stream (existing surface unchanged)
+        assert est.get_train_summary("Loss")
+        assert est.get_train_summary("LearningRate")
+        # jit instrumentation: compiles counted, steady state flat
+        misses = snap["zoo_jit_cache_misses_total"]
+        calls = snap["zoo_jit_calls_total"]
+        assert sum(misses.values()) >= 1
+        assert sum(calls.values()) >= sum(misses.values())
