@@ -131,7 +131,8 @@ class DevicePipeline:
 
     def __init__(self, submit_fn: Callable[[Any], Any], window: int = 2,
                  fetch_fn: Optional[Callable[[Any], Any]] = None,
-                 timer: Optional[StageTimer] = None, prefix: str = ""):
+                 timer: Optional[StageTimer] = None, prefix: str = "",
+                 trace_id: Optional[str] = None):
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
         self.window = int(window)
@@ -139,6 +140,12 @@ class DevicePipeline:
         self._fetch_fn = fetch_fn or _default_fetch
         self._timer = timer
         self._prefix = prefix
+        # trace_id: sampled retired batches record their window residency
+        # as tracer spans under "{trace_id}/batch-{n}" — inflight over
+        # dispatch + fetch — so the predict path shows up in GET /trace
+        # chrome exports alongside the serving engine's stage spans
+        self._trace_id = trace_id
+        self._batch_n = 0
         # (pending_device_value, ctx, t_submit, dispatch_error)
         self._q: deque = deque()
 
@@ -193,6 +200,17 @@ class DevicePipeline:
             self._timer.record_value(
                 self._prefix + "overlap_ratio",
                 1.0 - fetch_s / max(inflight_s, 1e-9))
+        if self._trace_id is not None:
+            n = self._batch_n
+            self._batch_n += 1
+            tracer = telemetry.get_tracer()
+            if tracer.should_sample():
+                tid = f"{self._trace_id}/batch-{n}"
+                tracer.record(tid, "inflight", t0, now)
+                tracer.record(tid, "dispatch", t0, t0 + dispatch_s,
+                              parent="inflight")
+                tracer.record(tid, "fetch", t_fetch, now,
+                              parent="inflight")
         return Completed(host, ctx, err, inflight_s, fetch_s, t0, dispatch_s)
 
     def drain(self, max_n: Optional[int] = None) -> List[Completed]:

@@ -48,7 +48,7 @@ __all__ = [
     "get_registry", "get_tracer", "prometheus_text", "snapshot",
     "bench_snapshot", "instrument_jit", "traced_device_put",
     "traced_device_get", "observe_device_block", "timed_block_until_ready",
-    "set_trace_sampling", "reset_for_tests",
+    "set_trace_sampling", "reset_for_tests", "dump_trace",
 ]
 
 # latency-shaped default buckets (seconds): 100µs .. 30s
@@ -409,6 +409,10 @@ class Tracer:
         self.capacity = int(capacity)
         self._sample = float(sample)
         self._acc = 1.0  # first decision samples (rate > 0)
+        # record-hooks: called with every Span as it lands (the flight
+        # recorder's ring buffer feeds off this). Exceptions are swallowed
+        # — an observer must never break the traced hot path.
+        self._hooks: List[Any] = []
 
     # -------------------------------------------------------- sampling
     def set_sampling(self, rate: float):
@@ -434,6 +438,21 @@ class Tracer:
             return False
 
     # -------------------------------------------------------- recording
+    def add_hook(self, hook) -> None:
+        """Register ``hook(span)`` to observe every recorded span. Used by
+        the flight recorder's ring buffer; hooks run outside the store
+        lock and their exceptions are swallowed."""
+        with self._lock:
+            if hook not in self._hooks:
+                self._hooks.append(hook)
+
+    def remove_hook(self, hook) -> None:
+        with self._lock:
+            try:
+                self._hooks.remove(hook)
+            except ValueError:
+                pass
+
     def record(self, trace_id: str, name: str, start: float, end: float,
                parent: Optional[str] = None):
         span = Span(name, trace_id, start, end, parent)
@@ -445,6 +464,12 @@ class Tracer:
                 spans = []
                 self._traces[trace_id] = spans
             spans.append(span)
+            hooks = tuple(self._hooks)
+        for hook in hooks:
+            try:
+                hook(span)
+            except Exception:
+                pass
         return span
 
     @contextmanager
@@ -473,6 +498,13 @@ class Tracer:
     def get(self, trace_id: str) -> List[Span]:
         with self._lock:
             return list(self._traces.get(trace_id, ()))
+
+    def traces(self) -> "OrderedDict[str, List[Span]]":
+        """Every held trace, oldest-inserted first — the chrome-trace
+        exporter's view of the store."""
+        with self._lock:
+            return OrderedDict((k, list(v))
+                               for k, v in self._traces.items())
 
     def clear(self):
         with self._lock:
@@ -507,14 +539,31 @@ def set_trace_sampling(rate: float):
     _TRACER.set_sampling(rate)
 
 
+def dump_trace(path: str, trace_id: Optional[str] = None) -> str:
+    """Serialize the tracer's span store to Chrome Trace Event JSON at
+    ``path`` (loadable in Perfetto / ``chrome://tracing``). Optionally
+    restrict to one ``trace_id``. Returns the path written.
+
+    Thin convenience over :func:`profiling.dump_trace`; lazy import keeps
+    telemetry free of any dependency on the profiling layer."""
+    from analytics_zoo_tpu.common import profiling
+    return profiling.dump_trace(path, trace_id=trace_id)
+
+
 def reset_for_tests():
     """Swap in a fresh registry/trace store (same objects, cleared state)
     — test isolation for the process-wide singletons."""
+    import sys
     global _REGISTRY
     _REGISTRY = MetricsRegistry()
     _TRACER.clear()
+    with _TRACER._lock:
+        _TRACER._hooks = []
     _TRACER.set_sampling(
         float(os.environ.get("ZOO_TELEMETRY_SAMPLE", "1.0")))
+    prof = sys.modules.get("analytics_zoo_tpu.common.profiling")
+    if prof is not None:
+        prof.reset_for_tests()
 
 
 def bench_snapshot() -> Dict[str, Any]:

@@ -319,7 +319,8 @@ class InferenceModel:
 
         with self._sem:
             pipe = DevicePipeline(lambda c: jitted(params, *c),
-                                  window=max(1, int(pipeline_window)))
+                                  window=max(1, int(pipeline_window)),
+                                  trace_id="inference_predict")
             with pipe:
                 for chunk, valid in chunks():
                     for comp in pipe.submit(chunk, ctx=valid):

@@ -34,6 +34,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from analytics_zoo_tpu.common import profiling as profiling_lib
 from analytics_zoo_tpu.common import telemetry
 from analytics_zoo_tpu.data.dataset import ShardedDataset, to_sharded_dataset
 from analytics_zoo_tpu.data.shard import HostXShards, XShards
@@ -59,6 +60,44 @@ def _trigger_needs_score(trigger) -> bool:
 
 def _as_args(x):
     return x if isinstance(x, tuple) else (x,)
+
+
+class _ProfileWindow:
+    """Defers ``jax.profiler.start_trace`` until training enters a
+    fit-relative step window and stops it when the window closes — whole-run
+    traces of long fits are too large to open in TensorBoard/Perfetto, a
+    20-step window is not. Thresholds are absolute ``_py_step`` values
+    computed at fit start; ``on_step`` is called after every optimizer
+    loop and ``close()`` from fit's ``finally``."""
+
+    def __init__(self, log_dir: str, start_step: int, stop_step: int):
+        if stop_step <= start_step:
+            raise ValueError(
+                f"profile_steps window must be non-empty, got "
+                f"({start_step}, {stop_step})")
+        self.log_dir = log_dir
+        self.start_step = int(start_step)
+        self.stop_step = int(stop_step)
+        self.active = False
+        self.done = False
+
+    def on_step(self, py_step: int):
+        import jax
+        if not self.active and not self.done and \
+                py_step >= self.start_step:
+            jax.profiler.start_trace(self.log_dir)
+            self.active = True
+            logger.info("jax profiler tracing steps [%d, %d) to %s",
+                        self.start_step, self.stop_step, self.log_dir)
+        if self.active and py_step >= self.stop_step:
+            self.close()
+
+    def close(self):
+        if self.active:
+            import jax
+            jax.profiler.stop_trace()
+            self.active = False
+            self.done = True
 
 
 class FlaxModelAdapter:
@@ -601,7 +640,9 @@ class JaxEstimator:
             shuffle: bool = True,
             steps_per_loop: int = 1,
             cache: Optional[str] = None,
-            profile: bool = False) -> Dict[str, List[float]]:
+            profile: bool = False,
+            profile_steps: Optional[Sequence[int]] = None
+            ) -> Dict[str, List[float]]:
         """(ref orca/learn/tf/estimator.py fit:486; batch_size is the GLOBAL
         batch — the reference required batch_size % num_workers == 0, here it
         must divide the data-axis size of the mesh).
@@ -617,11 +658,19 @@ class JaxEstimator:
         that fit on-chip. Requires an unsharded batch (single device or no
         data axis); loss summaries flush once per epoch.
 
-        ``profile=True`` wraps the run in ``jax.profiler.trace`` (the TPU
-        analog of the reference's coarse stage timers, SURVEY §5 —
-        Utils.timeIt / serving Timer.scala): trace files land in
+        ``profile=True`` runs ``jax.profiler`` tracing over a bounded
+        fit-relative step window — ``profile_steps=(start, stop)``, default
+        ``(0, 20)`` — instead of the whole run, so the dump stays small
+        enough to actually open. Passing ``profile_steps`` alone implies
+        ``profile=True``. Trace files land in
         ``<tensorboard dir>/plugins/profile`` next to the TF-events
-        summaries, viewable in TensorBoard's profile tab or Perfetto."""
+        summaries, viewable in TensorBoard's profile tab or Perfetto.
+
+        Independently of ``profile``, every fit publishes the step
+        decomposition through the telemetry registry: ``zoo_step_flops``
+        (XLA ``cost_analysis`` of the compiled step), ``zoo_mfu``,
+        ``zoo_hbm_bytes`` and the ``zoo_train_phase_seconds`` histogram
+        (data_wait/dispatch/device/callback) — see docs/observability.md."""
         ds = self._coerce(to_sharded_dataset(data, feature_cols, label_cols))
         val_ds = (self._coerce(to_sharded_dataset(validation_data, feature_cols,
                                                   label_cols))
@@ -642,12 +691,17 @@ class JaxEstimator:
         retries = 0
         target_epoch = self._epoch + epochs
 
-        profiling = False
-        if profile:
-            import jax
-            jax.profiler.start_trace(self._tb_dirs[0])
-            profiling = True
-            logger.info("jax profiler tracing to %s", self._tb_dirs[0])
+        profile_window = None
+        if profile or profile_steps is not None:
+            lo, hi = profile_steps if profile_steps is not None else (0, 20)
+            profile_window = _ProfileWindow(
+                self._tb_dirs[0], self._py_step + int(lo),
+                self._py_step + int(hi))
+        # per-step phase decomposition + MFU/FLOPs/HBM gauges — always on
+        # (sampled steps only are fenced, so the async dispatch overlap is
+        # preserved on the other sample_every-1 of steps)
+        step_prof = profiling_lib.StepProfiler(
+            name="train", sample_every=max(2, summary_interval // 2))
 
         try:
             while self._epoch < target_epoch:
@@ -655,7 +709,8 @@ class JaxEstimator:
                     epoch_loss = self._run_epoch(
                         ds, mesh, batch_size, shuffle, summary_interval,
                         train_writer, checkpoint_trigger,
-                        steps_per_loop=steps_per_loop, cache=cache)
+                        steps_per_loop=steps_per_loop, cache=cache,
+                        step_prof=step_prof, profile_window=profile_window)
                 except Exception:
                     # elastic retry-from-snapshot (ref Topology.scala:1255-1337)
                     retries += 1
@@ -687,9 +742,8 @@ class JaxEstimator:
                                       self._py_step, epoch_loss, val_score):
                     self._save_snapshot()
         finally:
-            if profiling:
-                import jax
-                jax.profiler.stop_trace()
+            if profile_window is not None:
+                profile_window.close()
         train_writer.flush()
         if self._val_writer:
             self._val_writer.flush()
@@ -800,7 +854,8 @@ class JaxEstimator:
 
     def _run_epoch(self, ds, mesh, batch_size, shuffle, summary_interval,
                    writer, checkpoint_trigger, steps_per_loop: int = 1,
-                   cache: Optional[str] = None) -> float:
+                   cache: Optional[str] = None, step_prof=None,
+                   profile_window=None) -> float:
         if cache == "device":
             return self._run_epoch_cached(ds, mesh, batch_size, shuffle,
                                           writer)
@@ -859,22 +914,76 @@ class JaxEstimator:
                     flush_window()
                     self._save_snapshot()
 
+        # the per-step profiler decomposes each loop into data-wait (the
+        # next() on the device iterator), dispatch (the async jitted
+        # call), device (dispatch→ready, measured by fencing — sampled
+        # steps only, so the dispatch overlap survives) and callback
+        # (summary flush / checkpoint triggers)
         if steps_per_loop > 1:
-            for x, y, k in ds.device_scan_iterator(
-                    mesh, self.strategy, batch_size, steps_per_loop,
-                    shuffle=shuffle, seed=self.seed, epoch=self._epoch):
+            it = iter(ds.device_scan_iterator(
+                mesh, self.strategy, batch_size, steps_per_loop,
+                shuffle=shuffle, seed=self.seed, epoch=self._epoch))
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    x, y, k = next(it)
+                except StopIteration:
+                    break
+                t1 = time.perf_counter()
+                sampled = step_prof is not None and \
+                    step_prof.should_sample(self._py_step)
                 self._state, loop_losses = self._train_scan(self._state,
                                                             (x, y))
+                t2 = time.perf_counter()
+                device_s = None
+                if sampled:
+                    step_prof.ensure_flops(
+                        lambda: profiling_lib.compiled_step_flops(
+                            self._train_scan, self._state, (x, y)),
+                        per_steps=k)
+                    jax.block_until_ready(loop_losses)
+                    device_s = time.perf_counter() - t1
                 pending.append(loop_losses)
+                t3 = time.perf_counter()
                 after_steps(k)
+                if step_prof is not None:
+                    step_prof.observe_step(
+                        self._py_step, t0, t1 - t0, t2 - t1, device_s,
+                        time.perf_counter() - t3, n_steps=k)
+                if profile_window is not None:
+                    profile_window.on_step(self._py_step)
         else:
-            it = ds.device_iterator(mesh, self.strategy, batch_size,
-                                    shuffle=shuffle, seed=self.seed,
-                                    epoch=self._epoch, drop_remainder=True)
-            for x, y, _ in it:
+            it = iter(ds.device_iterator(mesh, self.strategy, batch_size,
+                                         shuffle=shuffle, seed=self.seed,
+                                         epoch=self._epoch,
+                                         drop_remainder=True))
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    x, y, _ = next(it)
+                except StopIteration:
+                    break
+                t1 = time.perf_counter()
+                sampled = step_prof is not None and \
+                    step_prof.should_sample(self._py_step)
                 self._state, logs = self._train_step(self._state, x, y)
+                t2 = time.perf_counter()
+                device_s = None
+                if sampled:
+                    step_prof.ensure_flops(
+                        lambda: profiling_lib.compiled_step_flops(
+                            self._train_step, self._state, x, y))
+                    jax.block_until_ready(logs["loss"])
+                    device_s = time.perf_counter() - t1
                 pending.append(logs["loss"])
+                t3 = time.perf_counter()
                 after_steps(1)
+                if step_prof is not None:
+                    step_prof.observe_step(
+                        self._py_step, t0, t1 - t0, t2 - t1, device_s,
+                        time.perf_counter() - t3)
+                if profile_window is not None:
+                    profile_window.on_step(self._py_step)
         flush_window()
         dt = time.perf_counter() - t_epoch
         logger.info("epoch %d: %d samples in %.2fs (%.0f samples/s)",
@@ -944,7 +1053,8 @@ class JaxEstimator:
             outs.append(preds)
 
         pipe = DevicePipeline(lambda x: self._predict_fn(self._state, x),
-                              window=max(1, int(pipeline_window)))
+                              window=max(1, int(pipeline_window)),
+                              trace_id="estimator_predict")
         with pipe:
             for x, _, mask in ds.device_iterator(
                     mesh, self.strategy, batch_size, drop_remainder=False):

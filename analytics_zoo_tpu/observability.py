@@ -8,15 +8,27 @@ One import surface for operators and notebooks::
     obs.trace("my-uri")     # a served record's stage decomposition
     obs.trace_table("uri")  # ... pretty-printed
 
+Profiling layer (ISSUE 3)::
+
+    obs.dump_trace("out.json")        # Chrome Trace Event JSON → Perfetto
+    obs.chrome_trace()                # ... as a dict (GET /trace payload)
+    obs.get_flight_recorder().dump()  # postmortem under zoo_tpu_logs/
+    obs.backend_state()               # non-blocking backend/device probe
+
 The serving FrontEnd exposes the same data over HTTP (``GET /metrics``
-content-negotiated JSON/Prometheus, ``GET /healthz``); see
-docs/observability.md for the stable metric catalog.
+content-negotiated JSON/Prometheus, ``GET /healthz`` with backend state,
+``GET /trace``); see docs/observability.md for the stable metric catalog.
 """
 
 from __future__ import annotations
 
 from typing import Dict, List
 
+from analytics_zoo_tpu.common.profiling import (  # noqa: F401  (re-exports)
+    FlightRecorder, StepProfiler, backend_state, chrome_trace,
+    compiled_step_flops, device_peak_flops, dump_trace, get_flight_recorder,
+    hbm_bytes, maybe_arm_from_env,
+)
 from analytics_zoo_tpu.common.telemetry import (  # noqa: F401  (re-exports)
     MetricsRegistry, Span, Tracer, bench_snapshot, get_registry, get_tracer,
     instrument_jit, observe_device_block, prometheus_text, set_trace_sampling,
@@ -28,6 +40,9 @@ __all__ = [
     "get_tracer", "instrument_jit", "set_trace_sampling", "bench_snapshot",
     "prometheus_text", "snapshot", "traced_device_put", "traced_device_get",
     "observe_device_block", "timed_block_until_ready",
+    "chrome_trace", "dump_trace", "StepProfiler", "FlightRecorder",
+    "get_flight_recorder", "maybe_arm_from_env", "backend_state",
+    "compiled_step_flops", "device_peak_flops", "hbm_bytes",
 ]
 
 

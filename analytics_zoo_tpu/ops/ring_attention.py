@@ -29,17 +29,20 @@ NEG_INF = -1e30
 
 
 def _ring_flash_local(q, k, v, *, axis_name: str, causal: bool,
-                      block: int):
+                      block: int, n_shards: int):
     """Flash-kernel ring step: each resident k/v block goes through the
     pallas kernel (``flash_attention_with_lse``) and the per-step partial
     softmaxes merge via their logsumexps — no [s_loc, s_loc] score matrix
     ever materializes, on top of the ring's O(s/p) sharding. Causality by
     block position: past blocks run the un-masked kernel, the diagonal
-    block the causal kernel, future blocks are skipped."""
+    block the causal kernel, future blocks are skipped.
+
+    ``n_shards`` is the ring size, threaded from the caller's mesh
+    (``jax.lax.axis_size`` only exists on newer jax)."""
     from analytics_zoo_tpu.ops.flash_attention import (
         flash_attention_with_lse,
     )
-    p = jax.lax.axis_size(axis_name)
+    p = n_shards
     my = jax.lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
 
@@ -89,9 +92,10 @@ def _ring_flash_local(q, k, v, *, axis_name: str, causal: bool,
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
+                          n_shards: int):
     """Runs inside shard_map: q,k,v are the local [b, s_loc, h, d] blocks."""
-    p = jax.lax.axis_size(axis_name)
+    p = n_shards
     my = jax.lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
     scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
@@ -156,7 +160,9 @@ def ring_attention(q, k, v, mesh=None, axis_name: str = mesh_lib.SEQ_AXIS,
     heuristic but the kernel does not pad head_dim, so an unaligned lane
     dimension is left to the Mosaic compiler (may relayout or reject).
     """
-    from jax import shard_map
+    # cross-version shard_map (jax >= 0.8 top-level with check_vma,
+    # older jax under experimental with check_rep)
+    from analytics_zoo_tpu.parallel.pipeline import _shard_map
 
     if mesh is None:
         mesh = mesh_lib.get_default_mesh()
@@ -174,9 +180,10 @@ def ring_attention(q, k, v, mesh=None, axis_name: str = mesh_lib.SEQ_AXIS,
         assert s_loc % flash_block == 0, \
             f"local seq {s_loc} must divide by flash_block {flash_block}"
         fn = functools.partial(_ring_flash_local, axis_name=axis_name,
-                               causal=causal, block=flash_block)
+                               causal=causal, block=flash_block,
+                               n_shards=p)
     else:
         fn = functools.partial(_ring_attention_local, axis_name=axis_name,
-                               causal=causal)
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)(q, k, v)
+                               causal=causal, n_shards=p)
+    return _shard_map()(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec)(q, k, v)

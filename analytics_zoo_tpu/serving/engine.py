@@ -449,6 +449,11 @@ class ClusterServing:
     def start(self) -> "ClusterServing":
         if self._thread is not None:
             return self
+        # ZOO_FLIGHT_RECORDER=1: ring-buffer the serve-loop spans and dump
+        # a postmortem to zoo_tpu_logs/ on SIGTERM — a killed serving
+        # replica leaves evidence of what its pipeline was doing
+        from analytics_zoo_tpu.common import profiling
+        profiling.maybe_arm_from_env()
         self._stop.clear()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()

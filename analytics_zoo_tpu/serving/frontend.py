@@ -30,7 +30,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from analytics_zoo_tpu.common import telemetry
+from analytics_zoo_tpu.common import profiling, telemetry
 from analytics_zoo_tpu.serving import schema
 from analytics_zoo_tpu.serving.broker import BrokerClient
 from analytics_zoo_tpu.serving.client import (INPUT_STREAM, InputQueue,
@@ -109,7 +109,23 @@ class _Handler(BaseHTTPRequestHandler):
         if code == 200 and out["queue_depth"] > srv.max_backlog:
             out["status"] = "overloaded"
             code = 503
+        # surface the JAX backend so a CPU-fallback or wedged-device
+        # replica is visible from the probe itself; the probe thread is
+        # timeout-joined, so a wedged backend can never hang /healthz
+        out["backend"] = profiling.backend_state(timeout_s=2.0)
+        if out["backend"].get("status") == "wedged" and code == 200:
+            out["status"] = "degraded"
         self._json(code, out, path="/healthz")
+
+    def _trace(self):
+        # the span store as Chrome Trace Event JSON: open in Perfetto /
+        # chrome://tracing. ?uri=<trace_id> restricts to one record.
+        trace_id = None
+        if "?" in self.path:
+            from urllib.parse import parse_qs
+            q = parse_qs(self.path.split("?", 1)[1])
+            trace_id = (q.get("uri") or q.get("trace_id") or [None])[0]
+        self._json(200, profiling.chrome_trace(trace_id), path="/trace")
 
     def do_GET(self):
         path = self.path.split("?", 1)[0]
@@ -117,6 +133,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._metrics()
         elif path == "/healthz":
             self._healthz()
+        elif path == "/trace":
+            self._trace()
         else:
             self._json(200, {"status": "ok"}, path=path)
 

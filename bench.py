@@ -33,25 +33,31 @@ STEPS_PER_LOOP = 10     # optimizer steps fused into one scan dispatch
 # JAX CPU backend, same fused train loop, 2026-07-29): 1,120,094 samples/s.
 CPU_BASELINE_SPS = float(os.environ.get("BENCH_BASELINE_SPS", 1_120_094.0))
 
-# peak dense-matmul FLOP/s per chip (bf16), keyed by device_kind;
-# override with BENCH_PEAK_FLOPS
-PEAK_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
+# peak FLOP/s table + helpers live in common/profiling.py now (the
+# estimator's MFU gauge shares them); bench keeps its names as aliases
+from analytics_zoo_tpu.common.profiling import (  # noqa: E402
+    PEAK_FLOPS, device_peak_flops as _device_peak_flops)
+
+# flag per-metric regressions vs the previous BENCH_r*.json beyond this
+# fractional change (override with BENCH_REGRESSION_THRESHOLD)
+REGRESSION_THRESHOLD = float(
+    os.environ.get("BENCH_REGRESSION_THRESHOLD", "0.10"))
 
 
-def _device_peak_flops():
-    import jax
-    if os.environ.get("BENCH_PEAK_FLOPS"):
-        return float(os.environ["BENCH_PEAK_FLOPS"])
-    kind = jax.devices()[0].device_kind
-    return PEAK_FLOPS.get(kind)
+def _flight_dump(note: str, reason: str = "bench-wedge") -> str:
+    """Best-effort flight-recorder postmortem under zoo_tpu_logs/ — a
+    wedged run leaves its last spans + metrics snapshot. Never raises."""
+    try:
+        from analytics_zoo_tpu.common import profiling
+        fr = profiling.get_flight_recorder()
+        fr.note(note)
+        path = fr.dump(reason=reason)
+        if path:
+            print(f"# bench: flight recorder dumped to {path}",
+                  file=sys.stderr, flush=True)
+        return path
+    except Exception:
+        return ""
 
 
 def build_ncf():
@@ -139,15 +145,10 @@ def measure_ncf() -> dict:
 
 
 def _step_flops(train_step, state, x, y):
-    """XLA's own FLOP count for one compiled optimizer step."""
-    try:
-        compiled = train_step.lower(state, x, y).compile()
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        return float(ca.get("flops", 0.0)) or None
-    except Exception:
-        return None
+    """XLA's own FLOP count for one compiled optimizer step (shared with
+    the estimator's zoo_step_flops/zoo_mfu gauges)."""
+    from analytics_zoo_tpu.common.profiling import compiled_step_flops
+    return compiled_step_flops(train_step, state, x, y)
 
 
 def _put_data_sharded(mesh, arr):
@@ -670,8 +671,9 @@ def _cpu_fallback_line(wedge_note: str, timeout_s: float = 2400.0):
 
 
 def _emit_cpu_fallback_and_exit(note: str, timeout_s: float = 2400.0):
-    """Shared wedge protocol: labeled CPU-fallback line (or the 0.0 stub
-    if even that fails), then exit 3."""
+    """Shared wedge protocol: flight-recorder postmortem, then the labeled
+    CPU-fallback line (or the 0.0 stub if even that fails), then exit 3."""
+    _flight_dump(note)
     line, failure = _cpu_fallback_line(note, timeout_s=timeout_s)
     if line is None:
         line = json.dumps({
@@ -696,6 +698,103 @@ def _device_sanity(out: dict) -> None:
             (time.perf_counter() - t0) * 1e3, 2)
     except Exception as e:
         out["device_sanity_error"] = repr(e)[:160]
+
+
+def _load_bench_record(path: str) -> dict | None:
+    """A committed BENCH_r*.json is a driver wrapper {"n","cmd","rc",
+    "tail","parsed"}; the actual one-line record is under "parsed", or —
+    for older wrappers — the last JSON line of "tail"."""
+    try:
+        with open(path) as fh:
+            wrapper = json.load(fh)
+    except Exception:
+        return None
+    if not isinstance(wrapper, dict):
+        return None
+    if isinstance(wrapper.get("parsed"), dict):
+        return wrapper["parsed"]
+    for ln in reversed(str(wrapper.get("tail", "")).strip().splitlines()):
+        if ln.lstrip().startswith("{"):
+            try:
+                rec = json.loads(ln)
+                if isinstance(rec, dict):
+                    return rec
+            except Exception:
+                pass
+    return wrapper if "metric" in wrapper else None
+
+
+def _find_previous_bench_record(bench_dir: str | None = None):
+    """(filename, record) of the highest-round BENCH_r*.json next to this
+    script (or ``bench_dir``), or (None, None)."""
+    import glob
+    import re
+    d = bench_dir or os.path.dirname(os.path.abspath(__file__))
+
+    def round_of(p):
+        m = re.search(r"BENCH_r0*(\d+)", os.path.basename(p))
+        return int(m.group(1)) if m else -1
+
+    for p in sorted(glob.glob(os.path.join(d, "BENCH_r*.json")),
+                    key=lambda p: (round_of(p), p), reverse=True):
+        rec = _load_bench_record(p)
+        if rec is not None:
+            return os.path.basename(p), rec
+    return None, None
+
+
+# metric-name suffixes where lower is better; everything else numeric
+# (samples/s, steps/s, MFU, vs_baseline ...) is higher-better
+_LOWER_BETTER_SUFFIXES = ("_ms", "_ms_per_batch32", "_seconds", "_s")
+# bookkeeping fields that are numeric but not performance metrics
+_GATE_SKIP = {"n", "rc"}
+
+
+def compare_bench_records(prev: dict, cur: dict,
+                          threshold: float = 0.10) -> dict:
+    """Per-metric deltas between two bench records, flagging changes
+    beyond ``threshold`` in the worse direction. Records measured on
+    different devices (chip vs cpu-fallback) get ``comparable: False``
+    and no flags — a fallback round regressing vs a chip round is a
+    backend change, not a perf regression."""
+    comparable = prev.get("device") == cur.get("device")
+    deltas: dict = {}
+    regressions: list = []
+    for key in sorted(set(prev) & set(cur)):
+        pv, cv = prev.get(key), cur.get(key)
+        if key in _GATE_SKIP or isinstance(pv, bool) or \
+                isinstance(cv, bool):
+            continue
+        if not isinstance(pv, (int, float)) or \
+                not isinstance(cv, (int, float)) or pv == 0:
+            continue
+        ratio = (cv - pv) / abs(pv)
+        lower_better = key.endswith(_LOWER_BETTER_SUFFIXES)
+        worse = ratio > threshold if lower_better else ratio < -threshold
+        regression = bool(comparable and worse)
+        deltas[key] = {"prev": pv, "cur": cv,
+                       "delta_pct": round(ratio * 100.0, 1),
+                       "regression": regression}
+        if regression:
+            regressions.append(key)
+    return {"comparable": comparable, "threshold": threshold,
+            "deltas": deltas, "regressions": regressions}
+
+
+def _bench_regression(cur: dict) -> dict:
+    name, prev = _find_previous_bench_record()
+    if prev is None:
+        return {"baseline_file": None, "comparable": False,
+                "threshold": REGRESSION_THRESHOLD, "deltas": {},
+                "regressions": []}
+    gate = compare_bench_records(prev, cur, REGRESSION_THRESHOLD)
+    gate["baseline_file"] = name
+    for key in gate["regressions"]:
+        d = gate["deltas"][key]
+        print(f"# bench: REGRESSION {key}: {d['prev']} -> {d['cur']} "
+              f"({d['delta_pct']:+.1f}% vs {name})",
+              file=sys.stderr, flush=True)
+    return gate
 
 
 def _assemble_record(out: dict, parts, current: dict | None = None) -> dict:
@@ -739,6 +838,12 @@ def _assemble_record(out: dict, parts, current: dict | None = None) -> dict:
         out["telemetry"] = telemetry.bench_snapshot()
     except Exception as e:
         out["telemetry_error"] = repr(e)[:120]
+    # regression gate: per-metric deltas vs the previous round's committed
+    # record ride the line, flagged beyond REGRESSION_THRESHOLD
+    try:
+        out["bench_regression"] = _bench_regression(out)
+    except Exception as e:
+        out["bench_regression_error"] = repr(e)[:120]
     if current is not None:
         current["part"] = "done"
     return out
@@ -784,6 +889,9 @@ def _run_with_deadline(out: dict, parts, deadline_s: float) -> None:
             f"bench deadline {deadline_s:.0f}s expired inside "
             f"{current['part']} (accelerator tunnel unresponsive mid-run); "
             "fields present were measured on-chip before the stall")
+        out["flight_recorder"] = _flight_dump(
+            f"deadline {deadline_s:.0f}s expired in {current['part']}",
+            reason="bench-deadline")
         # dict(out): atomic snapshot — the worker may still be mutating out
         print(json.dumps(dict(out)))
         sys.stdout.flush()
@@ -856,6 +964,8 @@ def _smoke():
     smoke test asserts on it without paying the full bench."""
     import jax
     jax.config.update("jax_platforms", "cpu")
+    from analytics_zoo_tpu.common import profiling
+    fr = profiling.maybe_arm_from_env()
     global N_ROWS, BATCH, WARMUP_STEPS, MEASURE_STEPS, STEPS_PER_LOOP
     global SERVE_N, SERVE_BATCH, SERVE_HIDDEN, SERVE_WINDOW, SERVE_REPS
     N_ROWS, BATCH = 2048, 256
@@ -868,7 +978,12 @@ def _smoke():
         "mode": "smoke",
         "device": jax.devices()[0].device_kind,
     }
-    print(json.dumps(_assemble_record(out, (measure_serving,))))
+    rec = _assemble_record(out, (measure_serving,))
+    if fr is not None:
+        # armed smoke leaves the artifact the CI lane asserts on
+        fr.note("smoke complete")
+        rec["flight_recorder"] = fr.dump(reason="bench-smoke")
+    print(json.dumps(rec))
 
 
 def main():
@@ -887,6 +1002,11 @@ def main():
         print(f"# CPU baseline: {res['best']:,.0f} samples/s "
               f"(staged {res['staged']:,.0f}, cached {cached})")
         return
+    # record spans from the whole run and dump on SIGTERM (a driver-side
+    # kill of a hung bench still leaves a postmortem) — armed before the
+    # watchdog so even an init wedge is covered
+    from analytics_zoo_tpu.common import profiling
+    profiling.get_flight_recorder().arm()
     _device_watchdog()
     import jax
     out = {

@@ -7,7 +7,8 @@
 #   dev/run-tests.sh              # everything
 #   dev/run-tests.sh core         # one lane
 #   dev/run-tests.sh smoke        # fast pre-push subset (<5 min, 1 core)
-#   Lanes: smoke core data keras models zouwu automl serving interop examples
+#   Lanes: smoke core data keras models zouwu automl serving interop
+#          examples telemetry
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -65,6 +66,30 @@ case "$lane" in
   interop)  run tests/test_inference_net.py tests/test_onnx.py \
                 tests/test_openvino.py ;;
   examples) run tests/test_examples.py ;;
+  # observability: unit tests, then an armed bench smoke that must leave
+  # a flight-recorder postmortem (the dump path CI would rely on after a
+  # wedged TPU round is exercised on every lane run, not just on wedges)
+  telemetry) lint_wallclock
+            run -m "not slow" tests/test_telemetry.py tests/test_profiling.py
+            echo "== bench --smoke telemetry (flight recorder armed)"
+            frdir="$(mktemp -d)"
+            ZOO_FLIGHT_RECORDER=1 ZOO_FLIGHT_RECORDER_DIR="$frdir" \
+              JAX_PLATFORMS=cpu python bench.py --smoke telemetry \
+              > "$frdir/smoke.json"
+            python - "$frdir" <<'PY'
+import glob, json, sys
+frdir = sys.argv[1]
+rec = json.load(open(frdir + "/smoke.json"))
+assert rec["mode"] == "smoke" and "telemetry" in rec, rec.keys()
+assert "bench_regression" in rec, "regression gate missing from record"
+dumps = glob.glob(frdir + "/flightrec_*.json")
+assert dumps, "armed smoke left no flight-recorder dump"
+d = json.load(open(dumps[0]))
+assert d["kind"] == "zoo_flight_recorder" and d["spans"], d.get("kind")
+assert rec.get("flight_recorder") in dumps, "record does not point at dump"
+print(f"flight recorder OK: {len(d['spans'])} spans in {dumps[0]}")
+PY
+            ;;
   release)  bash "$(dirname "$0")/release.sh" ;;
   all)      lint_wallclock
             run tests/ ;;

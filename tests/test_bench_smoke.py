@@ -13,6 +13,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+@pytest.fixture(autouse=True)
+def _flight_dumps_to_tmp(monkeypatch, tmp_path):
+    # wedge-path tests dump flight-recorder postmortems; keep them out
+    # of the repo's zoo_tpu_logs/
+    monkeypatch.setenv("ZOO_FLIGHT_RECORDER_DIR", str(tmp_path))
+
+
 @pytest.fixture
 def tiny_bench(monkeypatch):
     import bench
@@ -134,7 +141,7 @@ def test_measure_int8_predict(tiny_bench, orca_ctx, monkeypatch):
 
 
 def test_run_with_deadline_emits_partial_on_stall(tiny_bench, monkeypatch,
-                                                  capsys):
+                                                  capsys, tmp_path):
     """A tunnel wedge MID-run must still produce the one JSON line with
     every already-measured field and the name of the stalled part."""
     import threading
@@ -172,6 +179,14 @@ def test_run_with_deadline_emits_partial_on_stall(tiny_bench, monkeypatch,
     assert rec["fast_ok"] == 1
     assert rec["value"] == 7.0
     assert "stall" in rec["error"]
+    # the simulated wedge left a flight-recorder postmortem, and the
+    # record points at it
+    assert os.path.isfile(rec["flight_recorder"])
+    with open(rec["flight_recorder"]) as fh:
+        dump = json.load(fh)
+    assert dump["kind"] == "zoo_flight_recorder"
+    assert dump["reason"] == "bench-deadline"
+    assert any("deadline" in n for n in dump["notes"])
 
 
 def test_smoke_mode_embeds_telemetry_snapshot(tiny_bench, monkeypatch,
