@@ -1,0 +1,31 @@
+"""zoolint — AST-based, JAX-aware static analysis for this codebase's
+real failure modes (ISSUE 4 tentpole). Rule catalog: docs/zoolint.md.
+
+Four rule families:
+
+- **hot-path sync** (`wallclock-hotpath`, `hotpath-host-sync`) — wall-
+  clock timing and implicit host↔device syncs in the serve/dispatch/train
+  inner loops under serving/, common/, learn/;
+- **recompile hazard** (`jit-in-loop`, `jit-call-inline`,
+  `jit-static-unhashable`) — jit constructions that silently recompile;
+- **concurrency** (`engine-unlocked-write`, `lock-order`) — unlocked
+  cross-thread attribute writes in Thread-spawning classes, ABBA lock
+  inversions;
+- **catalog drift** (`metric-undocumented`, `metric-undeclared`,
+  `envvar-undocumented`) — code vs docs/observability.md agreement.
+
+CLI: ``python -m analytics_zoo_tpu.analysis [paths...]``. Suppress a
+finding in place with ``# zoolint: disable=RULE`` (or grandfather it in
+``dev/zoolint-baseline.json`` with a justification).
+"""
+
+from analytics_zoo_tpu.analysis.core import (  # noqa: F401
+    Finding, Rule, all_rules, analyze_paths, analyze_source,
+    find_repo_root,
+)
+from analytics_zoo_tpu.analysis.rules_catalog import (  # noqa: F401
+    catalog_drift,
+)
+
+__all__ = ["Finding", "Rule", "all_rules", "analyze_paths",
+           "analyze_source", "catalog_drift", "find_repo_root"]

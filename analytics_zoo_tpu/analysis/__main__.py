@@ -1,0 +1,11 @@
+import sys
+
+from analytics_zoo_tpu.analysis.cli import main
+
+try:
+    rc = main()
+    sys.stdout.flush()
+except BrokenPipeError:
+    # reader went away (e.g. `... | head`) — not a lint failure
+    rc = 0
+sys.exit(rc)

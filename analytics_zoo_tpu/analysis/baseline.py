@@ -1,0 +1,108 @@
+"""zoolint baseline — committed, fingerprinted grandfather list.
+
+A finding the team decides to live with (with a one-line justification)
+goes in ``dev/zoolint-baseline.json`` instead of an inline suppression —
+the source line stays clean and the debt is inventoried in one reviewable
+place. Fingerprints hash the rule id, the repo-relative path and the
+*normalized source-line text* (plus an occurrence index for duplicates) —
+NOT the line number — so edits elsewhere in a file never invalidate the
+baseline, while any edit to the offending line itself retires the entry
+(the finding resurfaces and must be re-justified or fixed).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from analytics_zoo_tpu.analysis.core import Finding
+
+BASELINE_VERSION = 1
+#: default location, relative to the repo root
+DEFAULT_BASELINE = os.path.join("dev", "zoolint-baseline.json")
+
+
+def _line_text(root: Optional[str], finding: Finding) -> str:
+    path = finding.path
+    if root is not None and not os.path.isabs(path):
+        path = os.path.join(root, path)
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as fh:
+            lines = fh.read().splitlines()
+        return lines[finding.line - 1].strip()
+    except (OSError, IndexError):
+        return ""
+
+
+def fingerprints(findings: Iterable[Finding],
+                 root: Optional[str]) -> List[Tuple[Finding, str]]:
+    """Stable fingerprint per finding. Identical (rule, path, line-text)
+    triples get an occurrence counter so N copies of the same offending
+    line need N baseline entries — deleting one resurfaces one."""
+    counts: Dict[str, int] = {}
+    out = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        base = f"{f.rule}\x00{f.path}\x00{_line_text(root, f)}"
+        n = counts.get(base, 0)
+        counts[base] = n + 1
+        digest = hashlib.sha256(
+            f"{base}\x00{n}".encode("utf-8")).hexdigest()[:16]
+        out.append((f, digest))
+    return out
+
+
+def load(path: str) -> Dict[str, dict]:
+    """fingerprint -> entry dict. Missing file = empty baseline."""
+    if not os.path.isfile(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path}: unsupported version {data.get('version')!r}")
+    return {e["fingerprint"]: e for e in data.get("entries", ())}
+
+
+def save(path: str, findings: Iterable[Finding], root: Optional[str],
+         justifications: Optional[Dict[str, str]] = None) -> int:
+    """Write a baseline covering ``findings``. Existing justifications at
+    ``path`` are preserved for fingerprints that survive; new entries get
+    a TODO marker that review is expected to replace."""
+    prior = {}
+    if os.path.isfile(path):
+        try:
+            prior = load(path)
+        except ValueError:
+            prior = {}
+    entries = []
+    for f, fp in fingerprints(findings, root):
+        just = (justifications or {}).get(fp) \
+            or prior.get(fp, {}).get("justification") \
+            or "TODO: justify or fix"
+        entries.append({"fingerprint": fp, "rule": f.rule, "path": f.path,
+                        "line": f.line, "message": f.message,
+                        "justification": just})
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": BASELINE_VERSION, "entries": entries},
+                  fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    return len(entries)
+
+
+def apply(findings: List[Finding], baseline: Dict[str, dict],
+          root: Optional[str]) -> Tuple[List[Finding], List[dict]]:
+    """(surviving findings, stale baseline entries). A stale entry's
+    offending line was fixed or edited — it should be deleted from the
+    baseline file (reported as a warning, never a failure)."""
+    matched = set()
+    out = []
+    for f, fp in fingerprints(findings, root):
+        if fp in baseline:
+            matched.add(fp)
+        else:
+            out.append(f)
+    stale = [e for fp, e in baseline.items() if fp not in matched]
+    return out, stale

@@ -1,0 +1,104 @@
+"""``python -m analytics_zoo_tpu.analysis`` — the zoolint CLI.
+
+Exit codes: 0 clean (modulo baseline + inline suppressions), 1 findings,
+2 usage/internal error. ``dev/run-tests.sh zoolint`` (and the ``all`` /
+``smoke`` lanes) require exit 0 on the shipped tree and non-zero on
+tests/fixtures/zoolint's seeded violations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from analytics_zoo_tpu.analysis import baseline as baseline_lib
+from analytics_zoo_tpu.analysis import report
+from analytics_zoo_tpu.analysis.core import (
+    all_rules, analyze_paths, find_repo_root, iter_python_files, relpath,
+)
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m analytics_zoo_tpu.analysis",
+        description="zoolint: AST-based JAX-aware static analysis "
+                    "(hot-path syncs, recompile hazards, concurrency, "
+                    "catalog drift)")
+    p.add_argument("paths", nargs="*", default=["analytics_zoo_tpu"],
+                   help="files/directories to scan "
+                        "(default: analytics_zoo_tpu)")
+    p.add_argument("--format", choices=("human", "json"), default="human")
+    p.add_argument("--rules", metavar="ID[,ID...]",
+                   help="run only these rule ids")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--baseline", metavar="PATH",
+                   help="baseline file (default: <repo>/dev/"
+                        "zoolint-baseline.json when it exists)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write the current findings to the baseline "
+                        "(preserving surviving justifications) and exit 0")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _parser().parse_args(argv)
+    rules = all_rules()
+    if args.list_rules:
+        for rid in sorted(rules):
+            r = rules[rid]
+            print(f"{rid:24s} [{r.scope:7s}] {r.description}")
+        return 0
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = wanted - set(rules)
+        if unknown:
+            print(f"unknown rule id(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = {rid: r for rid, r in rules.items() if rid in wanted}
+    for p in args.paths:
+        if not os.path.exists(p):
+            print(f"no such path: {p}", file=sys.stderr)
+            return 2
+    root = find_repo_root(args.paths[0])
+    findings = analyze_paths(args.paths, rules=rules, root=root)
+
+    baseline_path = args.baseline
+    if baseline_path is None and root is not None:
+        cand = os.path.join(root, baseline_lib.DEFAULT_BASELINE)
+        if os.path.isfile(cand) or args.write_baseline:
+            baseline_path = cand
+    if args.write_baseline:
+        if baseline_path is None:
+            print("--write-baseline needs --baseline or a repo root",
+                  file=sys.stderr)
+            return 2
+        n = baseline_lib.save(baseline_path, findings, root)
+        print(f"baseline written: {baseline_path} ({n} entries)")
+        return 0
+
+    stale: List[dict] = []
+    if baseline_path is not None and not args.no_baseline:
+        try:
+            entries = baseline_lib.load(baseline_path)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        # a partial scan (subset of paths or --rules) must not report
+        # out-of-scope baseline entries as stale — judge staleness only
+        # for entries this run could have re-found
+        scanned = {relpath(p, root) for p in iter_python_files(args.paths)}
+        in_scope = {fp: e for fp, e in entries.items()
+                    if e["path"] in scanned and e["rule"] in rules}
+        findings, stale = baseline_lib.apply(findings, in_scope, root)
+
+    if args.format == "json":
+        print(report.json_report(findings, stale, root))
+    else:
+        print(report.human_report(findings, stale))
+    return 1 if findings else 0

@@ -1,0 +1,328 @@
+"""zoolint core — per-file AST rule engine with inline suppressions.
+
+The invariants the last three PRs rest on (no wall-clock in hot paths, no
+implicit host syncs inside dispatch loops, no per-call jit construction,
+locked engine shared state, a docs catalog that matches the registry) were
+enforced by code review plus one brittle grep. This package turns them
+into first-class static analysis: every rule is an AST visitor with a
+stable id, findings carry ``path:line:col``, and any finding can be
+silenced in place (``# zoolint: disable=RULE``) or grandfathered in the
+committed baseline (see baseline.py) — so the clean-tree invariant is
+``exit 0`` in CI, not tribal knowledge.
+
+Two rule scopes:
+
+- **file** rules see one parsed module at a time (``check_file``);
+- **project** rules see every scanned file at once plus the repo root
+  (``check_project``) — the catalog-drift checks that compare code
+  against docs/observability.md live there.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: path segments whose files count as hot-path (the serve/dispatch/train
+#: inner loops) — hot-path-only rules look at these trees exclusively
+HOT_PATH_SEGMENTS = frozenset({"serving", "common", "learn"})
+
+_DISABLE_LINE = re.compile(
+    r"#\s*zoolint:\s*disable(?:=(?P<rules>[\w,\- ]+))?")
+_DISABLE_FILE = re.compile(
+    r"#\s*zoolint:\s*disable-file=(?P<rules>[\w,\- ]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location. ``path`` is repo-relative
+    posix so findings (and baseline fingerprints) are machine-portable."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: " \
+               f"{self.message}"
+
+
+class _ParentAnnotator(ast.NodeVisitor):
+    """Stamp ``_zl_parent`` on every node — rules walk ancestor chains
+    (enclosing loop / function / ``with`` / ``if``) constantly."""
+
+    def generic_visit(self, node):
+        for child in ast.iter_child_nodes(node):
+            child._zl_parent = node  # type: ignore[attr-defined]
+        super().generic_visit(node)
+
+
+def ancestors(node: ast.AST) -> Iterable[ast.AST]:
+    cur = getattr(node, "_zl_parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "_zl_parent", None)
+
+
+class ImportMap:
+    """Local name -> qualified dotted name, from a module's imports.
+
+    ``resolve(call.func)`` turns an AST callee into its dotted origin
+    (``np.asarray`` -> ``numpy.asarray``, bare ``jit`` after ``from jax
+    import jit`` -> ``jax.jit``) so rules match on canonical names, not on
+    whatever alias a file picked."""
+
+    def __init__(self, tree: ast.AST):
+        self.names: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.names[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    self.names[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+
+    def resolve(self, func: ast.AST) -> str:
+        """Dotted name of a callee ('' when it isn't a plain name chain)."""
+        parts: List[str] = []
+        cur = func
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return ""
+        root = self.names.get(cur.id, cur.id)
+        return ".".join([root] + list(reversed(parts)))
+
+
+@dataclass
+class FileContext:
+    """Everything a file rule sees: parsed AST (parent-annotated), source
+    lines, repo-relative path, import resolution, and hot-path flag."""
+
+    path: str                    # repo-relative, posix separators
+    source: str
+    tree: ast.AST
+    lines: List[str] = field(default_factory=list)
+    imports: ImportMap = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        self.lines = self.source.splitlines()
+        if self.imports is None:
+            self.imports = ImportMap(self.tree)
+
+    @property
+    def is_hot_path(self) -> bool:
+        return bool(HOT_PATH_SEGMENTS
+                    & set(self.path.split("/")[:-1]))
+
+    def line_text(self, line: int) -> str:
+        return self.lines[line - 1] if 0 < line <= len(self.lines) else ""
+
+
+@dataclass
+class ProjectContext:
+    """What project rules see: every FileContext plus the repo root (for
+    docs/ lookups). ``root`` may be None when no repo root was found —
+    root-dependent rules then skip themselves."""
+
+    files: List[FileContext]
+    root: Optional[str]
+
+
+class Rule:
+    """Base rule. Subclasses set ``id`` (the stable suppression/baseline
+    key), ``scope`` ('file' | 'project'), and override the matching
+    ``check_*``. Rule ids are kebab-case and documented in
+    docs/zoolint.md."""
+
+    id: str = ""
+    scope: str = "file"
+    description: str = ""
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, pctx: ProjectContext) -> Iterable[Finding]:
+        return ()
+
+
+_RULES: "Dict[str, Rule]" = {}
+
+
+def register(rule_cls):
+    """Class decorator: instantiate and add to the global rule registry
+    (import-time, like pytest plugins — rules_*.py modules just need to
+    be imported)."""
+    rule = rule_cls()
+    if not rule.id:
+        raise ValueError(f"{rule_cls.__name__} has no id")
+    if rule.id in _RULES:
+        raise ValueError(f"duplicate rule id {rule.id!r}")
+    _RULES[rule.id] = rule
+    return rule_cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    from analytics_zoo_tpu.analysis import (  # noqa: F401
+        rules_catalog, rules_concurrency, rules_hotpath, rules_jit,
+    )
+    return dict(_RULES)
+
+
+# ------------------------------------------------------------ suppressions
+
+def _parse_rule_list(raw: Optional[str]) -> Optional[frozenset]:
+    """None = bare disable (all rules)."""
+    if raw is None:
+        return None
+    return frozenset(r.strip() for r in raw.split(",") if r.strip())
+
+
+def suppressed(ctx: FileContext, finding: Finding) -> bool:
+    """True when the finding's source line carries ``# zoolint: disable``
+    (bare = everything, ``=a,b`` = those rules) or the file carries a
+    matching ``# zoolint: disable-file=a,b`` anywhere."""
+    m = _DISABLE_LINE.search(ctx.line_text(finding.line))
+    if m:
+        rules = _parse_rule_list(m.group("rules"))
+        if rules is None or finding.rule in rules:
+            return True
+    for line in ctx.lines:
+        fm = _DISABLE_FILE.search(line)
+        if fm and finding.rule in _parse_rule_list(fm.group("rules")):
+            return True
+    return False
+
+
+# ------------------------------------------------------------------ engine
+
+def find_repo_root(start: str) -> Optional[str]:
+    """Walk up from ``start`` to the checkout root (the dir holding
+    pyproject.toml / .git / docs/observability.md) — anchors the baseline
+    path and the catalog rules' docs lookup."""
+    cur = os.path.abspath(start)
+    if os.path.isfile(cur):
+        cur = os.path.dirname(cur)
+    while True:
+        if (os.path.exists(os.path.join(cur, "pyproject.toml"))
+                or os.path.isdir(os.path.join(cur, ".git"))
+                or os.path.isfile(
+                    os.path.join(cur, "docs", "observability.md"))):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return None
+        cur = parent
+
+
+def relpath(path: str, root: Optional[str]) -> str:
+    """Repo-relative posix path — the form Finding.path and baseline
+    entries use."""
+    ap = os.path.abspath(path)
+    if root and ap.startswith(os.path.abspath(root) + os.sep):
+        ap = os.path.relpath(ap, root)
+    return ap.replace(os.sep, "/")
+
+
+_relpath = relpath
+
+
+def parse_file(path: str, root: Optional[str]) -> Tuple[
+        Optional[FileContext], Optional[Finding]]:
+    """Parse one file into a FileContext, or a ``syntax-error`` finding —
+    an unparseable file must fail the lint loudly, not crash the linter."""
+    with open(path, "r", encoding="utf-8", errors="replace") as fh:
+        source = fh.read()
+    rel = _relpath(path, root)
+    try:
+        tree = ast.parse(source, filename=rel)
+    except SyntaxError as e:
+        return None, Finding("syntax-error", rel, e.lineno or 1,
+                             (e.offset or 1) - 1,
+                             f"file does not parse: {e.msg}")
+    _ParentAnnotator().visit(tree)
+    return FileContext(path=rel, source=source, tree=tree), None
+
+
+def analyze_source(source: str, relpath: str,
+                   rules: Optional[Sequence[Rule]] = None,
+                   root: Optional[str] = None) -> List[Finding]:
+    """Run file-scope rules over in-memory source — the unit-test entry
+    point (project rules need a tree on disk; see ``analyze_paths``)."""
+    try:
+        tree = ast.parse(source, filename=relpath)
+    except SyntaxError as e:
+        return [Finding("syntax-error", relpath, e.lineno or 1,
+                        (e.offset or 1) - 1,
+                        f"file does not parse: {e.msg}")]
+    _ParentAnnotator().visit(tree)
+    ctx = FileContext(path=relpath.replace(os.sep, "/"), source=source,
+                      tree=tree)
+    use = [r for r in (rules if rules is not None
+                       else all_rules().values()) if r.scope == "file"]
+    out: List[Finding] = []
+    for rule in use:
+        for f in rule.check_file(ctx):
+            if not suppressed(ctx, f):
+                out.append(f)
+    return sorted(out, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git",
+                                            "build", ".eggs")]
+                out.extend(os.path.join(dirpath, f)
+                           for f in sorted(filenames) if f.endswith(".py"))
+    return sorted(set(out))
+
+
+def analyze_paths(paths: Sequence[str],
+                  rules: Optional[Dict[str, Rule]] = None,
+                  root: Optional[str] = None) -> List[Finding]:
+    """Scan files/dirs with every registered rule (file + project scope),
+    inline suppressions applied. Baseline filtering is the CLI's job —
+    library callers (the pytest catalog cross-check) see raw findings."""
+    rules = rules if rules is not None else all_rules()
+    if root is None and paths:
+        root = find_repo_root(paths[0])
+    contexts: List[FileContext] = []
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        ctx, err = parse_file(path, root)
+        if err is not None:
+            findings.append(err)
+            continue
+        contexts.append(ctx)
+        for rule in rules.values():
+            if rule.scope != "file":
+                continue
+            for f in rule.check_file(ctx):
+                if not suppressed(ctx, f):
+                    findings.append(f)
+    pctx = ProjectContext(files=contexts, root=root)
+    by_path = {c.path: c for c in contexts}
+    for rule in rules.values():
+        if rule.scope != "project":
+            continue
+        for f in rule.check_project(pctx):
+            ctx = by_path.get(f.path)
+            if ctx is None or not suppressed(ctx, f):
+                findings.append(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))

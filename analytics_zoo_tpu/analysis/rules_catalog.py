@@ -1,0 +1,189 @@
+"""Catalog-drift rules — the code and docs/observability.md must agree.
+
+docs/observability.md declares every ``zoo_*`` metric name **stable**
+("tests and dashboards key on them") and documents the ``ZOO_*`` env
+knobs. Drift in either direction is a real bug: an undocumented metric is
+invisible to dashboard authors, a documented-but-unregistered metric is a
+dashboard keyed on nothing. These are project-scope rules — they see
+every scanned file at once — and the same check is exposed as a plain
+pytest via :func:`catalog_drift` (tests/test_docs.py) so tier-1 catches
+drift even without the zoolint lane.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from analytics_zoo_tpu.analysis.core import (
+    Finding, ProjectContext, Rule, analyze_paths, find_repo_root, register,
+)
+
+_REGISTRY_METHODS = frozenset({"counter", "gauge", "histogram"})
+_METRIC_PREFIX = "zoo_"
+_ENV_PREFIX = "ZOO_"
+
+#: catalog table rows: ``| `zoo_name` | kind | ...``
+_DOC_METRIC_ROW = re.compile(r"^\|\s*`(zoo_[a-z0-9_]+)`", re.M)
+#: any backticked/bare mention counts as "documented"
+_DOC_METRIC_ANY = re.compile(r"\b(zoo_[a-z0-9_]+)\b")
+_DOC_ENV_ANY = re.compile(r"\b(ZOO_[A-Z0-9_]+)\b")
+
+
+def _docs_path(root: Optional[str]) -> Optional[str]:
+    if root is None:
+        return None
+    p = os.path.join(root, "docs", "observability.md")
+    return p if os.path.isfile(p) else None
+
+
+def _read_docs(root: Optional[str]) -> Optional[str]:
+    p = _docs_path(root)
+    if p is None:
+        return None
+    with open(p, "r", encoding="utf-8") as fh:
+        return fh.read()
+
+
+def _registered_metrics(pctx: ProjectContext) -> List[
+        Tuple[str, str, int, int]]:
+    """Every ``reg.counter/gauge/histogram("zoo_...")`` registration in
+    the scanned files: (metric, path, line, col)."""
+    out = []
+    for ctx in pctx.files:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _REGISTRY_METHODS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value.startswith(_METRIC_PREFIX)):
+                continue
+            out.append((node.args[0].value, ctx.path,
+                        node.lineno, node.col_offset))
+    return out
+
+
+def _env_reads(pctx: ProjectContext) -> List[Tuple[str, str, int, int]]:
+    """Every ``ZOO_*`` env read: os.environ.get/[], os.getenv,
+    environ.get — (var, path, line, col)."""
+    out = []
+    for ctx in pctx.files:
+        for node in ast.walk(ctx.tree):
+            var = None
+            if isinstance(node, ast.Call):
+                name = ctx.imports.resolve(node.func)
+                tail = name.split(".")[-1] if name else ""
+                if (name == "os.getenv"
+                        or (tail == "get" and "environ" in name)) \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Constant):
+                    var = node.args[0].value
+            elif isinstance(node, ast.Subscript):
+                base = node.value
+                if isinstance(base, ast.Attribute) \
+                        and base.attr == "environ":
+                    sl = node.slice
+                    if isinstance(sl, ast.Constant):
+                        var = sl.value
+            if isinstance(var, str) and var.startswith(_ENV_PREFIX):
+                out.append((var, ctx.path, node.lineno, node.col_offset))
+    return out
+
+
+def _scan_covers_package(pctx: ProjectContext) -> bool:
+    """Doc→code drift only makes sense when the scan includes the WHOLE
+    package tree — a fixture-only or subtree scan registers few/no
+    metrics and would flag every documented one. Scanning the package
+    root always pulls in its __init__.py, so that file is the witness."""
+    return any(c.path == "analytics_zoo_tpu/__init__.py"
+               for c in pctx.files)
+
+
+@register
+class MetricUndocumented(Rule):
+    """A ``zoo_*`` metric registered in code but absent from the
+    docs/observability.md catalog."""
+
+    id = "metric-undocumented"
+    scope = "project"
+    description = "registered zoo_* metric missing from the docs catalog"
+
+    def check_project(self, pctx: ProjectContext) -> Iterable[Finding]:
+        docs = _read_docs(pctx.root)
+        if docs is None:
+            return
+        documented = set(_DOC_METRIC_ANY.findall(docs))
+        for metric, path, line, col in _registered_metrics(pctx):
+            if metric not in documented:
+                yield Finding(
+                    self.id, path, line, col,
+                    f"metric {metric!r} is registered here but missing "
+                    "from docs/observability.md — add a catalog row "
+                    "(metric names are a stable interface)")
+
+
+@register
+class MetricUndeclared(Rule):
+    """A catalog row in docs/observability.md whose metric no scanned
+    code registers — a dashboard keyed on nothing."""
+
+    id = "metric-undeclared"
+    scope = "project"
+    description = "docs catalog row with no registration in code"
+
+    def check_project(self, pctx: ProjectContext) -> Iterable[Finding]:
+        docs = _read_docs(pctx.root)
+        if docs is None or not _scan_covers_package(pctx):
+            return
+        registered = {m for m, *_ in _registered_metrics(pctx)}
+        doc_rel = "docs/observability.md"
+        for m in _DOC_METRIC_ROW.finditer(docs):
+            metric = m.group(1)
+            if metric not in registered:
+                line = docs.count("\n", 0, m.start()) + 1
+                yield Finding(
+                    self.id, doc_rel, line, 0,
+                    f"catalog documents {metric!r} but nothing in the "
+                    "scanned tree registers it — remove the row or "
+                    "restore the metric")
+
+
+@register
+class EnvvarUndocumented(Rule):
+    """A ``ZOO_*`` env var read in code but never mentioned in
+    docs/observability.md."""
+
+    id = "envvar-undocumented"
+    scope = "project"
+    description = "ZOO_* env var read but undocumented"
+
+    def check_project(self, pctx: ProjectContext) -> Iterable[Finding]:
+        docs = _read_docs(pctx.root)
+        if docs is None:
+            return
+        documented = set(_DOC_ENV_ANY.findall(docs))
+        for var, path, line, col in _env_reads(pctx):
+            if var not in documented:
+                yield Finding(
+                    self.id, path, line, col,
+                    f"env var {var!r} is read here but undocumented — "
+                    "mention it in docs/observability.md")
+
+
+def catalog_drift(root: Optional[str] = None) -> List[Finding]:
+    """The catalog checks as a plain function: scan the repo's
+    ``analytics_zoo_tpu`` package with only the three catalog rules.
+    tests/test_docs.py asserts this returns [] so tier-1 fails on drift
+    even when the zoolint lane is skipped."""
+    if root is None:
+        root = find_repo_root(os.path.dirname(os.path.abspath(__file__)))
+    if root is None:
+        raise RuntimeError("repo root not found")
+    rules = {r.id: r for r in (
+        MetricUndocumented(), MetricUndeclared(), EnvvarUndocumented())}
+    return analyze_paths([os.path.join(root, "analytics_zoo_tpu")],
+                         rules=rules, root=root)

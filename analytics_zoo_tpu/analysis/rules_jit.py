@@ -1,0 +1,111 @@
+"""Recompile-hazard rules — ``jax.jit`` misuse that causes silent
+per-call or per-iteration recompilation.
+
+On TPU a recompile costs seconds and stalls the whole dispatch window; the
+``zoo_jit_cache_misses_total`` counter detects a storm at runtime, these
+rules catch the three constructions that guarantee one before the code
+ever reaches a chip: jit built inside a loop, jit built and invoked in one
+expression (a fresh wrapper per call), and unhashable / list-typed
+``static_argnums``/``static_argnames`` values.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from analytics_zoo_tpu.analysis.core import (
+    FileContext, Finding, Rule, ancestors, register,
+)
+
+#: callee names that construct a jitted callable
+_JIT_TAILS = frozenset({"jit", "instrument_jit", "pjit"})
+
+_LOOPS = (ast.For, ast.While, ast.AsyncFor)
+_STATIC_KWARGS = ("static_argnums", "static_argnames")
+
+
+def _is_jit_constructor(ctx: FileContext, node: ast.Call) -> bool:
+    name = ctx.imports.resolve(node.func)
+    if not name:
+        return False
+    parts = name.split(".")
+    # bare `jit` only counts when it resolves through an import (jax.jit,
+    # telemetry.instrument_jit) — a local helper named `jit` does not
+    return len(parts) > 1 and parts[-1] in _JIT_TAILS
+
+
+@register
+class JitInLoop(Rule):
+    """``jax.jit(...)`` constructed inside a ``for``/``while`` body.
+
+    Every iteration builds a fresh wrapper; tracing (and often XLA
+    compilation) re-runs per iteration. Construct the jitted callable
+    once outside the loop (or in ``__init__``) and call it inside."""
+
+    id = "jit-in-loop"
+    description = "jit constructed inside a loop"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and _is_jit_constructor(ctx, node) \
+                    and any(isinstance(a, _LOOPS) for a in ancestors(node)):
+                yield Finding(
+                    self.id, ctx.path, node.lineno, node.col_offset,
+                    f"{ctx.imports.resolve(node.func)} constructed inside "
+                    "a loop — build the jitted callable once outside and "
+                    "reuse it")
+
+
+@register
+class JitCallInline(Rule):
+    """``jax.jit(f)(x)`` — a jitted wrapper built and invoked in one
+    expression, i.e. rebuilt on every call of the enclosing function.
+
+    The per-call wrapper defeats jit's own cache keying and re-traces per
+    call site; hoist the ``jax.jit(f)`` to module/``__init__`` scope."""
+
+    id = "jit-call-inline"
+    description = "jit built and invoked in one expression"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Call) \
+                    and _is_jit_constructor(ctx, node.func):
+                yield Finding(
+                    self.id, ctx.path, node.lineno, node.col_offset,
+                    "jit wrapper built and invoked in one expression — a "
+                    "fresh trace per call; hoist the jit() construction "
+                    "out of the call path")
+
+
+@register
+class JitStaticUnhashable(Rule):
+    """List/set/dict literals passed as ``static_argnums`` /
+    ``static_argnames``.
+
+    Static argument descriptors are part of jit's cache key; an
+    unhashable container either raises at call time or (on older APIs)
+    silently defeats caching. Use a tuple — and mark only arguments whose
+    values are hashable and genuinely static."""
+
+    id = "jit-static-unhashable"
+    description = "unhashable static_argnums/static_argnames value"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and _is_jit_constructor(ctx, node)):
+                continue
+            for kw in node.keywords:
+                if kw.arg in _STATIC_KWARGS and isinstance(
+                        kw.value, (ast.List, ast.Set, ast.Dict)):
+                    kind = type(kw.value).__name__.lower()
+                    yield Finding(
+                        self.id, ctx.path, kw.value.lineno,
+                        kw.value.col_offset,
+                        f"{kw.arg} given a {kind} literal — static arg "
+                        "descriptors key the jit cache and must be "
+                        "hashable; use a tuple")

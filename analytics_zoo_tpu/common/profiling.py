@@ -375,7 +375,7 @@ class FlightRecorder:
                     self._seq += 1
                     seq = self._seq
                 import time
-                stamp = int(time.time())   # wallclock: ok (dump filename)
+                stamp = int(time.time())   # zoolint: disable=wallclock-hotpath (dump filename)
                 base = (self.dump_dir
                         or os.environ.get("ZOO_FLIGHT_RECORDER_DIR")
                         or DUMP_DIR)

@@ -91,7 +91,7 @@ def _event(step: int, tag: str = None, value: float = None,
            file_version: str = None) -> bytes:
     # Event: wall_time f1 double, step f2 int64, file_version f3 string,
     # summary f5 message; Summary.value = repeated field 1
-    out = _pb_double(1, time.time())  # wallclock: ok (event timestamp)
+    out = _pb_double(1, time.time())  # zoolint: disable=wallclock-hotpath (event timestamp)
     out += _pb_int64(2, step)
     if file_version is not None:
         out += _pb_string(3, file_version.encode())
@@ -127,7 +127,7 @@ class SummaryWriter:
                  flush_every: int = FLUSH_EVERY):
         os.makedirs(log_dir, exist_ok=True)
         self.log_dir = log_dir
-        fname = (f"events.out.tfevents.{int(time.time())}"  # wallclock: ok
+        fname = (f"events.out.tfevents.{int(time.time())}"  # zoolint: disable=wallclock-hotpath
                  f".{socket.gethostname()}")
         self._path = os.path.join(log_dir, fname)
         self._lock = threading.RLock()

@@ -43,7 +43,7 @@ def save_checkpoint(ckpt_dir: str, state: Any, iteration: int, epoch: int,
         fh.write(serialization.to_bytes(_to_host(state)))
     with open(os.path.join(tmp, "meta.json"), "w") as fh:
         json.dump({"iteration": iteration, "epoch": epoch,
-                   "time": time.time()}, fh)  # wallclock: ok (metadata)
+                   "time": time.time()}, fh)  # zoolint: disable=wallclock-hotpath (metadata)
     if os.path.exists(path):
         shutil.rmtree(path)
     os.replace(tmp, path)

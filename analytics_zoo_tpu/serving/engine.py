@@ -131,6 +131,9 @@ class ClusterServing:
         self.timer = StageTimer()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # records_out is bumped on the serving thread and read from
+        # metrics() on arbitrary caller threads; += is not atomic
+        self._state_lock = threading.Lock()
         self.records_out = 0
         # process-wide telemetry: the registry counters feed the Prometheus
         # /metrics exposition; traces are keyed by record uri so one
@@ -346,7 +349,8 @@ class ClusterServing:
         # find the batch already counted
         t_pp_end = time.perf_counter()
         self.timer.record("postprocess", t_pp_end - t0)
-        self.records_out += n
+        with self._state_lock:
+            self.records_out += n
         self._rec_counter.inc(n)
         if trace is not None:
             self._record_batch_trace(uris, trace, comp, t0, t_pp_end)
@@ -468,7 +472,8 @@ class ClusterServing:
     def metrics(self) -> Dict:
         """Throughput + stage latencies (ref Flink numRecordsOutPerSecond +
         Timer stats)."""
-        out = {"records_out": self.records_out}
+        with self._state_lock:
+            out = {"records_out": self.records_out}
         out.update(self.timer.summary())
         return out
 

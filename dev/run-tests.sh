@@ -8,7 +8,7 @@
 #   dev/run-tests.sh core         # one lane
 #   dev/run-tests.sh smoke        # fast pre-push subset (<5 min, 1 core)
 #   Lanes: smoke core data keras models zouwu automl serving interop
-#          examples telemetry
+#          examples telemetry zoolint
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -16,33 +16,30 @@ lane="${1:-all}"
 
 run() { echo "== pytest $*"; python -m pytest -q "$@"; }
 
-# Grep lint: no wall-clock timing in the serving/common/learn hot paths —
-# time.time() there corrupts stage stats and deadlines under NTP slew
-# (use time.perf_counter()/time.monotonic()). Legitimate wall-clock uses
-# (event timestamps, filenames, checkpoint metadata) carry a
-# "wallclock: ok" marker on the same line.
-lint_wallclock() {
-  echo "== lint: time.time() in hot paths"
-  local hits
-  hits=$(grep -rnE 'time\.time\(\)' \
-           analytics_zoo_tpu/serving analytics_zoo_tpu/common \
-           analytics_zoo_tpu/learn --include='*.py' \
-         | grep -v 'wallclock: ok' || true)
-  if [[ -n "$hits" ]]; then
-    echo "$hits"
-    echo "lint: time.time() found in hot paths (use time.perf_counter()" \
-         "or time.monotonic(); mark legitimate wall-clock uses with" \
-         "'# wallclock: ok')" >&2
+# zoolint: AST-based static analysis (docs/zoolint.md) — hot-path
+# wall-clock/sync, jit recompile hazards, unlocked cross-thread writes,
+# metric/env-var catalog drift. Replaces the old time.time() grep: the
+# shipped tree must be clean (modulo dev/zoolint-baseline.json and
+# inline "# zoolint: disable=RULE"), and the seeded-violation fixture
+# must FAIL — a passing fixture means the linter itself regressed.
+lint_zoolint() {
+  echo "== zoolint: analytics_zoo_tpu"
+  python -m analytics_zoo_tpu.analysis analytics_zoo_tpu
+  echo "== zoolint: seeded-violation fixture (must fail)"
+  if python -m analytics_zoo_tpu.analysis --no-baseline \
+       tests/fixtures/zoolint >/dev/null; then
+    echo "zoolint passed the seeded-violation fixture — linter regressed" >&2
     exit 1
   fi
 }
 
 case "$lane" in
-  lint)     lint_wallclock ;;
+  lint)     lint_zoolint ;;
+  zoolint)  lint_zoolint ;;
   # fast cross-subsystem sweep for the edit loop: serving end-to-end,
   # the dispatch pipeline, estimator, inference + quantize, attention
   # ops — everything marked slow stays out
-  smoke)    lint_wallclock
+  smoke)    lint_zoolint
             run -m "not slow" tests/test_pipeline_io.py \
                 tests/test_serving.py tests/test_inference_net.py \
                 tests/test_estimator.py tests/test_attention.py ;;
@@ -69,7 +66,7 @@ case "$lane" in
   # observability: unit tests, then an armed bench smoke that must leave
   # a flight-recorder postmortem (the dump path CI would rely on after a
   # wedged TPU round is exercised on every lane run, not just on wedges)
-  telemetry) lint_wallclock
+  telemetry) lint_zoolint
             run -m "not slow" tests/test_telemetry.py tests/test_profiling.py
             echo "== bench --smoke telemetry (flight recorder armed)"
             frdir="$(mktemp -d)"
@@ -91,7 +88,7 @@ print(f"flight recorder OK: {len(d['spans'])} spans in {dumps[0]}")
 PY
             ;;
   release)  bash "$(dirname "$0")/release.sh" ;;
-  all)      lint_wallclock
+  all)      lint_zoolint
             run tests/ ;;
   *) echo "unknown lane: $lane" >&2; exit 2 ;;
 esac

@@ -1,0 +1,11 @@
+"""Seeded catalog-drift violations: a zoo_* metric and a ZOO_* env var
+that docs/observability.md does not document. Never imported."""
+
+import os
+
+
+def register_bogus(registry):
+    c = registry.counter("zoo_fixture_bogus_total",
+                         "not in docs")  # VIOLATION metric-undocumented
+    flag = os.getenv("ZOO_FIXTURE_BOGUS")  # VIOLATION envvar-undocumented
+    return c, flag

@@ -1,0 +1,19 @@
+"""Seeded recompile-hazard violations (jit-* rules). Never imported."""
+
+import jax
+
+
+def retrace_per_step(fn, xs):
+    out = []
+    for x in xs:
+        step = jax.jit(fn)  # VIOLATION jit-in-loop
+        out.append(step(x))
+    return out
+
+
+def build_and_call(fn, x):
+    return jax.jit(fn)(x)  # VIOLATION jit-call-inline
+
+
+def unhashable_static(fn):
+    return jax.jit(fn, static_argnums=[0, 1])  # VIOLATION jit-static-unhashable

@@ -73,3 +73,13 @@ def test_doc_snippets_execute(doc, tmp_path):
     assert proc.returncode == 0, (
         f"{doc} snippets failed:\n--- stdout ---\n{proc.stdout[-3000:]}\n"
         f"--- stderr ---\n{proc.stderr[-3000:]}")
+
+
+def test_observability_catalog_matches_code():
+    """Metric/env-var catalog drift (docs/observability.md vs the actual
+    registrations and env reads) fails tier-1, not just the zoolint lane.
+    zoolint's project-scope catalog rules are the single implementation —
+    this test is just their pytest face (docs/zoolint.md)."""
+    from analytics_zoo_tpu.analysis import catalog_drift
+    findings = catalog_drift(root=REPO)
+    assert findings == [], "\n".join(f.format() for f in findings)
