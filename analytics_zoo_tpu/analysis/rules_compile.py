@@ -1,0 +1,95 @@
+"""Compile-ahead rules — XLA compilation reachable from serve/drain loops.
+
+ISSUE 5 moved every hot-path compile onto a background warmup thread
+(common/compile_ahead.py): the serve loop swaps to an already-built rung,
+it never builds one. This rule keeps it that way: an in-band
+``jitted.lower(...)`` / ``lowered.compile()`` inside the loop of a
+dispatch/drain/serve/produce-named function stalls the serve thread for
+the full XLA compile exactly when backlog is highest — the regression the
+compile-ahead layer exists to prevent.
+
+The warmup path itself is baselined by design: code inside any
+``*warm*``-named function (``warm_up``, ``warm_async``, ``_warm_rung``)
+is the sanctioned home for AOT builds, and plain-function compiles with
+no enclosing hot loop (``ExecutableCache._compile``) are not findings.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from analytics_zoo_tpu.analysis.core import (
+    FileContext, Finding, Rule, ancestors, register,
+)
+from analytics_zoo_tpu.analysis.rules_hotpath import (
+    HOT_FN_TOKENS, _enclosing, _fn_tokens, _LOOPS, _nearest_function,
+)
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: attribute tails that perform (or trigger) an XLA build on the spot
+_COMPILE_ATTRS = frozenset({"lower", "compile"})
+
+#: fully-resolved callables that merely LOOK like compiles (regex)
+_NOT_XLA = frozenset({"re.compile", "regex.compile"})
+
+
+def _in_warmup_code(node: ast.AST) -> bool:
+    """True inside any ``*warm*``-named function — the sanctioned AOT
+    build path (warm_up / warm_async / _warm_rung / worker closures whose
+    enclosing function is warm-named)."""
+    for a in ancestors(node):
+        if isinstance(a, _FUNCS) and "warm" in a.name.lower():
+            return True
+    return False
+
+
+@register
+class JitCompileInServeLoop(Rule):
+    """``.lower(...)`` / ``.compile(...)`` inside a serve/drain loop.
+
+    In a hot-path package, an XLA lowering or compile call lexically
+    inside a loop of a hot-named function (dispatch/drain/serve/produce/
+    predict/fit/...) pays a multi-second compile on the latency-critical
+    thread. Route the build through ``compile_ahead.ExecutableCache``
+    (``warm``/``warm_async``) instead — warmup-named functions are
+    baselined, ``re.compile`` is ignored, and a bare ``.lower()`` with no
+    arguments reads as ``str.lower`` (never flagged)."""
+
+    id = "jit-compile-in-serve-loop"
+    description = "XLA lower/compile inside a serve/drain loop"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.is_hot_path:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute) or \
+                    func.attr not in _COMPILE_ATTRS:
+                continue
+            # str.lower() — zero-arg .lower is string casing, not a
+            # jit lowering (which always takes avals/args)
+            if func.attr == "lower" and not node.args and \
+                    not node.keywords:
+                continue
+            name = ctx.imports.resolve(func)
+            if name in _NOT_XLA:
+                continue
+            fn = _nearest_function(node)
+            if fn is None or not (_fn_tokens(fn.name) & HOT_FN_TOKENS):
+                continue
+            loops = [lp for lp in _enclosing(node, _LOOPS)
+                     if _nearest_function(lp) is fn]
+            if not loops:
+                continue
+            if _in_warmup_code(node):
+                continue
+            yield Finding(
+                self.id, ctx.path, node.lineno, node.col_offset,
+                f".{func.attr}(...) inside the `{fn.name}` loop compiles "
+                "XLA on the serve thread — AOT-build the rung through "
+                "compile_ahead.ExecutableCache.warm_async and swap to it "
+                "when ready")

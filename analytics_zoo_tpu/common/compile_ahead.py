@@ -1,0 +1,420 @@
+"""Compile-ahead execution — shape-bucket ladder, AOT executable cache,
+and the persistent XLA compile cache (ISSUE 5 tentpole).
+
+Every batch-shape change costs an XLA compile, and before this layer the
+serving engine paid it *on the serve thread* exactly when backlog was
+highest (``_grow_batch_on_backlog`` doubled the bucket in-band). The fix
+is the same shape discipline the TPU serving literature converges on
+(PAPERS.md: Gemma-on-TPU, Flare): a small fixed ladder of power-of-two
+batch buckets, every incoming batch padded to its nearest rung, and every
+rung's executable built ahead of time, off the hot path:
+
+- **BucketLadder** — the bucket policy: power-of-two rungs between
+  ``min_batch_size`` and ``max_batch_size`` (the top rung clamps to the
+  max), ``rung_for(n)`` selection, ``up``/``down`` stepping.
+- **ExecutableCache** — AOT-compiled executables keyed by the avals
+  signature of the call, built via ``jitted.lower(*avals).compile()``
+  either synchronously (a miss) or on a background warmup thread
+  (``warm_async``). Warm lookups dispatch **directly through the stored
+  executable**, never through ``jax.jit``'s call path — so the
+  ``zoo_jit_cache_misses_total`` recompile counter stays flat by
+  construction once the ladder is warm. Every compile is timed into
+  ``zoo_compile_seconds`` and recorded as a ``compile`` span under the
+  :data:`WARMUP_TRACE_ID` trace, which is how tests prove no serve-thread
+  span ever overlaps a compile.
+- **configure_persistent_cache** — wires JAX's on-disk compilation cache
+  (``ZOO_COMPILE_CACHE``, default ``zoo_tpu_logs/xla_cache``) so process
+  restarts skip cold compiles entirely: a background AOT compile in one
+  process seeds the entry the next process's first jit call hits.
+
+Import cost matches telemetry.py: stdlib + numpy only; jax is imported
+lazily inside the functions that need it.
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import os
+import threading
+from time import perf_counter
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.common import telemetry
+
+__all__ = [
+    "BucketLadder", "ExecutableCache", "configure_persistent_cache",
+    "pad_to_rung", "batch_avals", "WARMUP_TRACE_ID",
+    "register_warmup_thread", "draining",
+]
+
+logger = logging.getLogger(__name__)
+
+#: trace id every compile span is recorded under — serve-thread spans are
+#: keyed by record uri, so "no serve span overlaps a span of this trace"
+#: is exactly the stall-free-warmup invariant
+WARMUP_TRACE_ID = "compile_warmup"
+
+#: default persistent compile-cache directory (ZOO_COMPILE_CACHE overrides;
+#: set it to 0/off/empty to disable)
+DEFAULT_CACHE_DIR = os.path.join("zoo_tpu_logs", "xla_cache")
+
+#: pad fraction is bounded [0, 1): the latency buckets make no sense here
+_PAD_BUCKETS = (0.0, 0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.625, 0.75, 0.875,
+                1.0)
+
+_cache_lock = threading.Lock()
+_cache_dir: Optional[str] = None
+_cache_configured = False
+
+# Warmup threads are daemons so they never block a healthy exit path by
+# policy, but a daemon killed mid-XLA-compile takes the process down from
+# C++ ("terminate called without an active exception"). The atexit drain
+# cancels the remaining rungs and joins the in-flight compile, so a
+# short-lived process (doc snippet, example script) exits cleanly even
+# while a ladder is still warming.
+_warm_threads_lock = threading.Lock()
+_warm_threads: List[threading.Thread] = []
+_draining = threading.Event()
+
+
+def draining() -> bool:
+    """True once interpreter shutdown began — warmup workers poll this
+    between compiles and skip the rest of their rungs."""
+    return _draining.is_set()
+
+
+def register_warmup_thread(thread: threading.Thread) -> None:
+    """Track a background warmup thread so process exit joins it instead
+    of killing it inside an XLA compile."""
+    with _warm_threads_lock:
+        _warm_threads[:] = [t for t in _warm_threads if t.is_alive()]
+        _warm_threads.append(thread)
+
+
+def _drain_warmup_threads() -> None:
+    _draining.set()
+    with _warm_threads_lock:
+        threads = list(_warm_threads)
+    for t in threads:
+        t.join()
+
+
+atexit.register(_drain_warmup_threads)
+
+
+def configure_persistent_cache(path: Optional[str] = None) -> Optional[str]:
+    """Point JAX's persistent compilation cache at a directory so compiled
+    executables survive process restarts (cold start skips straight to
+    deserialization). Idempotent and cheap after the first call.
+
+    ``path`` defaults to ``$ZOO_COMPILE_CACHE`` and then
+    ``zoo_tpu_logs/xla_cache``; an empty value or ``0``/``off``/``none``
+    disables the cache. A directory the user already configured through
+    ``jax_compilation_cache_dir`` is left alone. Returns the directory in
+    use, or None when disabled."""
+    global _cache_dir, _cache_configured
+    with _cache_lock:
+        if _cache_configured:
+            return _cache_dir
+        raw = path if path is not None else os.environ.get(
+            "ZOO_COMPILE_CACHE", DEFAULT_CACHE_DIR)
+        raw = (raw or "").strip()
+        if not raw or raw.lower() in ("0", "off", "none", "disabled"):
+            _cache_configured = True
+            return None
+        try:
+            import jax
+            existing = getattr(jax.config, "jax_compilation_cache_dir",
+                               None)
+            if existing:
+                _cache_dir = existing
+                _cache_configured = True
+                return _cache_dir
+            os.makedirs(raw, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", raw)
+            # the ladder's rungs are small, fast compiles — cache them all,
+            # not just the >1s ones the default thresholds keep
+            for knob, val in (
+                    ("jax_persistent_cache_min_compile_time_secs", 0.0),
+                    ("jax_persistent_cache_min_entry_size_bytes", 0)):
+                try:
+                    jax.config.update(knob, val)
+                except Exception:  # older jax: knob absent — best effort
+                    pass
+            _cache_dir = raw
+        except Exception:
+            logger.exception("persistent compile cache unavailable; "
+                             "continuing without it")
+            _cache_dir = None
+        _cache_configured = True
+        return _cache_dir
+
+
+def _reset_cache_config_for_tests():
+    """Forget the configured-once latch (test isolation only)."""
+    global _cache_dir, _cache_configured
+    with _cache_lock:
+        _cache_dir = None
+        _cache_configured = False
+
+
+class BucketLadder:
+    """Power-of-two batch buckets between ``min_batch_size`` and
+    ``max_batch_size`` (inclusive; the top rung clamps to the max when the
+    doubling overshoots). Incoming batches pad up to ``rung_for(n)`` with
+    tail masking, so every request shape hits one of ``len(ladder)``
+    executables instead of compiling per shape."""
+
+    def __init__(self, min_batch_size: int,
+                 max_batch_size: Optional[int] = None):
+        mn = int(min_batch_size)
+        mx = int(max_batch_size) if max_batch_size else mn
+        if mn < 1:
+            raise ValueError(f"min_batch_size must be >= 1, got {mn}")
+        if mx < mn:
+            raise ValueError(
+                f"max_batch_size {mx} < min_batch_size {mn}")
+        rungs: List[int] = []
+        r = mn
+        while r < mx:
+            rungs.append(r)
+            r *= 2
+        rungs.append(mx)
+        self.rungs: Tuple[int, ...] = tuple(rungs)
+
+    @property
+    def min(self) -> int:
+        return self.rungs[0]
+
+    @property
+    def max(self) -> int:
+        return self.rungs[-1]
+
+    def rung_for(self, n: int) -> int:
+        """Smallest rung that fits ``n`` records (the top rung for
+        anything larger)."""
+        for r in self.rungs:
+            if n <= r:
+                return r
+        return self.rungs[-1]
+
+    def up(self, rung: int) -> int:
+        """The next larger rung (itself at the top)."""
+        for r in self.rungs:
+            if r > rung:
+                return r
+        return self.rungs[-1]
+
+    def down(self, rung: int) -> int:
+        """The next smaller rung (itself at the bottom)."""
+        below = [r for r in self.rungs if r < rung]
+        return below[-1] if below else self.rungs[0]
+
+    def __contains__(self, n: int) -> bool:
+        return int(n) in self.rungs
+
+    def __iter__(self):
+        return iter(self.rungs)
+
+    def __len__(self) -> int:
+        return len(self.rungs)
+
+    def __repr__(self) -> str:
+        return f"BucketLadder{self.rungs}"
+
+
+def _pad_hist(site: str):
+    return telemetry.get_registry().histogram(
+        "zoo_bucket_pad_fraction",
+        "Fraction of each dispatched bucket that is tail padding",
+        ("site",), buckets=_PAD_BUCKETS).labels(site)
+
+
+def pad_to_rung(arrays: Sequence[np.ndarray], rung: int,
+                site: str = "inference") -> Tuple[np.ndarray, ...]:
+    """Pad every array of one logical batch up to ``rung`` rows by
+    repeating the last row (the caller masks the tail off the output).
+    Records the padded fraction on ``zoo_bucket_pad_fraction{site=}`` for
+    every call — a full batch observes 0, so the histogram's mean is the
+    real pad-waste rate, not just the waste of padded batches."""
+    arrays = tuple(arrays)
+    n = int(arrays[0].shape[0])
+    rung = int(rung)
+    if n > rung:
+        raise ValueError(f"batch of {n} does not fit rung {rung}")
+    _pad_hist(site).observe((rung - n) / float(rung))
+    if n == rung:
+        return arrays
+    return tuple(
+        np.concatenate([a, np.repeat(a[-1:], rung - n, axis=0)])
+        for a in arrays)
+
+
+def batch_avals(spec: Sequence[Tuple[Tuple[int, ...], Any]], rung: int):
+    """Turn a per-sample input spec — ``[(sample_shape, dtype), ...]``,
+    one entry per model input — into batched ``jax.ShapeDtypeStruct``
+    avals at batch size ``rung``."""
+    import jax
+    return tuple(jax.ShapeDtypeStruct((int(rung),) + tuple(shape), dtype)
+                 for shape, dtype in spec)
+
+
+def _aval_of(x):
+    import jax
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is None or dtype is None:
+        arr = np.asarray(x)
+        shape, dtype = arr.shape, arr.dtype
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+class ExecutableCache:
+    """AOT-compiled executables for one jitted function, keyed by the
+    avals signature of the call.
+
+    ``__call__`` is the hot path: a warm signature dispatches directly
+    through the stored compiled executable — bypassing ``jax.jit``'s
+    dispatch cache entirely, so the ``zoo_jit_*`` recompile counters
+    cannot move — and counts a ``zoo_compile_cache_hits_total``. A cold
+    signature compiles synchronously (``zoo_compile_cache_misses_total``
+    plus a timed ``zoo_compile_seconds`` observation) and is stored for
+    next time. ``warm``/``warm_async`` pre-build rungs so the hot path
+    never sees a cold signature; every compile — warm or miss — lands a
+    ``compile`` span on the :data:`WARMUP_TRACE_ID` trace.
+
+    Any failure in the AOT path (lowering, executable call) falls back to
+    the plain jitted call, so the cache can only ever add speed, never
+    break a model that jit handles."""
+
+    def __init__(self, jitted, name: str = "compile_ahead",
+                 registry: Optional[telemetry.MetricsRegistry] = None,
+                 tracer: Optional[telemetry.Tracer] = None):
+        self._jitted = jitted
+        self.name = name
+        self._lock = threading.Lock()
+        self._execs: Dict[Tuple, Any] = {}
+        self._inflight: set = set()
+        reg = registry if registry is not None else telemetry.get_registry()
+        self._tracer = tracer if tracer is not None else \
+            telemetry.get_tracer()
+        self._compile_hist = reg.histogram(
+            "zoo_compile_seconds",
+            "XLA compile time per AOT-built executable", ("fn",)
+        ).labels(name)
+        self._hits = reg.counter(
+            "zoo_compile_cache_hits_total",
+            "Dispatches served by an already-compiled executable",
+            ("fn",)).labels(name)
+        self._misses = reg.counter(
+            "zoo_compile_cache_misses_total",
+            "Dispatches that had to compile synchronously", ("fn",)
+        ).labels(name)
+
+    # ----------------------------------------------------------- keying
+    @staticmethod
+    def signature(args: Tuple) -> Tuple:
+        """Pytree structure plus (shape, dtype) of every array leaf —
+        the same avals identity ``jax.jit``'s cache keys on, so a stored
+        executable is exactly reusable for a matching signature."""
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        return (treedef, tuple(telemetry._leaf_sig(leaf) for leaf in leaves))
+
+    def ready(self, *args) -> bool:
+        """True when a compiled executable exists for this call shape
+        (``args`` may be concrete arrays or ``ShapeDtypeStruct`` avals —
+        both carry the shape/dtype the signature reads)."""
+        sig = self.signature(args)
+        with self._lock:
+            return sig in self._execs
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._execs)
+
+    # -------------------------------------------------------- compiling
+    def _compile(self, sig: Tuple, avals: Tuple):
+        """Build and store one executable; records the compile span +
+        histogram. Duplicate concurrent builds of one signature are
+        collapsed (second builder just waits for the dict entry)."""
+        configure_persistent_cache()
+        with self._lock:
+            if sig in self._execs:
+                return self._execs[sig]
+            self._inflight.add(sig)
+        try:
+            t0 = perf_counter()
+            exe = self._jitted.lower(*avals).compile()
+            t1 = perf_counter()
+            self._compile_hist.observe(t1 - t0)
+            self._tracer.record(WARMUP_TRACE_ID, "compile", t0, t1)
+            with self._lock:
+                self._execs[sig] = exe
+            return exe
+        finally:
+            with self._lock:
+                self._inflight.discard(sig)
+
+    def warm(self, *avals) -> bool:
+        """Synchronously AOT-compile one signature (no-op when already
+        built). Returns True when an executable is available after the
+        call."""
+        sig = self.signature(avals)
+        with self._lock:
+            if sig in self._execs:
+                return True
+        try:
+            self._compile(sig, avals)
+            return True
+        except Exception:
+            logger.exception("AOT warmup compile failed for %s", self.name)
+            return False
+
+    def warm_async(self, aval_sets: Sequence[Tuple]) -> threading.Thread:
+        """Spawn a daemon thread that warms every signature in
+        ``aval_sets`` (a list of argument-aval tuples), smallest first so
+        the rung most likely to be needed next lands earliest."""
+        sets = [tuple(s) for s in aval_sets]
+
+        def worker():
+            for avals in sets:
+                if _draining.is_set():
+                    return
+                self.warm(*avals)
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name=f"zoo-warmup-{self.name}")
+        t.start()
+        register_warmup_thread(t)
+        return t
+
+    # --------------------------------------------------------- dispatch
+    def __call__(self, *args):
+        sig = self.signature(args)
+        with self._lock:
+            exe = self._execs.get(sig)
+        if exe is None:
+            self._misses.inc()
+            try:
+                exe = self._compile(sig, _tree_avals(args))
+            except Exception:
+                # lowering failed (exotic leaf types, donated aliasing...):
+                # the jitted call handles everything the cache can't
+                return self._jitted(*args)
+        else:
+            self._hits.inc()
+        try:
+            return exe(*args)
+        except Exception:
+            # executable/arg mismatch (sharding drift, weak types): the
+            # jitted path is always correct, just not compile-proof
+            return self._jitted(*args)
+
+
+def _tree_avals(tree):
+    import jax
+    return jax.tree_util.tree_map(_aval_of, tree)

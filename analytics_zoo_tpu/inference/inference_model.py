@@ -31,11 +31,12 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
-from analytics_zoo_tpu.common import telemetry
+from analytics_zoo_tpu.common import compile_ahead, telemetry
 
 
 def _as_tuple(x):
@@ -55,6 +56,15 @@ class InferenceModel:
         self._n_inputs = 1
         # set by quantize(mode="int8"): {dense path: calibrated |x|max}
         self._act_ranges = None
+        # compile-ahead state: the batch-bucket ladder predict chunks
+        # against, the per-sample input spec warmup builds avals from
+        # (captured at load when a sample_input is given, else observed on
+        # the first dispatch), the AOT executable cache dispatches run
+        # through, and the live warmup threads wait_warm() joins
+        self._ladder: Optional[compile_ahead.BucketLadder] = None
+        self._sample_spec = None    # ((sample_shape, dtype), ...) per input
+        self._exec_cache: Optional[compile_ahead.ExecutableCache] = None
+        self._warm_threads: list = []
 
     # ------------------------------------------------------------- loaders
     def load_zoo(self, model) -> "InferenceModel":
@@ -106,6 +116,7 @@ class InferenceModel:
             return module.apply(state["params"], *xs)
 
         self._install(apply_fn, {"params": params}, len(args))
+        self._remember_spec(args, overwrite=True)
         return self
 
     def load_openvino(self, model_path: str, weight_path: str,
@@ -143,6 +154,7 @@ class InferenceModel:
 
         self._install(wrapped, {"params": variables["params"],
                                 "model_state": variables["buffers"]}, n)
+        self._remember_spec(_as_tuple(sample_input), overwrite=True)
         return self
 
     def load_checkpoint(self, path: str) -> "InferenceModel":
@@ -238,6 +250,119 @@ class InferenceModel:
             # zoo_jit_cache_misses_total{fn="inference_model"}
             self._jitted = telemetry.instrument_jit(
                 apply_fn, name="inference_model")
+            # warm dispatches bypass jit entirely through the AOT
+            # executable cache; a re-install (load_*, quantize) drops the
+            # old executables — the new forward needs new ones
+            self._exec_cache = compile_ahead.ExecutableCache(
+                self._jitted, name="inference_model")
+
+    # ------------------------------------------------------ compile-ahead
+    def _remember_spec(self, xs, overwrite: bool = False):
+        """Record the per-sample (shape, dtype) of every input — what
+        ``warm_up`` builds batched avals from. Loaders with a
+        ``sample_input`` overwrite (authoritative); observed dispatch
+        shapes only fill an empty spec."""
+        try:
+            spec = tuple((tuple(a.shape[1:]), np.dtype(a.dtype))
+                         for a in xs)
+        except Exception:
+            return
+        with self._lock:
+            if overwrite or self._sample_spec is None:
+                self._sample_spec = spec
+
+    def has_warm_spec(self) -> bool:
+        """True once the input spec needed for AOT warmup is known."""
+        with self._lock:
+            return self._sample_spec is not None
+
+    def set_ladder(self, ladder, max_batch_size: Optional[int] = None
+                   ) -> "InferenceModel":
+        """Attach a batch-bucket ladder: ``predict`` pads each tail chunk
+        to the nearest rung (instead of the full batch bucket) so tails
+        reuse smaller pre-built executables. Pass a
+        :class:`~analytics_zoo_tpu.common.compile_ahead.BucketLadder` or
+        ``(min_batch_size, max_batch_size)`` ints."""
+        if not isinstance(ladder, compile_ahead.BucketLadder):
+            ladder = compile_ahead.BucketLadder(int(ladder), max_batch_size)
+        with self._lock:
+            self._ladder = ladder
+        return self
+
+    def _aot_avals(self, params, spec, rung):
+        import jax
+
+        def aval(a):
+            if hasattr(a, "shape") and hasattr(a, "dtype"):
+                return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+            arr = np.asarray(a)
+            return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+        p_avals = jax.tree_util.tree_map(aval, params)
+        return (p_avals,) + compile_ahead.batch_avals(spec, rung)
+
+    def warm_up(self, rungs=None, sample_input=None, block: bool = False):
+        """AOT-compile executables for the given batch ``rungs`` (default:
+        the attached ladder's) on a background daemon thread — the serving
+        engine calls this off the serve thread so bucket growth becomes a
+        stall-free swap. ``sample_input`` records the input spec when the
+        loader didn't capture one. ``block=True`` compiles synchronously.
+        Returns the warmup thread (None when there is nothing to warm or
+        no spec yet); ``wait_warm`` joins all outstanding ones."""
+        if sample_input is not None:
+            self._remember_spec(
+                tuple(np.asarray(a) for a in _as_tuple(sample_input)),
+                overwrite=True)
+        with self._lock:
+            spec, cache = self._sample_spec, self._exec_cache
+            params, ladder = self._params, self._ladder
+        if cache is None or spec is None:
+            return None
+        if rungs is None:
+            rungs = ladder.rungs if ladder is not None else ()
+        todo = []
+        for rung in sorted({int(r) for r in rungs}):
+            avals = self._aot_avals(params, spec, rung)
+            if not cache.ready(*avals):
+                todo.append(avals)
+        if not todo:
+            return None
+        if block:
+            for avals in todo:
+                cache.warm(*avals)
+            return None
+        t = cache.warm_async(todo)
+        with self._lock:
+            self._warm_threads = [w for w in self._warm_threads
+                                  if w.is_alive()] + [t]
+        return t
+
+    def wait_warm(self, timeout: Optional[float] = None
+                  ) -> "InferenceModel":
+        """Join every outstanding warmup thread (best effort under
+        ``timeout`` seconds total)."""
+        with self._lock:
+            threads = list(self._warm_threads)
+        deadline = None if timeout is None else \
+            time.monotonic() + float(timeout)
+        for t in threads:
+            t.join(None if deadline is None
+                   else max(0.0, deadline - time.monotonic()))
+        return self
+
+    def rung_ready(self, rung: int) -> bool:
+        """True when an AOT executable exists for batch size ``rung`` —
+        the serving engine's gate for stall-free bucket growth. Unknown
+        spec reads as not-ready (growing would compile in-band)."""
+        with self._lock:
+            spec, cache, params = \
+                self._sample_spec, self._exec_cache, self._params
+        if cache is None or spec is None:
+            return False
+        try:
+            return cache.ready(*self._aot_avals(params, spec, rung))
+        except Exception:
+            return False
 
     # ------------------------------------------------------------- predict
     def _snapshot(self):
@@ -246,7 +371,8 @@ class InferenceModel:
             # load_checkpoint can't mix model versions across chunks
             if self._apply is None:
                 raise RuntimeError("no model loaded")
-            return self._params, self._jitted, self._n_inputs
+            return (self._params, self._jitted, self._n_inputs,
+                    self._exec_cache, self._ladder)
 
     @staticmethod
     def _coerce(x, n_inputs) -> Tuple[np.ndarray, ...]:
@@ -259,26 +385,27 @@ class InferenceModel:
                     f"model takes {n_inputs} inputs, got {len(xs)}")
         return tuple(np.asarray(a) for a in xs)
 
-    def _chunks(self, x, n_inputs, batch_size):
+    def _chunks(self, x, n_inputs, batch_size, ladder=None):
         """Split one logical batch into compile-bucket chunks, padding the
         tail so every shape hits an already-built executable: yields
-        ``(chunk_tuple, n_valid)``."""
+        ``(chunk_tuple, n_valid)``. With a bucket ladder attached, the
+        tail pads to its **nearest rung** instead of the full bucket —
+        less pad waste, and the rung's executable is already warm."""
         xs = self._coerce(x, n_inputs)
+        self._remember_spec(xs)
         n = xs[0].shape[0]
         if n == 0:
             raise ValueError("predict called on an empty batch")
-        bs = int(batch_size) if batch_size else n
+        bs = int(batch_size) if batch_size else \
+            (ladder.rung_for(n) if ladder is not None else n)
         for lo in range(0, n, bs):
             hi = min(lo + bs, n)
             chunk = tuple(a[lo:hi] for a in xs)
             valid = hi - lo
-            if valid < bs:
-                # pad to the bucket so the same executable is reused
-                chunk = tuple(
-                    np.concatenate(
-                        [a, np.repeat(a[-1:], bs - valid, axis=0)])
-                    for a in chunk)
-            yield chunk, valid
+            rung = bs if ladder is None else \
+                min(bs, ladder.rung_for(valid))
+            yield compile_ahead.pad_to_rung(chunk, rung,
+                                            site="inference"), valid
 
     def predict(self, x, batch_size: Optional[int] = None,
                 pipeline_window: int = 2) -> np.ndarray:
@@ -300,14 +427,20 @@ class InferenceModel:
         import jax
         from analytics_zoo_tpu.common.pipeline_io import DevicePipeline
 
-        params, jitted, n_inputs = self._snapshot()
+        params, jitted, n_inputs, cache, ladder = self._snapshot()
+        # warm rungs dispatch straight through the AOT executable cache —
+        # the jit call path (and its recompile counter) is only the
+        # fallback for shapes the cache cannot handle
+        run = cache if cache is not None else \
+            (lambda p, *c: jitted(p, *c))
 
         def chunks():
             if hasattr(x, "__next__"):       # stream of batches
                 for b in x:
-                    yield from self._chunks(b, n_inputs, batch_size)
+                    yield from self._chunks(b, n_inputs, batch_size,
+                                            ladder)
             else:
-                yield from self._chunks(x, n_inputs, batch_size)
+                yield from self._chunks(x, n_inputs, batch_size, ladder)
 
         outs = []
 
@@ -318,7 +451,7 @@ class InferenceModel:
                 lambda a: a[:comp.ctx], comp.result))
 
         with self._sem:
-            pipe = DevicePipeline(lambda c: jitted(params, *c),
+            pipe = DevicePipeline(lambda c: run(params, *c),
                                   window=max(1, int(pipeline_window)),
                                   trace_id="inference_predict")
             with pipe:
@@ -344,8 +477,12 @@ class InferenceModel:
         and padding (the engine pads to its own bucket) and bounds
         in-flight work through its DevicePipeline window, so the
         ``concurrent_num`` semaphore is not taken here."""
-        params, jitted, n_inputs = self._snapshot()
-        return jitted(params, *self._coerce(x, n_inputs))
+        params, jitted, n_inputs, cache, _ = self._snapshot()
+        xs = self._coerce(x, n_inputs)
+        self._remember_spec(xs)
+        if cache is not None:
+            return cache(params, *xs)
+        return jitted(params, *xs)
 
     def predict_fetch(self, pending):
         """Blocking host side of ``predict_async``."""

@@ -34,6 +34,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from analytics_zoo_tpu.common import compile_ahead
 from analytics_zoo_tpu.common import profiling as profiling_lib
 from analytics_zoo_tpu.common import telemetry
 from analytics_zoo_tpu.data.dataset import ShardedDataset, to_sharded_dataset
@@ -334,6 +335,7 @@ class JaxEstimator:
         self._train_step = None
         self._eval_step = None
         self._predict_fn = None
+        self._precompile_thread = None
         self._epoch = 0
         self._py_step = 0  # host-side mirror of state["step"]: no device sync
         self._train_writer = None
@@ -630,6 +632,98 @@ class JaxEstimator:
         self._predict_fn = telemetry.instrument_jit(
             pred_fn, name="estimator_predict")
 
+    def _start_precompile(self, ds, batch_size: int,
+                          steps_per_loop: int = 1,
+                          with_eval: bool = False):
+        """AOT-compile the train (scan/eval) steps on a background daemon
+        thread, concurrently with first-batch staging. The AOT build seeds
+        JAX's persistent compilation cache, so the hot loop's first jit
+        dispatch deserializes the executable instead of compiling it —
+        step 0 overlaps compile with data load. Entirely best-effort: any
+        failure (streaming dataset with no materialized shapes, exotic
+        shardings) leaves the plain jit path untouched. Returns the
+        warmup thread, or None when there was nothing to precompile."""
+        import threading
+
+        import jax
+
+        compile_ahead.configure_persistent_cache()
+        bs = int(batch_size)
+
+        def batched(extra_lead):
+            def f(a):
+                shape = getattr(a, "shape", None)
+                dtype = getattr(a, "dtype", None)
+                if shape is None or dtype is None:
+                    raise TypeError("dataset tensors are not materialized")
+                return jax.ShapeDtypeStruct(
+                    tuple(extra_lead) + (bs,) + tuple(shape[1:]), dtype)
+            return f
+
+        def state_avals(with_sharding: bool):
+            def f(a):
+                if with_sharding:
+                    sh = getattr(a, "sharding", None)
+                    if sh is not None:
+                        try:
+                            return jax.ShapeDtypeStruct(
+                                a.shape, a.dtype, sharding=sh)
+                        except TypeError:  # older jax: no sharding kwarg
+                            pass
+                arr = a if hasattr(a, "shape") else np.asarray(a)
+                return jax.ShapeDtypeStruct(
+                    tuple(arr.shape), arr.dtype)
+            return jax.tree_util.tree_map(f, self._state)
+
+        try:
+            x_avals = jax.tree_util.tree_map(batched(()), ds.x)
+            y_avals = jax.tree_util.tree_map(batched(()), ds.y)
+            targets = []
+            if steps_per_loop > 1:
+                k = int(steps_per_loop)
+                scan_x = jax.tree_util.tree_map(batched((k,)), ds.x)
+                scan_y = jax.tree_util.tree_map(batched((k,)), ds.y)
+                targets.append(("estimator_train_scan", self._train_scan,
+                                ((scan_x, scan_y),)))
+            else:
+                targets.append(("estimator_train_step", self._train_step,
+                                (x_avals, y_avals)))
+            if with_eval and self._eval_step is not None:
+                ms = [m.init_state() for m in self.metrics]
+                ms_avals = jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(
+                        np.shape(a), np.asarray(a).dtype), ms)
+                targets.append(("estimator_eval_step", self._eval_step,
+                                (ms_avals, x_avals, y_avals)))
+        except Exception:
+            logger.debug("step precompile skipped: dataset shapes "
+                         "unavailable", exc_info=True)
+            return None
+
+        def worker():
+            # the eval step takes the state WITHOUT donating it, the train
+            # step donates — but the aval signature is identical, so one
+            # state tree serves every target
+            for sharded in (True, False):
+                sa = state_avals(sharded)
+                ok = True
+                for name, fn, rest in targets:
+                    if compile_ahead.draining():
+                        return          # interpreter exit: stop compiling
+                    cache = compile_ahead.ExecutableCache(fn, name=name)
+                    if not cache.warm(sa, *rest):
+                        ok = False
+                        break
+                if ok:
+                    return
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name="zoo-warmup-estimator")
+        t.start()
+        compile_ahead.register_warmup_thread(t)
+        self._precompile_thread = t
+        return t
+
     # ------------- public API --------------------------------------------
     def fit(self, data, epochs: int = 1, batch_size: int = 32,
             feature_cols: Optional[Sequence[str]] = None,
@@ -677,6 +771,14 @@ class JaxEstimator:
                   if validation_data is not None else None)
         mesh = self._ensure_mesh()
         self._build_train_step()
+        if val_ds is not None:
+            self._build_eval_step()
+        # compile-ahead: AOT-build the train (and eval) step on a daemon
+        # thread WHILE the first batch stages host-side — step 0's jit
+        # call then deserializes from the persistent compile cache instead
+        # of compiling cold (ISSUE 5 tentpole, third hot path)
+        self._start_precompile(ds, batch_size, steps_per_loop,
+                               with_eval=val_ds is not None)
         if checkpoint_trigger is None and self.model_dir:
             checkpoint_trigger = EveryEpoch()
         if checkpoint_trigger is not None and \

@@ -24,6 +24,9 @@ from __future__ import annotations
 
 from typing import Dict, List
 
+from analytics_zoo_tpu.common.compile_ahead import (  # noqa: F401  (re-exports)
+    WARMUP_TRACE_ID, BucketLadder, ExecutableCache, configure_persistent_cache,
+)
 from analytics_zoo_tpu.common.profiling import (  # noqa: F401  (re-exports)
     FlightRecorder, StepProfiler, backend_state, chrome_trace,
     compiled_step_flops, device_peak_flops, dump_trace, get_flight_recorder,
@@ -43,6 +46,8 @@ __all__ = [
     "chrome_trace", "dump_trace", "StepProfiler", "FlightRecorder",
     "get_flight_recorder", "maybe_arm_from_env", "backend_state",
     "compiled_step_flops", "device_peak_flops", "hbm_bytes",
+    "BucketLadder", "ExecutableCache", "configure_persistent_cache",
+    "WARMUP_TRACE_ID",
 ]
 
 

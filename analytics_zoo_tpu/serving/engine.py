@@ -25,6 +25,7 @@ during every broker round-trip (VERDICT.md weak #5/#7).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Dict, List, Optional
@@ -33,7 +34,7 @@ import numpy as np
 
 # StageTimer moved to the shared pipeline layer; re-exported here because
 # the engine is its historical home.
-from analytics_zoo_tpu.common import telemetry
+from analytics_zoo_tpu.common import compile_ahead, telemetry
 from analytics_zoo_tpu.common.pipeline_io import (  # noqa: F401
     Completed,
     DevicePipeline,
@@ -88,14 +89,28 @@ class ClusterServing:
     measured baseline for bench.py's sync-vs-pipelined comparison).
 
     ``max_batch_size``: cap for adaptive batch growth. Under sustained
-    backlog (every dequeue returns a full batch) the engine doubles its
-    batch bucket up to this cap — fewer, bigger dispatches win when the
-    per-dispatch cost dominates. ``None`` defaults to 4× ``batch_size``;
-    set it equal to ``batch_size`` to pin the bucket.
+    backlog (every dequeue returns a full batch) the engine steps its
+    batch bucket up the ladder to this cap — fewer, bigger dispatches win
+    when the per-dispatch cost dominates. ``None`` defaults to 4×
+    ``batch_size``; set it equal to ``batch_size`` to pin the bucket.
+
+    ``min_batch_size``: the bottom rung the bucket may shrink back to
+    after sustained idle (defaults to ``batch_size``: no shrinking).
+
+    ``warmup``: AOT-compile the whole bucket ladder on a background
+    thread at ``start()`` (and wire the persistent compile cache), so a
+    backlog-driven bucket change is a stall-free swap to an
+    already-compiled rung instead of an in-band XLA compile on the serve
+    thread. On by default for models that support it (InferenceModel);
+    ``ZOO_WARMUP_BUCKETS=0`` disables it process-wide, any other integer
+    caps how many rungs (smallest first) are warmed.
     """
 
     #: consecutive full dequeues that count as "sustained backlog"
     BACKLOG_GROW_AFTER = 8
+    #: consecutive under-half-full dequeues before stepping DOWN one rung
+    #: (bounds pad waste after a burst; empty polls count as idle too)
+    IDLE_SHRINK_AFTER = 32
 
     def __init__(self, model, broker_port: int, batch_size: int = 8,
                  stream: str = INPUT_STREAM, result_key: str = RESULT_HASH,
@@ -107,13 +122,32 @@ class ClusterServing:
                  broker_host: str = "127.0.0.1",
                  image_preprocess=None,
                  pipeline_window: int = 2,
-                 max_batch_size: Optional[int] = None):
+                 max_batch_size: Optional[int] = None,
+                 min_batch_size: Optional[int] = None,
+                 warmup: bool = True):
         self.model = model
         self.batch_size = int(batch_size)
         self.pipeline_window = int(pipeline_window)
         self.max_batch_size = int(max_batch_size) if max_batch_size \
             else 4 * self.batch_size
+        self.min_batch_size = int(min_batch_size) if min_batch_size \
+            else self.batch_size
+        # the bucket ladder spans shrink floor → growth cap; the starting
+        # bucket snaps to a rung so every dispatch shape is a ladder shape
+        self.ladder = compile_ahead.BucketLadder(
+            min(self.min_batch_size, self.batch_size),
+            max(self.max_batch_size, self.batch_size))
+        self.batch_size = self.ladder.rung_for(self.batch_size)
         self._full_streak = 0
+        self._idle_streak = 0
+        # ZOO_WARMUP_BUCKETS: 0 disables compile-ahead warmup, N caps the
+        # rung count (smallest first), unset warms the full ladder
+        raw = os.environ.get("ZOO_WARMUP_BUCKETS", "").strip()
+        self._warmup_enabled = bool(warmup) and raw != "0"
+        limit = int(raw) if raw.isdigit() and int(raw) > 0 else None
+        self._warm_rungs = self.ladder.rungs if limit is None \
+            else self.ladder.rungs[:limit]
+        self._warm_kicked = False
         self.broker_host = broker_host
         self.broker_port = broker_port
         self.stream, self.result_key = stream, result_key
@@ -196,7 +230,9 @@ class ClusterServing:
                                         self.stream, self.batch_size,
                                         block_ms)
         if not entries:
-            self._full_streak = 0
+            # an empty poll is the strongest idle signal there is — it
+            # feeds the same streak accounting as an under-half-full batch
+            self._grow_batch_on_backlog(0)
             return None
         t_dq1 = time.perf_counter()
         self.timer.record("dequeue", t_dq1 - t_dq0)
@@ -263,10 +299,11 @@ class ClusterServing:
         cols = self.input_cols or sorted(rows[0].keys())
         batch = [np.stack([r[c] for r in rows]) for c in cols]
         n = len(rows)
-        if n < self.batch_size:  # pad to the compile bucket
-            batch = [np.concatenate(
-                [b, np.repeat(b[-1:], self.batch_size - n, axis=0)])
-                for b in batch]
+        # pad to the nearest ladder rung at or below the current bucket —
+        # a short dequeue rides a smaller pre-compiled executable instead
+        # of padding all the way up (zoo_bucket_pad_fraction is the waste)
+        rung = min(self.ladder.rung_for(n), self.batch_size)
+        batch = list(compile_ahead.pad_to_rung(batch, rung, site="serving"))
         t_pp1 = time.perf_counter()
         self.timer.record("preprocess", t_pp1 - t0)
         x = batch[0] if len(batch) == 1 else tuple(batch)
@@ -278,21 +315,105 @@ class ClusterServing:
         return x, (uris, err_cmds, ack_cmds, n, trace)
 
     def _grow_batch_on_backlog(self, dequeued: int):
-        """Adaptive batch growth: every dequeue coming back full means the
-        stream is producing faster than we drain — double the compile
-        bucket (one recompile per doubling) up to ``max_batch_size``."""
+        """Adaptive batch-bucket stepping, both directions. Every dequeue
+        coming back full means the stream is producing faster than we
+        drain — step up one ladder rung (capped at ``max_batch_size``).
+        With warmup on, growth is gated on the next rung's executable
+        being built already: the swap is stall-free, and an unready rung
+        pins the streak and (re-)kicks its background compile instead of
+        compiling in-band on the serve thread. Sustained under-half-full
+        dequeues (empty polls included) step back DOWN one rung after
+        ``IDLE_SHRINK_AFTER`` turns, bounding pad waste after a burst."""
         if dequeued >= self.batch_size:
             self._full_streak += 1
+            self._idle_streak = 0
+        elif dequeued * 2 < self.batch_size:
+            self._full_streak = 0
+            self._idle_streak += 1
         else:
             self._full_streak = 0
+            self._idle_streak = 0
         if (self._full_streak >= self.BACKLOG_GROW_AFTER
                 and self.batch_size < self.max_batch_size):
-            self.batch_size = min(2 * self.batch_size, self.max_batch_size)
-            self._full_streak = 0
-            self.timer.record_value("batch_size", self.batch_size)
-            self._batch_gauge.set(self.batch_size)
-            logger.info("sustained backlog: batch bucket grown to %d",
-                        self.batch_size)
+            nxt = self.ladder.up(self.batch_size)
+            if not self._rung_ready(nxt):
+                # hold the current rung until the background compile
+                # lands — swapping now would stall the serve thread on an
+                # XLA compile exactly when backlog is highest
+                self._full_streak = self.BACKLOG_GROW_AFTER
+                self._warm_rung(nxt)
+                return
+            self._set_bucket(nxt, "sustained backlog")
+        elif (self._idle_streak >= self.IDLE_SHRINK_AFTER
+                and self.batch_size > self.min_batch_size):
+            self._set_bucket(self.ladder.down(self.batch_size),
+                             "sustained idle")
+
+    def _set_bucket(self, rung: int, why: str):
+        """One bucket transition: reset both streaks, record the new size
+        on the ``batch_size`` timer series and the serving gauge."""
+        self.batch_size = int(rung)
+        self._full_streak = 0
+        self._idle_streak = 0
+        self.timer.record_value("batch_size", self.batch_size)
+        self._batch_gauge.set(self.batch_size)
+        logger.info("%s: batch bucket -> %d", why, self.batch_size)
+
+    def _rung_ready(self, rung: int) -> bool:
+        """Whether switching to ``rung`` is a stall-free swap. Duck-typed
+        models (no AOT cache) and warmup-disabled engines always read
+        ready — that is the legacy in-band-recompile behavior."""
+        fn = getattr(self.model, "rung_ready", None)
+        if fn is None or not self._warmup_enabled:
+            return True
+        try:
+            return bool(fn(rung))
+        except Exception:
+            return True
+
+    def _warm_rung(self, rung: int):
+        """Kick a background AOT compile of one rung (growth found it
+        cold — e.g. ``ZOO_WARMUP_BUCKETS`` capped the initial warmup)."""
+        fn = getattr(self.model, "warm_up", None)
+        if fn is not None:
+            try:
+                fn(rungs=(rung,))
+            except Exception:
+                logger.debug("rung %d warmup kick failed", rung,
+                             exc_info=True)
+
+    def _kick_warmup(self) -> bool:
+        """Attach the ladder to the model and start the background AOT
+        warmup over ``self._warm_rungs``. Returns False (and stays
+        re-kickable from the serve loop) only when the model supports
+        warmup but cannot describe its input shapes yet."""
+        set_ladder = getattr(self.model, "set_ladder", None)
+        warm_up = getattr(self.model, "warm_up", None)
+        if set_ladder is None or warm_up is None:
+            self._warm_kicked = True   # duck-typed model: nothing to warm
+            return False
+        try:
+            set_ladder(self.ladder)
+            has_spec = getattr(self.model, "has_warm_spec", None)
+            if has_spec is not None and not has_spec():
+                return False           # retry once the model is loaded
+            warm_up(rungs=list(self._warm_rungs))
+            self._warm_kicked = True
+            return True
+        except Exception:
+            logger.exception("ladder warmup failed; serving continues "
+                             "with in-band compiles")
+            self._warm_kicked = True
+            return False
+
+    def wait_warm(self, timeout: Optional[float] = None
+                  ) -> "ClusterServing":
+        """Block until the background ladder compiles finish (tests and
+        bench cold-start timing; no-op for duck-typed models)."""
+        fn = getattr(self.model, "wait_warm", None)
+        if fn is not None:
+            fn(timeout=timeout)
+        return self
 
     def _dispatch(self, x):
         """Device stage: non-blocking when the model supports it (an
@@ -421,6 +542,11 @@ class ClusterServing:
                 if client is None:
                     client = BrokerClient(host=self.broker_host,
                                           port=self.broker_port)
+                if self._warmup_enabled and not self._warm_kicked:
+                    # the model had no input spec at start() (nothing
+                    # loaded yet) — kick the ladder warmup the moment it
+                    # can describe its shapes
+                    self._kick_warmup()
                 self._serve_once(client, pipe)
             except (ConnectionError, OSError):
                 # broker died or the socket went bad: DROP the client and
@@ -458,6 +584,11 @@ class ClusterServing:
         # replica leaves evidence of what its pipeline was doing
         from analytics_zoo_tpu.common import profiling
         profiling.maybe_arm_from_env()
+        if self._warmup_enabled:
+            # persistent XLA cache + background AOT over the whole ladder:
+            # the serve thread then swaps buckets without ever compiling
+            compile_ahead.configure_persistent_cache()
+            self._kick_warmup()
         self._stop.clear()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()

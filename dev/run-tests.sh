@@ -84,7 +84,18 @@ assert dumps, "armed smoke left no flight-recorder dump"
 d = json.load(open(dumps[0]))
 assert d["kind"] == "zoo_flight_recorder" and d["spans"], d.get("kind")
 assert rec.get("flight_recorder") in dumps, "record does not point at dump"
+# compile-ahead serve path (ISSUE 5): after the ladder warmup the burst
+# must cross at least one bucket-growth boundary with ZERO recompiles —
+# a stall-free swap onto an already-AOT-compiled rung
+assert rec.get("serving_post_warmup_recompiles") == 0, \
+    f"serve path recompiled after warmup: {rec.get('serving_post_warmup_recompiles')}"
+assert rec.get("serving_bucket_growth", 0) >= 1, \
+    f"burst never crossed a bucket boundary: {rec.get('serving_bucket_growth')}"
+assert rec.get("serving_cold_start_seconds", -1) >= 0, \
+    "cold-start metric missing from smoke record"
 print(f"flight recorder OK: {len(d['spans'])} spans in {dumps[0]}")
+print(f"compile-ahead OK: growth={rec['serving_bucket_growth']} "
+      f"recompiles=0 cold_start={rec['serving_cold_start_seconds']}s")
 PY
             ;;
   release)  bash "$(dirname "$0")/release.sh" ;;

@@ -1,0 +1,27 @@
+"""Seeded jit-compile-in-serve-loop violations.
+
+Hot-path (``serving/`` segment) module whose drain loop builds XLA
+executables in-band — the stall the compile-ahead layer forbids. Never
+imported; fixture data for dev/run-tests.sh zoolint and
+tests/test_zoolint.py.
+"""
+
+
+def serve_drain_loop(jitted, rungs):
+    exes = []
+    for avals in rungs:
+        # VIOLATION jit-compile-in-serve-loop (.lower with args AND the
+        # chained .compile both flag)
+        exes.append(jitted.lower(*avals).compile())
+    return exes
+
+
+def warm_up(jitted, rungs):
+    """Baselined: warm-named functions are the sanctioned AOT path."""
+    return [jitted.lower(*avals).compile() for avals in rungs]
+
+
+def produce_names(rows):
+    for r in rows:
+        # str.lower() takes no args — never a finding
+        yield r.name.lower()

@@ -33,6 +33,7 @@ def test_rule_registry_complete():
     assert set(rules) == {
         "wallclock-hotpath", "hotpath-host-sync",
         "jit-in-loop", "jit-call-inline", "jit-static-unhashable",
+        "jit-compile-in-serve-loop",
         "engine-unlocked-write", "lock-order",
         "metric-undocumented", "metric-undeclared", "envvar-undocumented",
     }
@@ -197,6 +198,54 @@ def test_local_helper_named_jit_not_flagged():
         return jit(f)(x)
     """
     assert _scan(src, "mod.py") == []
+
+
+# -------------------------------------------------- compile-in-serve-loop
+
+def test_compile_in_serve_loop_flagged():
+    src = """
+    def serve_drain(jitted, rungs):
+        out = []
+        for avals in rungs:
+            out.append(jitted.lower(*avals).compile())
+        return out
+    """
+    fs = _scan(src)
+    assert _rules_of(fs) == ["jit-compile-in-serve-loop"]
+    assert len(fs) == 2   # .lower(*avals) AND the chained .compile()
+
+
+def test_compile_in_serve_loop_baselines():
+    # warm-named functions are the sanctioned AOT path; re.compile and
+    # zero-arg str.lower() are not XLA builds; non-hot packages exempt
+    src = """
+    import re
+    def warm_serve_loop(jitted, rungs):
+        return [jitted.lower(*a).compile() for a in rungs]
+    def produce(rows):
+        for r in rows:
+            if re.compile(r.pat):
+                yield r.name.lower()
+    """
+    assert _scan(src) == []
+    hot_elsewhere = """
+    def serve_drain(jitted, rungs):
+        out = []
+        for avals in rungs:
+            out.append(jitted.lower(*avals).compile())
+        return out
+    """
+    assert _scan(hot_elsewhere, "analytics_zoo_tpu/zouwu/mod.py") == []
+
+
+def test_compile_outside_loop_not_flagged():
+    # one build at function entry (the ExecutableCache miss path) is fine
+    src = """
+    def predict(jitted, avals, x):
+        exe = jitted.lower(*avals).compile()
+        return exe(x)
+    """
+    assert _scan(src) == []
 
 
 # ----------------------------------------------------------- concurrency
@@ -409,6 +458,7 @@ def test_seeded_fixture_trips_every_family():
     assert got == {
         "wallclock-hotpath", "hotpath-host-sync",
         "jit-in-loop", "jit-call-inline", "jit-static-unhashable",
+        "jit-compile-in-serve-loop",
         "engine-unlocked-write", "lock-order",
         "metric-undocumented", "envvar-undocumented",
     }
