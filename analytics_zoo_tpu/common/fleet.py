@@ -1,0 +1,230 @@
+"""Fleet replica registry — who is serving, where, and how much.
+
+The reference platform's Cluster Serving is multi-replica by construction
+(Flink parallelism, SURVEY §3/§6) and BigDL's scale-out accounting
+(arxiv 1804.05839) leans on cluster-wide counter aggregation; our engine
+(serving/engine.py) was a single anonymous process. This module makes
+replicas *discoverable* over the data plane they already share: every
+serving engine heartbeats ``{replica_id, host, port, started_at,
+records_total}`` into one broker hash (``HSET fleet_replicas <id>
+<b64(json)>``), and any frontend can list the hash to find live peers —
+no extra service, no new wire protocol, and the broker's hash TTL
+(broker.py ``hash_ttl_ms``) garbage-collects replicas that die without
+saying goodbye.
+
+``GET /metrics?scope=fleet`` (serving/frontend.py) consumes this registry
+to scrape+merge live replicas' snapshots (telemetry.merge_snapshot);
+``GET /healthz`` reports live/stale counts. Knobs: ``ZOO_FLEET_HEARTBEAT_S``
+(engine heartbeat period, 0 disables), ``ZOO_FLEET_STALE_S`` (age past
+which a replica reads stale).
+
+Timestamps here are WALL clock on purpose: heartbeat ages are compared
+across processes and hosts, where ``perf_counter`` has no shared epoch.
+Staleness tolerances are seconds, far above NTP slew.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import uuid
+from base64 import b64decode, b64encode
+from dataclasses import asdict, dataclass, field
+from typing import Callable, List, Optional, Tuple
+
+from analytics_zoo_tpu.common import telemetry
+
+__all__ = [
+    "REPLICA_HASH", "ReplicaInfo", "ReplicaRegistry", "Heartbeater",
+    "heartbeat_interval_s", "stale_after_s", "default_replica_id",
+]
+
+#: broker hash holding one field per replica (field = replica_id)
+REPLICA_HASH = "fleet_replicas"
+
+
+def heartbeat_interval_s() -> float:
+    """Engine heartbeat period; ``0`` disables fleet registration."""
+    return float(os.environ.get("ZOO_FLEET_HEARTBEAT_S", "2.0"))
+
+
+def stale_after_s() -> float:
+    """Heartbeats older than this read as stale (default: 5 periods —
+    one lost heartbeat must not flap the fleet view)."""
+    raw = os.environ.get("ZOO_FLEET_STALE_S", "").strip()
+    if raw:
+        return float(raw)
+    return 5.0 * max(heartbeat_interval_s(), 1.0)
+
+
+def default_replica_id(stream: str = "serving") -> str:
+    """Unique, uri-charset-safe id: stream + pid + random suffix (two
+    replicas in one process — tests — must not collide)."""
+    return f"{stream}:{os.getpid()}:{uuid.uuid4().hex[:6]}"
+
+
+@dataclass
+class ReplicaInfo:
+    """One replica's heartbeat record (JSON on the wire)."""
+    replica_id: str
+    host: str = "127.0.0.1"
+    port: int = 0                 # metrics/HTTP port (0 = no frontend)
+    started_at: float = 0.0       # wall clock, seconds
+    last_heartbeat: float = 0.0   # wall clock, seconds
+    records_total: int = 0
+    stream: str = "serving_stream"
+    pid: int = field(default_factory=os.getpid)
+
+    def age_s(self, now: Optional[float] = None) -> float:
+        if now is None:
+            now = time.time()  # zoolint: disable=wallclock-hotpath
+        return max(0.0, now - self.last_heartbeat)
+
+    def stale(self, stale_s: Optional[float] = None,
+              now: Optional[float] = None) -> bool:
+        return self.age_s(now) > (stale_after_s() if stale_s is None
+                                  else stale_s)
+
+    def as_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ReplicaInfo":
+        known = {k: d[k] for k in cls.__dataclass_fields__ if k in d}
+        return cls(**known)
+
+
+def _encode(info: ReplicaInfo) -> str:
+    return b64encode(json.dumps(info.as_dict()).encode()).decode()
+
+
+def _decode(val: str) -> ReplicaInfo:
+    return ReplicaInfo.from_dict(json.loads(b64decode(val)))
+
+
+logger = logging.getLogger(__name__)
+
+
+class ReplicaRegistry:
+    """List/publish replicas through the broker hash. Connection-per-call
+    (the broker protocol is connection-oriented and callers live on
+    arbitrary request threads); every method raises broker
+    ``ConnectionError``/``OSError`` to the caller — the frontend maps
+    that to its existing broker-down handling."""
+
+    def __init__(self, broker_host: str = "127.0.0.1",
+                 broker_port: int = 6399, hash_key: str = REPLICA_HASH):
+        self.broker_host = broker_host
+        self.broker_port = int(broker_port)
+        self.hash_key = hash_key
+
+    def _client(self):
+        from analytics_zoo_tpu.serving.broker import BrokerClient
+        return BrokerClient(host=self.broker_host, port=self.broker_port)
+
+    def publish(self, info: ReplicaInfo) -> None:
+        client = self._client()
+        try:
+            client.hset(self.hash_key, info.replica_id, _encode(info))
+        finally:
+            client.close()
+
+    def remove(self, replica_id: str) -> None:
+        client = self._client()
+        try:
+            client.hdel(self.hash_key, replica_id)
+        finally:
+            client.close()
+
+    def list(self) -> List[ReplicaInfo]:
+        client = self._client()
+        try:
+            ids = client.hkeys(self.hash_key)
+            vals = client.pipeline(
+                ("HGET", self.hash_key, rid) for rid in ids) if ids else []
+        finally:
+            client.close()
+        out = []
+        for rid, val in zip(ids, vals):
+            if val is None:
+                continue        # expired between HKEYS and HGET
+            try:
+                out.append(_decode(val))
+            except Exception:
+                logger.warning("undecodable replica record %r", rid)
+        return sorted(out, key=lambda r: r.replica_id)
+
+    def partition(self, stale_s: Optional[float] = None
+                  ) -> Tuple[List[ReplicaInfo], List[ReplicaInfo]]:
+        """(live, stale) split of :meth:`list`, and publish the
+        ``zoo_fleet_replicas`` gauge pair while at it — every caller of
+        the fleet view keeps the gauge current."""
+        now = time.time()  # zoolint: disable=wallclock-hotpath
+        live, stale = [], []
+        for r in self.list():
+            (stale if r.stale(stale_s, now) else live).append(r)
+        gauge = telemetry.get_registry().gauge(
+            "zoo_fleet_replicas",
+            "Serving replicas in the fleet registry by heartbeat state",
+            ("state",))
+        gauge.labels("live").set(len(live))
+        gauge.labels("stale").set(len(stale))
+        return live, stale
+
+
+class Heartbeater:
+    """Engine-owned daemon thread that republishes a replica's record
+    every ``interval_s``. ``info_fn`` builds the fresh :class:`ReplicaInfo`
+    (the engine closes over its live ``records_out``); publish failures
+    count ``zoo_fleet_heartbeat_errors_total`` and never propagate — a
+    flapping broker must not take the serve loop's sidecar down."""
+
+    def __init__(self, registry: ReplicaRegistry,
+                 info_fn: Callable[[], ReplicaInfo],
+                 interval_s: Optional[float] = None):
+        self.registry = registry
+        self.info_fn = info_fn
+        self.interval_s = heartbeat_interval_s() if interval_s is None \
+            else float(interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._errors = telemetry.get_registry().counter(
+            "zoo_fleet_heartbeat_errors_total",
+            "Replica heartbeats that failed to publish", ("replica",))
+
+    def beat_once(self) -> bool:
+        info = self.info_fn()
+        try:
+            self.registry.publish(info)
+            return True
+        except Exception:
+            self._errors.labels(info.replica_id).inc()
+            return False
+
+    def _run(self):
+        while not self._stop.is_set():
+            self.beat_once()
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "Heartbeater":
+        if self._thread is not None or self.interval_s <= 0:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="zoo-fleet-heartbeat")
+        self._thread.start()
+        return self
+
+    def stop(self, deregister: bool = True):
+        t, self._thread = self._thread, None
+        self._stop.set()
+        if t is not None:
+            t.join(timeout=5)
+        if deregister:
+            try:
+                self.registry.remove(self.info_fn().replica_id)
+            except Exception:
+                pass            # broker already gone: TTL will collect us

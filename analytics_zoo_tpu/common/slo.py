@@ -1,0 +1,374 @@
+"""Burn-rate SLO monitor — declarative latency/availability objectives
+evaluated from the telemetry registry (ISSUE 6 piece 3).
+
+The TPU serving comparison (PAPERS.md, arxiv 2605.25645) is blunt that
+tail latency degrades first under mixed traffic; raw queue depth — what
+``/healthz`` used to shed on — moves long after p99 already blew the
+objective. This module turns the registry's histograms/counters into the
+SRE-workbook signal instead:
+
+- an :class:`SLO` declares a target: "99% of records complete within
+  ``threshold_s``" (latency, read from a histogram's bucket counts) or
+  "99.9% of records succeed" (availability, read from a counter pair);
+- :class:`SLOMonitor` samples the cumulative series on every ``tick()``,
+  keeps a bounded ring of timestamped samples, and computes the **burn
+  rate** per rolling window: ``bad_fraction / (1 - objective)`` — burn 1.0
+  spends the error budget exactly at the sustainable rate, burn N spends
+  it N× too fast;
+- burns are published as ``zoo_slo_burn_rate{slo,window}`` (and the
+  shed decision as ``zoo_slo_shedding``), served by ``GET /slo``, and
+  drive the frontend's ``/healthz`` 503: **multi-window** agreement (all
+  windows burning past ``ZOO_SLO_SHED_BURN``) sheds load, so a one-batch
+  blip cannot flap the fleet while a sustained burn trips within the
+  short window.
+
+Knobs: ``ZOO_SLO_P99_MS`` (default latency threshold, ms),
+``ZOO_SLO_AVAILABILITY`` (default availability objective),
+``ZOO_SLO_WINDOWS`` (comma-separated rolling windows, seconds),
+``ZOO_SLO_SHED_BURN`` (burn past which all-window agreement sheds),
+``ZOO_SLO_TICK_S`` (sampling period for the ticker/`tick_if_stale`).
+
+Stdlib-only; clocks are monotonic throughout (window arithmetic must not
+see NTP steps).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from time import monotonic
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from analytics_zoo_tpu.common import telemetry
+
+__all__ = [
+    "SLO", "SLOMonitor", "default_slos", "get_monitor", "set_monitor",
+    "reset_for_tests",
+]
+
+
+def _windows_from_env() -> Tuple[float, ...]:
+    raw = os.environ.get("ZOO_SLO_WINDOWS", "60,300")
+    out = []
+    for part in raw.split(","):
+        part = part.strip()
+        if part:
+            out.append(max(1.0, float(part)))
+    return tuple(out) or (60.0, 300.0)
+
+
+@dataclass(frozen=True)
+class SLO:
+    """One declarative objective over registry series.
+
+    ``kind="latency"``: ``objective`` of observations in histogram
+    ``metric`` must land at or under ``threshold_s`` (good = count in
+    buckets whose upper edge ≥ threshold covers it). ``kind=
+    "availability"``: ``objective`` of events must be good, where good
+    rides counter ``metric`` and bad rides counter ``bad_metric``.
+    Label children of a family are summed — the SLO is per process (or
+    per fleet, when evaluated over a merged snapshot)."""
+
+    name: str
+    kind: str                                  # "latency" | "availability"
+    objective: float                           # good fraction target (0..1)
+    metric: str
+    threshold_s: Optional[float] = None        # latency only
+    bad_metric: Optional[str] = None           # availability only
+
+    def __post_init__(self):
+        if self.kind not in ("latency", "availability"):
+            raise ValueError(f"unknown SLO kind {self.kind!r}")
+        if not 0.0 < self.objective < 1.0:
+            raise ValueError("objective must be in (0, 1)")
+        if self.kind == "latency" and not self.threshold_s:
+            raise ValueError("latency SLO needs threshold_s")
+        if self.kind == "availability" and not self.bad_metric:
+            raise ValueError("availability SLO needs bad_metric")
+
+
+def default_slos() -> List[SLO]:
+    """The serving defaults: p99 end-to-end latency under
+    ``ZOO_SLO_P99_MS`` (default 1000 ms) and record availability at
+    ``ZOO_SLO_AVAILABILITY`` (default 0.999)."""
+    p99_ms = float(os.environ.get("ZOO_SLO_P99_MS", "1000"))
+    avail = float(os.environ.get("ZOO_SLO_AVAILABILITY", "0.999"))
+    return [
+        SLO(name="serving_p99_latency", kind="latency", objective=0.99,
+            metric="zoo_serving_latency_seconds",
+            threshold_s=p99_ms / 1000.0),
+        SLO(name="serving_availability", kind="availability",
+            objective=avail, metric="zoo_serving_records_total",
+            bad_metric="zoo_serving_record_errors_total"),
+    ]
+
+
+def _entries(fam: Any) -> List[Dict[str, Any]]:
+    """Histogram entries of a snapshot family (labelled or collapsed)."""
+    if fam is None:
+        return []
+    if isinstance(fam, dict) and "count" in fam and "le" in fam:
+        return [fam]
+    if isinstance(fam, dict):
+        return [v for v in fam.values()
+                if isinstance(v, dict) and "count" in v and "le" in v]
+    return []
+
+
+def _scalar_total(fam: Any) -> float:
+    if fam is None:
+        return 0.0
+    if isinstance(fam, (int, float)):
+        return float(fam)
+    if isinstance(fam, dict):
+        return float(sum(v for v in fam.values()
+                         if isinstance(v, (int, float))))
+    return 0.0
+
+
+def _sample_slo(slo: SLO, snap: Dict[str, Any]) -> Dict[str, Any]:
+    """One cumulative sample of the series an SLO watches."""
+    if slo.kind == "latency":
+        le: List[float] = []
+        counts: List[int] = []
+        total = 0
+        for e in _entries(snap.get(slo.metric)):
+            if not le:
+                le = list(e["le"])
+                counts = [0] * len(e["bucket_counts"])
+            if list(e["le"]) != le:
+                continue        # mismatched child buckets: skip, not lie
+            counts = [a + int(b)
+                      for a, b in zip(counts, e["bucket_counts"])]
+            total += int(e["count"])
+        return {"le": le, "counts": counts, "count": total}
+    return {"good": _scalar_total(snap.get(slo.metric)),
+            "bad": _scalar_total(snap.get(slo.bad_metric))}
+
+
+def _good_bad_delta(slo: SLO, old: Dict[str, Any],
+                    new: Dict[str, Any]) -> Tuple[float, float]:
+    """(good, bad) event deltas between two cumulative samples. Clamped
+    at 0 so a registry reset (tests) reads as an empty window, never a
+    negative one."""
+    if slo.kind == "latency":
+        le = new.get("le") or []
+        if not le or old.get("le") not in (None, [], le):
+            return 0.0, 0.0
+        d_total = max(0, new["count"] - (old.get("count") or 0))
+        if d_total == 0:
+            return 0.0, 0.0
+        old_counts = old.get("counts") or [0] * len(new["counts"])
+        # good = observations in buckets fully at/under the threshold
+        # (first edge ≥ threshold still counts: v ≤ edge ⇒ within SLO
+        # only when edge ≤ threshold, so use edges ≤ threshold + ulp)
+        good = 0
+        for edge, n_new, n_old in zip(le, new["counts"], old_counts):
+            if edge <= slo.threshold_s * (1 + 1e-9):
+                good += max(0, int(n_new) - int(n_old))
+        return float(min(good, d_total)), float(max(0, d_total - good))
+    d_good = max(0.0, new["good"] - (old.get("good") or 0.0))
+    d_bad = max(0.0, new["bad"] - (old.get("bad") or 0.0))
+    return d_good, d_bad
+
+
+@dataclass
+class _WindowBurn:
+    window_s: float
+    events: float = 0.0
+    bad: float = 0.0
+    bad_fraction: float = 0.0
+    burn: float = 0.0
+    covered_s: float = 0.0     # how much of the window samples span
+
+
+class SLOMonitor:
+    """Rolling-window burn rates over the process registry.
+
+    ``tick()`` is the one state transition: sample the cumulative series,
+    recompute every (slo, window) burn, publish the gauges. Call it from
+    the daemon ticker (``start()``), from a request handler via
+    ``tick_if_stale()`` (the frontend's mode — no thread, sampling rides
+    the health-check cadence), or directly in tests."""
+
+    def __init__(self, slos: Optional[Sequence[SLO]] = None,
+                 windows: Optional[Sequence[float]] = None,
+                 shed_burn: Optional[float] = None,
+                 tick_s: Optional[float] = None):
+        self.slos: Tuple[SLO, ...] = tuple(
+            default_slos() if slos is None else slos)
+        self.windows: Tuple[float, ...] = tuple(
+            _windows_from_env() if windows is None else
+            tuple(max(1.0, float(w)) for w in windows))
+        self.shed_burn = float(
+            os.environ.get("ZOO_SLO_SHED_BURN", "2.0")
+            if shed_burn is None else shed_burn)
+        self.tick_s = float(
+            os.environ.get("ZOO_SLO_TICK_S", "1.0")
+            if tick_s is None else tick_s)
+        self._lock = threading.Lock()
+        retain = int(max(self.windows) / max(self.tick_s, 1e-3)) + 8
+        self._samples: "deque[Tuple[float, Dict[str, Dict]]]" = deque(
+            maxlen=min(retain, 4096))
+        self._burns: Dict[str, Dict[str, _WindowBurn]] = {}
+        self._last_tick = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------------- sampling
+    def tick(self, now: Optional[float] = None) -> None:
+        now = monotonic() if now is None else float(now)
+        snap = telemetry.snapshot()
+        sample = {slo.name: _sample_slo(slo, snap) for slo in self.slos}
+        reg = telemetry.get_registry()
+        burn_gauge = reg.gauge(
+            "zoo_slo_burn_rate",
+            "Error-budget burn rate per SLO and rolling window "
+            "(1.0 = spending the budget exactly at the sustainable rate)",
+            ("slo", "window"))
+        shed_gauge = reg.gauge(
+            "zoo_slo_shedding",
+            "1 while burn-rate load shedding is active (all windows past "
+            "ZOO_SLO_SHED_BURN for some SLO)")
+        with self._lock:
+            self._samples.append((now, sample))
+            self._last_tick = now
+            burns: Dict[str, Dict[str, _WindowBurn]] = {}
+            for slo in self.slos:
+                per_win: Dict[str, _WindowBurn] = {}
+                for w in self.windows:
+                    old_t, old = self._sample_at(now - w)
+                    good, bad = _good_bad_delta(
+                        slo, old.get(slo.name, {}), sample[slo.name])
+                    events = good + bad
+                    frac = bad / events if events else 0.0
+                    burn = frac / max(1e-9, 1.0 - slo.objective)
+                    per_win[f"{int(w)}s"] = _WindowBurn(
+                        window_s=w, events=events, bad=bad,
+                        bad_fraction=frac, burn=burn,
+                        covered_s=max(0.0, now - old_t))
+                burns[slo.name] = per_win
+            self._burns = burns
+            shedding = self._overloaded_locked()
+        for name, per_win in burns.items():
+            for wname, wb in per_win.items():
+                burn_gauge.labels(name, wname).set(round(wb.burn, 6))
+        shed_gauge.set(1.0 if shedding else 0.0)
+
+    def _sample_at(self, t: float) -> Tuple[float, Dict[str, Dict]]:
+        """The newest sample taken at or before ``t`` — the window's
+        start point; falls back to the oldest held sample (partial
+        window) so a young process still reports."""
+        best = self._samples[0]
+        for s in self._samples:
+            if s[0] <= t:
+                best = s
+            else:
+                break
+        return best
+
+    def tick_if_stale(self) -> None:
+        """Tick when the last sample is older than ``tick_s`` — lets the
+        health-check cadence drive sampling without a dedicated thread."""
+        with self._lock:
+            stale = (monotonic() - self._last_tick) >= self.tick_s
+        if stale:
+            self.tick()
+
+    # ----------------------------------------------------------- reading
+    def burn_rates(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {name: {w: wb.burn for w, wb in per.items()}
+                    for name, per in self._burns.items()}
+
+    def _overloaded_locked(self) -> bool:
+        for per_win in self._burns.values():
+            if per_win and all(wb.burn > self.shed_burn
+                               for wb in per_win.values()):
+                return True
+        return False
+
+    def overloaded(self) -> bool:
+        """Shed? True when, for some SLO, EVERY window burns past
+        ``shed_burn`` — the multi-window guard against flapping."""
+        with self._lock:
+            return self._overloaded_locked()
+
+    def report(self) -> Dict[str, Any]:
+        """The ``GET /slo`` payload."""
+        with self._lock:
+            slos = []
+            for slo in self.slos:
+                per = self._burns.get(slo.name, {})
+                slos.append({
+                    "name": slo.name, "kind": slo.kind,
+                    "objective": slo.objective,
+                    "threshold_s": slo.threshold_s,
+                    "metric": slo.metric,
+                    "windows": {
+                        w: {"burn": round(wb.burn, 6),
+                            "bad_fraction": round(wb.bad_fraction, 6),
+                            "events": wb.events,
+                            "covered_s": round(wb.covered_s, 3)}
+                        for w, wb in per.items()},
+                })
+            return {"slos": slos, "shedding": self._overloaded_locked(),
+                    "shed_burn": self.shed_burn,
+                    "windows_s": list(self.windows),
+                    "samples_held": len(self._samples)}
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "SLOMonitor":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def run():
+            while not self._stop.is_set():
+                try:
+                    self.tick()
+                except Exception:
+                    pass        # the monitor must never take a host down
+                self._stop.wait(self.tick_s)
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="zoo-slo-monitor")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        t, self._thread = self._thread, None
+        self._stop.set()
+        if t is not None:
+            t.join(timeout=5)
+
+
+# ------------------------------------------------------------ process-wide
+
+_MONITOR: Optional[SLOMonitor] = None
+_MONITOR_LOCK = threading.Lock()
+
+
+def get_monitor() -> SLOMonitor:
+    """Lazy default monitor (env-configured SLOs, no ticker thread —
+    sampling rides health-check reads via ``tick_if_stale`` unless the
+    caller ``start()``s it)."""
+    global _MONITOR
+    with _MONITOR_LOCK:
+        if _MONITOR is None:
+            _MONITOR = SLOMonitor()
+        return _MONITOR
+
+
+def set_monitor(monitor: Optional[SLOMonitor]) -> None:
+    global _MONITOR
+    with _MONITOR_LOCK:
+        old, _MONITOR = _MONITOR, monitor
+    if old is not None and old is not monitor:
+        old.stop()
+
+
+def reset_for_tests():
+    set_monitor(None)

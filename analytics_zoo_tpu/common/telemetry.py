@@ -55,6 +55,11 @@ __all__ = [
 DEFAULT_BUCKETS = (1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.025, 0.05, 0.1, 0.25,
                    0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
 RESERVOIR_SIZE = 1024
+#: how many reservoir samples ride a JSON snapshot per histogram series —
+#: enough for stable p50/p99 on the merged side, small enough that a
+#: snapshot stays a one-line payload (fleet scrapes and BENCH records
+#: both carry it)
+SNAPSHOT_RESERVOIR = 256
 
 _NAME_OK = frozenset(
     "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_:")
@@ -261,6 +266,113 @@ class _Family:
         return self._default().quantile(q)
 
 
+# ------------------------------------------------- snapshot merge algebra
+
+def _subsample_sorted(xs: List[float], cap: int) -> List[float]:
+    """Deterministic even-stride subsample of an already-sorted list —
+    keeps the quantile structure (min/max always survive) with no RNG."""
+    n = len(xs)
+    if n <= cap:
+        return list(xs)
+    # spread cap picks over [0, n-1] inclusive of both ends
+    return [xs[(i * (n - 1)) // (cap - 1)] for i in range(cap)]
+
+
+def _is_hist_entry(v: Any) -> bool:
+    return isinstance(v, dict) and "count" in v and "le" in v
+
+
+def _copy_entry(v: Any) -> Any:
+    if isinstance(v, dict):
+        return {k: list(x) if isinstance(x, (list, tuple)) else x
+                for k, x in v.items()}
+    return v
+
+
+def _copy_snapshot(snap: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for name, val in snap.items():
+        if isinstance(val, dict) and not _is_hist_entry(val):
+            out[name] = {k: _copy_entry(v) for k, v in val.items()}
+        else:
+            out[name] = _copy_entry(val)
+    return out
+
+
+def _parse_label_key(key: str) -> Tuple[Tuple[str, ...], Tuple[str, ...]]:
+    """Invert snapshot()'s ``k=v,k2=v2`` label-key encoding."""
+    if not key:
+        return (), ()
+    names, values = [], []
+    for pair in key.split(","):
+        k, _, v = pair.partition("=")
+        names.append(k)
+        values.append(v)
+    return tuple(names), tuple(values)
+
+
+def _bucket_quantile(le: Sequence[float], bucket_counts: Sequence[int],
+                     q: float, hi: Optional[float] = None) -> Optional[float]:
+    """Quantile from per-bucket counts: the upper edge of the bucket the
+    q-th observation falls in — within one bucket width of the true
+    stream quantile by construction (what the merge-algebra test pins).
+    ``hi`` caps the +Inf bucket (largest reservoir sample when known)."""
+    total = sum(bucket_counts)
+    if total <= 0:
+        return None
+    rank = max(1, int(math.ceil(q * total)))
+    cum = 0
+    for i, c in enumerate(bucket_counts):
+        cum += c
+        if cum >= rank:
+            if i < len(le):
+                return float(le[i])
+            return float(hi) if hi is not None else float(le[-1])
+    return float(hi) if hi is not None else float(le[-1])
+
+
+def _merge_hist_entry(name: str, a: Dict[str, Any],
+                      b: Dict[str, Any]) -> Dict[str, Any]:
+    if list(a["le"]) != list(b["le"]):
+        raise ValueError(
+            f"histogram {name!r}: bucket edges differ, cannot merge")
+    counts = [int(x) + int(y)
+              for x, y in zip(a["bucket_counts"], b["bucket_counts"])]
+    total = int(a["count"]) + int(b["count"])
+    s = float(a["sum"]) + float(b["sum"])
+    res = sorted(list(a.get("reservoir", ())) + list(b.get("reservoir", ())))
+    hi = res[-1] if res else None
+    return {"count": total, "sum": s,
+            "mean": s / total if total else 0.0,
+            "p50": _bucket_quantile(a["le"], counts, 0.5, hi),
+            "p99": _bucket_quantile(a["le"], counts, 0.99, hi),
+            "le": list(a["le"]), "bucket_counts": counts,
+            "reservoir": _subsample_sorted(res, SNAPSHOT_RESERVOIR)}
+
+
+def _merge_family(name: str, a: Any, b: Any) -> Any:
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        return a + b
+    if _is_hist_entry(a) and _is_hist_entry(b):
+        return _merge_hist_entry(name, a, b)
+    if isinstance(a, dict) and isinstance(b, dict) \
+            and not _is_hist_entry(a) and not _is_hist_entry(b):
+        out = {k: _copy_entry(v) for k, v in a.items()}
+        for k, v in b.items():
+            if k not in out:
+                out[k] = _copy_entry(v)
+            elif _is_hist_entry(out[k]) and _is_hist_entry(v):
+                out[k] = _merge_hist_entry(name, out[k], v)
+            elif isinstance(out[k], (int, float)) \
+                    and isinstance(v, (int, float)):
+                out[k] = out[k] + v
+            else:
+                raise ValueError(
+                    f"series {name}{{{k}}}: incompatible snapshot shapes")
+        return out
+    raise ValueError(f"family {name!r}: incompatible snapshot shapes")
+
+
 class MetricsRegistry:
     """Thread-safe registry of metric families. ``counter``/``gauge``/
     ``histogram`` are get-or-create (idempotent for a matching kind, error
@@ -345,8 +457,13 @@ class MetricsRegistry:
 
     def snapshot(self) -> Dict[str, Any]:
         """JSON-able view: counters/gauges as values, histograms as
-        {count, sum, mean, p50, p99} — what rides BENCH records and the
-        JSON ``/metrics`` response."""
+        {count, sum, mean, p50, p99, le, bucket_counts, reservoir} — what
+        rides BENCH records and the JSON ``/metrics`` response. ``le`` is
+        the bucket upper-edge list and ``bucket_counts`` the per-bucket
+        (NOT cumulative) counts with the +Inf bucket last, so two
+        snapshots of the same series are mergeable by addition
+        (:meth:`merge_snapshot`); ``reservoir`` is a sorted deterministic
+        subsample (≤ ``SNAPSHOT_RESERVOIR``) of the quantile reservoir."""
         out: Dict[str, Any] = {}
         for fam in self.families():
             entries = {}
@@ -356,7 +473,7 @@ class MetricsRegistry:
                 if fam.kind in ("counter", "gauge"):
                     entries[key] = child.value
                 else:
-                    _, total, s, res = child._state()
+                    counts, total, s, res = child._state()
                     mean = s / total if total else 0.0
                     xs = sorted(res)
 
@@ -366,13 +483,79 @@ class MetricsRegistry:
                         return xs[min(len(xs) - 1,
                                       max(0, int(math.ceil(q * len(xs))) - 1))]
 
-                    entries[key] = {"count": total, "sum": s, "mean": mean,
-                                    "p50": pq(0.5), "p99": pq(0.99)}
+                    entries[key] = {
+                        "count": total, "sum": s, "mean": mean,
+                        "p50": pq(0.5), "p99": pq(0.99),
+                        "le": list(child.buckets),
+                        "bucket_counts": list(counts),
+                        "reservoir": _subsample_sorted(
+                            xs, SNAPSHOT_RESERVOIR),
+                    }
             if list(entries) == [""]:
                 out[fam.name] = entries[""]
             elif entries:
                 out[fam.name] = entries
         return out
+
+    # ---------------------------------------------------------- federation
+    @staticmethod
+    def merge_snapshot(base: Dict[str, Any],
+                       other: Dict[str, Any]) -> Dict[str, Any]:
+        """Fold snapshot ``other`` into snapshot ``base`` and return the
+        merged dict (inputs are not mutated). Counters and gauges add
+        (summing is the only associative choice for gauges; a fleet-wide
+        gauge reads as a total), histogram series add bucket counts /
+        count / sum and take a subsampled union of the reservoirs. Raises
+        ``ValueError`` when the same series has incompatible shapes
+        (histogram-vs-scalar, differing ``le`` edges) — the fleet scraper
+        treats that replica as a failed scrape rather than corrupting the
+        aggregate."""
+        out = _copy_snapshot(base)
+        for name, val in other.items():
+            if name not in out:
+                out[name] = _copy_snapshot({name: val})[name]
+                continue
+            out[name] = _merge_family(name, out[name], val)
+        return out
+
+    @classmethod
+    def from_snapshot(cls, snap: Dict[str, Any]) -> "MetricsRegistry":
+        """Rebuild a registry from a (possibly merged) snapshot so the
+        aggregate can be re-exposed (``prometheus_text``) or re-snapshot.
+        Kinds are inferred: histogram entries carry ``le``/``count``;
+        scalars named ``*_total`` are counters, the rest gauges. Label
+        keys round-trip through the snapshot's ``k=v,k2=v2`` encoding
+        (label VALUES therefore must not contain ``,`` or ``=`` — true
+        for every catalog metric). Entries that are not valid metric
+        families (e.g. ``trace_ids_held``) are skipped."""
+        reg = cls()
+        for name, val in snap.items():
+            try:
+                entries = val if isinstance(val, dict) and \
+                    not _is_hist_entry(val) else {"": val}
+                for key, entry in entries.items():
+                    labelnames, labelvalues = _parse_label_key(key)
+                    if _is_hist_entry(entry):
+                        fam = reg.histogram(name, labelnames=labelnames,
+                                            buckets=entry["le"])
+                        child = fam.labels(*labelvalues)
+                        with child._lock:
+                            child._bucket_counts = [
+                                int(c) for c in entry["bucket_counts"]]
+                            child._count = int(entry["count"])
+                            child._sum = float(entry["sum"])
+                            child._reservoir = [
+                                float(v) for v in entry.get("reservoir", [])]
+                    elif isinstance(entry, (int, float)):
+                        kind = reg.counter if name.endswith("_total") \
+                            else reg.gauge
+                        child = kind(name, labelnames=labelnames).labels(
+                            *labelvalues)
+                        with child._lock:
+                            child._value = float(entry)
+            except (ValueError, KeyError, TypeError):
+                continue
+        return reg
 
 
 # ----------------------------------------------------------------- tracing
@@ -564,6 +747,9 @@ def reset_for_tests():
     prof = sys.modules.get("analytics_zoo_tpu.common.profiling")
     if prof is not None:
         prof.reset_for_tests()
+    slo = sys.modules.get("analytics_zoo_tpu.common.slo")
+    if slo is not None:
+        slo.reset_for_tests()
 
 
 def bench_snapshot() -> Dict[str, Any]:

@@ -15,9 +15,17 @@ Profiling layer (ISSUE 3)::
     obs.get_flight_recorder().dump()  # postmortem under zoo_tpu_logs/
     obs.backend_state()               # non-blocking backend/device probe
 
+Fleet & SLO layer (ISSUE 6)::
+
+    obs.merge_snapshot(a, b)   # mergeable-snapshot algebra (federation)
+    obs.fleet_registry(port=p) # list/partition live serving replicas
+    obs.get_slo_monitor()      # burn-rate SLO monitor (GET /slo payload)
+
 The serving FrontEnd exposes the same data over HTTP (``GET /metrics``
-content-negotiated JSON/Prometheus, ``GET /healthz`` with backend state,
-``GET /trace``); see docs/observability.md for the stable metric catalog.
+content-negotiated JSON/Prometheus — ``?scope=fleet`` for the merged
+fleet view, ``?format=snapshot`` for the mergeable wire format —
+``GET /healthz`` with fleet/SLO state, ``GET /trace``, ``GET /slo``);
+see docs/observability.md for the stable metric catalog.
 """
 
 from __future__ import annotations
@@ -27,6 +35,13 @@ from typing import Dict, List
 from analytics_zoo_tpu.common.compile_ahead import (  # noqa: F401  (re-exports)
     WARMUP_TRACE_ID, BucketLadder, ExecutableCache, configure_persistent_cache,
 )
+from analytics_zoo_tpu.common.fleet import (  # noqa: F401  (re-exports)
+    Heartbeater, ReplicaInfo, ReplicaRegistry,
+)
+from analytics_zoo_tpu.common.slo import (  # noqa: F401  (re-exports)
+    SLO, SLOMonitor, default_slos,
+)
+from analytics_zoo_tpu.common.slo import get_monitor as get_slo_monitor  # noqa: F401
 from analytics_zoo_tpu.common.profiling import (  # noqa: F401  (re-exports)
     FlightRecorder, StepProfiler, backend_state, chrome_trace,
     compiled_step_flops, device_peak_flops, dump_trace, get_flight_recorder,
@@ -48,7 +63,23 @@ __all__ = [
     "compiled_step_flops", "device_peak_flops", "hbm_bytes",
     "BucketLadder", "ExecutableCache", "configure_persistent_cache",
     "WARMUP_TRACE_ID",
+    "merge_snapshot", "fleet_registry", "ReplicaRegistry", "ReplicaInfo",
+    "Heartbeater", "SLO", "SLOMonitor", "default_slos", "get_slo_monitor",
 ]
+
+
+def merge_snapshot(base: Dict, other: Dict) -> Dict:
+    """Merge two registry snapshots (the federation algebra): counters
+    and gauges sum, histograms add bucket counts and union reservoirs.
+    See :meth:`MetricsRegistry.merge_snapshot`."""
+    return MetricsRegistry.merge_snapshot(base, other)
+
+
+def fleet_registry(host: str = "127.0.0.1", port: int = 6399
+                   ) -> ReplicaRegistry:
+    """A :class:`ReplicaRegistry` over the given broker — ``.list()`` /
+    ``.partition()`` enumerate serving replicas by heartbeat."""
+    return ReplicaRegistry(host, port)
 
 
 def scrape() -> str:

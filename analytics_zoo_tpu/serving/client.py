@@ -15,6 +15,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from analytics_zoo_tpu.common import telemetry
 from analytics_zoo_tpu.serving.broker import BrokerClient
 from analytics_zoo_tpu.serving import schema
 
@@ -33,6 +34,7 @@ class InputQueue:
         self.stream = stream
         self.cipher = cipher
         self.arrow = bool(arrow)
+        self._tracer = telemetry.get_tracer()
 
     @staticmethod
     def _coerce(v):
@@ -49,15 +51,31 @@ class InputQueue:
             return schema.ImageBytes(bytes(v))
         return np.asarray(v)
 
-    def _encode(self, uri: Optional[str], inputs: Dict) -> "tuple[str, str]":
+    def _encode(self, uri: Optional[str], inputs: Dict
+                ) -> "tuple[str, str, Optional[tuple]]":
+        """(uri, payload, trace) — ``trace`` is ``(t_enc_pc, sampled)``
+        for natively-encoded records (the stamp the engine's queue-wait
+        accounting reads), None for Arrow records (the reference wire
+        format has no side channel)."""
         if not inputs:
             raise ValueError("enqueue needs at least one named tensor")
         uri = schema.validate_uri(uri or uuid.uuid4().hex)
         coerced = {k: self._coerce(v) for k, v in inputs.items()}
-        enc = (schema.encode_record_arrow if self.arrow
-               else schema.encode_record)
-        payload = enc(uri, coerced, self.cipher)
-        return uri, payload
+        if self.arrow:
+            return uri, schema.encode_record_arrow(
+                uri, coerced, self.cipher), None
+        # dual-clock stamp: perf_counter is CLOCK_MONOTONIC on Linux
+        # (comparable across processes on ONE host — the engine checks
+        # plausibility before trusting it); t_wall is the cross-host
+        # fallback, tolerant of NTP slew at queue-wait magnitudes
+        sampled = self._tracer.should_sample()
+        t_pc = time.perf_counter()
+        trace = {"id": uri, "t_pc": t_pc,
+                 "t_wall": time.time(),  # zoolint: disable=wallclock-hotpath
+                 "s": int(sampled)}
+        payload = schema.encode_record(uri, coerced, self.cipher,
+                                       trace=trace)
+        return uri, payload, (t_pc, sampled)
 
     def enqueue(self, uri: Optional[str] = None, **inputs) -> str:
         """``enqueue("img1", x=ndarray)``; returns the uri (generated when
@@ -65,8 +83,13 @@ class InputQueue:
         ``enqueue("img1", image=jpeg_bytes)`` sends the raw encoded image
         for engine-side decode + preprocessing (``enqueue_image`` for
         file paths)."""
-        uri, payload = self._encode(uri, inputs)
+        uri, payload, trace = self._encode(uri, inputs)
         self._client.xadd(self.stream, payload)
+        if trace is not None and trace[1]:
+            # encode + broker write, on the record's own trace id — the
+            # timeline head GET /trace?uri= shows before queue_wait
+            self._tracer.record(uri, "client_enqueue", trace[0],
+                                time.perf_counter())
         return uri
 
     def enqueue_image(self, uri: Optional[str] = None, image=None,
@@ -92,12 +115,17 @@ class InputQueue:
         redis-py pipeline of XADDs). ``records`` is an iterable of
         ``(uri, {name: tensor, ...})`` pairs; pass ``None`` as a uri to
         have one generated. Returns the uris in order."""
-        uris, cmds = [], []
+        uris, cmds, traces = [], [], []
         for uri, inputs in records:
-            uri, payload = self._encode(uri, inputs)
+            uri, payload, trace = self._encode(uri, inputs)
             uris.append(uri)
+            traces.append(trace)
             cmds.append(("XADD", self.stream, payload))
         self._client.pipeline(cmds)
+        t1 = time.perf_counter()
+        for uri, trace in zip(uris, traces):
+            if trace is not None and trace[1]:
+                self._tracer.record(uri, "client_enqueue", trace[0], t1)
         return uris
 
     def __len__(self):

@@ -34,7 +34,7 @@ import numpy as np
 
 # StageTimer moved to the shared pipeline layer; re-exported here because
 # the engine is its historical home.
-from analytics_zoo_tpu.common import compile_ahead, telemetry
+from analytics_zoo_tpu.common import compile_ahead, fleet, telemetry
 from analytics_zoo_tpu.common.pipeline_io import (  # noqa: F401
     Completed,
     DevicePipeline,
@@ -124,7 +124,8 @@ class ClusterServing:
                  pipeline_window: int = 2,
                  max_batch_size: Optional[int] = None,
                  min_batch_size: Optional[int] = None,
-                 warmup: bool = True):
+                 warmup: bool = True,
+                 replica_id: Optional[str] = None):
         self.model = model
         self.batch_size = int(batch_size)
         self.pipeline_window = int(pipeline_window)
@@ -186,6 +187,24 @@ class ClusterServing:
             "Current adaptive compile-bucket batch size",
             ("stream",)).labels(stream)
         self._batch_gauge.set(self.batch_size)
+        # first-class queue wait + end-to-end latency (ISSUE 6): stamped
+        # client-side (schema trace meta), measured here — the fleet's
+        # backlog signal and the SLO monitor's p99 source
+        self._wait_hist = reg.histogram(
+            "zoo_queue_wait_seconds",
+            "Broker queue wait: client enqueue to engine dequeue",
+            ("stream",)).labels(stream)
+        self._latency_hist = reg.histogram(
+            "zoo_serving_latency_seconds",
+            "End-to-end record latency: client enqueue to result flush",
+            ("stream",)).labels(stream)
+        # fleet identity: heartbeats ride the broker hash so any frontend
+        # can enumerate live replicas (common/fleet.py); the frontend
+        # fills in the advertised metrics host/port at start()
+        self.replica_id = replica_id or fleet.default_replica_id(stream)
+        self._advertise = ("127.0.0.1", 0)
+        self._started_wall = 0.0
+        self._heartbeater: Optional[fleet.Heartbeater] = None
 
     def _decode_images(self, inputs):
         """Decode any raw-image entries and run the preprocessing chain
@@ -247,13 +266,14 @@ class ClusterServing:
         err_cmds: list = []
         ack_cmds = [("XACK", self.stream, self.group, str(eid))
                     for eid, _ in entries]
-        uris, rows = [], []
+        uris, rows, metas = [], [], []
         for eid, payload in entries:
             # one bad record (corrupt b64, wrong cipher, bad uri) must not
             # take the batch or the serve loop down: store an error result
             # for it and continue
             try:
-                uri, inputs = schema.decode_record(payload, self.cipher)
+                uri, inputs, meta = schema.decode_record_meta(
+                    payload, self.cipher)
                 schema.validate_uri(uri)
             except Exception as e:
                 logger.warning("dropping undecodable record %s: %s", eid, e)
@@ -270,6 +290,7 @@ class ClusterServing:
                 continue
             uris.append(uri)
             rows.append(inputs)
+            metas.append(self._queue_wait(meta, t_dq1))
         if rows:
             # batch by the MAJORITY shape signature — a single malformed
             # leading record must not reject the whole batch
@@ -279,18 +300,19 @@ class ClusterServing:
             for r in rows:
                 counts[sig(r)] = counts.get(sig(r), 0) + 1
             best = max(counts, key=lambda s: counts[s])
-            kept_uris, kept = [], []
-            for uri, r in zip(uris, rows):
+            kept_uris, kept, kept_metas = [], [], []
+            for uri, r, m in zip(uris, rows, metas):
                 if sig(r) == best:
                     kept_uris.append(uri)
                     kept.append(r)
+                    kept_metas.append(m)
                 else:
                     err_cmds.append((
                         "HSET", self.result_key, uri, schema.encode_error(
                             f"tensor shapes {dict(best)} expected, got "
                             f"{ {k: np.shape(v) for k, v in r.items()} }",
                             self.cipher)))
-            uris, rows = kept_uris, kept
+            uris, rows, metas = kept_uris, kept, kept_metas
         if not rows:
             if err_cmds:
                 self._err_counter.inc(len(err_cmds))
@@ -312,7 +334,34 @@ class ClusterServing:
         # dispatch/device timing into per-uri spans
         trace = (t_dq0, t_dq1, t0, t_pp1) \
             if self._tracer.should_sample() else None
-        return x, (uris, err_cmds, ack_cmds, n, trace)
+        return x, (uris, err_cmds, ack_cmds, n, trace, metas)
+
+    def _queue_wait(self, meta, t_dq1: float):
+        """Measure one record's broker queue wait from its client stamp.
+        Returns ``(t_enqueue_on_this_clock, wait_s)`` or None (no stamp).
+
+        The stamp is dual-clock: ``t_pc`` (perf_counter, CLOCK_MONOTONIC —
+        directly comparable across processes on one Linux host) is used
+        when the delta is plausible (0..1h); otherwise the wall-clock
+        stamp covers cross-host clients, clamped at 0 so NTP slew can
+        only blur a wait, never fabricate a negative one."""
+        if not isinstance(meta, dict) or not meta:
+            return None
+        wait = None
+        t_pc = meta.get("t_pc")
+        if isinstance(t_pc, (int, float)):
+            d = t_dq1 - float(t_pc)
+            if 0.0 <= d < 3600.0:
+                wait = d
+        if wait is None:
+            t_wall = meta.get("t_wall")
+            if isinstance(t_wall, (int, float)):
+                now = time.time()  # zoolint: disable=wallclock-hotpath
+                wait = min(max(0.0, now - float(t_wall)), 3600.0)
+        if wait is None:
+            return None
+        self._wait_hist.observe(wait)
+        return (t_dq1 - wait, wait)
 
     def _grow_batch_on_backlog(self, dequeued: int):
         """Adaptive batch-bucket stepping, both directions. Every dequeue
@@ -429,7 +478,7 @@ class ClusterServing:
     def _finish(self, client: BrokerClient, comp: Completed) -> int:
         """Drain stage: postprocess + result/ack flush for one retired
         batch."""
-        uris, err_cmds, ack_cmds, n, trace = comp.ctx
+        uris, err_cmds, ack_cmds, n, trace, metas = comp.ctx
         if err_cmds:
             self._err_counter.inc(len(err_cmds))
         if comp.error is not None:
@@ -473,23 +522,34 @@ class ClusterServing:
         with self._state_lock:
             self.records_out += n
         self._rec_counter.inc(n)
+        # end-to-end latency per stamped record: client enqueue (mapped
+        # onto this clock by _queue_wait) → results about to flush
+        for m in metas:
+            if m is not None:
+                self._latency_hist.observe(max(0.0, t_pp_end - m[0]))
         if trace is not None:
-            self._record_batch_trace(uris, trace, comp, t0, t_pp_end)
+            self._record_batch_trace(uris, trace, comp, t0, t_pp_end,
+                                     metas)
         client.pipeline(cmds + ack_cmds)
         return n
 
     def _record_batch_trace(self, uris, trace, comp: Completed,
-                            t_post0: float, t_post1: float):
+                            t_post0: float, t_post1: float, metas=()):
         """Turn the sampled batch's stage stamps into per-uri spans. The
         record's uri is the trace id, so ``observability.trace(uri)`` (or a
         frontend caller that kept its uri) gets the full decomposition:
         ``serve`` (root, dequeue start → postprocess end) over contiguous
         ``dequeue``/``preprocess``/``device``/``postprocess`` children,
         with ``dispatch`` a sub-span of ``device``. Batch-level stages are
-        shared verbatim by every uri in the batch."""
+        shared verbatim by every uri in the batch. Records that carried a
+        client stamp additionally get the measured ``queue_wait`` span
+        (enqueue → dequeue-return) ahead of the engine stages — parentless
+        like ``client_enqueue``, because both cross the process boundary."""
         t_dq0, t_dq1, t_pp0, t_pp1 = trace
         tr = self._tracer
-        for uri in uris:
+        for uri, m in zip(uris, list(metas) or [None] * len(uris)):
+            if m is not None:
+                tr.record(uri, "queue_wait", m[0], t_dq1)
             tr.record(uri, "dequeue", t_dq0, t_dq1, parent="serve")
             tr.record(uri, "preprocess", t_pp0, t_pp1, parent="serve")
             tr.record(uri, "dispatch", comp.t_submit,
@@ -575,6 +635,24 @@ class ClusterServing:
         if client is not None:
             client.close()
 
+    # -------------------------------------------------------------- fleet
+    def set_advertise(self, host: str, port: int):
+        """Where peers can scrape this replica's ``/metrics`` — filled in
+        by the FrontEnd that owns this engine (port 0 = headless)."""
+        self._advertise = (host, int(port))
+
+    def _replica_info(self) -> fleet.ReplicaInfo:
+        with self._state_lock:
+            n = self.records_out
+        host, port = self._advertise
+        # wall clock by design: heartbeat ages are compared across
+        # processes/hosts (see common/fleet.py module docstring)
+        now = time.time()  # zoolint: disable=wallclock-hotpath
+        return fleet.ReplicaInfo(
+            replica_id=self.replica_id, host=host, port=port,
+            started_at=self._started_wall, last_heartbeat=now,
+            records_total=n, stream=self.stream)
+
     # ---------------------------------------------------------------- api
     def start(self) -> "ClusterServing":
         if self._thread is not None:
@@ -592,10 +670,23 @@ class ClusterServing:
         self._stop.clear()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
+        # join the fleet: periodic heartbeats through the broker hash so
+        # any frontend can enumerate/scrape this replica
+        # (ZOO_FLEET_HEARTBEAT_S=0 opts out)
+        if self._heartbeater is None and fleet.heartbeat_interval_s() > 0:
+            self._started_wall = \
+                time.time()  # zoolint: disable=wallclock-hotpath
+            self._heartbeater = fleet.Heartbeater(
+                fleet.ReplicaRegistry(self.broker_host, self.broker_port),
+                self._replica_info)
+            self._heartbeater.start()
         return self
 
     def stop(self):
         self._stop.set()
+        hb, self._heartbeater = self._heartbeater, None
+        if hb is not None:
+            hb.stop()
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None

@@ -12,10 +12,22 @@ enqueues to Redis and awaits the result). Endpoints:
   The Prometheus view is the process-wide telemetry registry, so engine
   counters, stage histograms, JIT/transfer metrics and frontend request
   counters all scrape from one endpoint.
+  ``?format=snapshot`` returns the raw mergeable registry snapshot
+  (histograms with ``le`` edges + ``bucket_counts`` — the federation wire
+  format). ``?scope=fleet`` federates: list live replicas from the fleet
+  registry (common/fleet.py), scrape each peer's snapshot, and serve the
+  merged view (telemetry.merge_snapshot) in either format; a failed
+  peer scrape counts ``zoo_fleet_scrape_errors_total{replica}`` and
+  degrades the response to partial instead of failing it.
 - ``GET  /healthz``  → readiness JSON: broker reachability, input queue
-  depth, consumer-group backlog. 503 when the broker is unreachable or
-  the queue depth exceeds ``max_backlog`` — load balancers use this to
-  stop routing to a drowning replica.
+  depth, consumer-group backlog, fleet replica counts, SLO burn rates.
+  503 when the broker is unreachable, when the queue depth exceeds
+  ``max_backlog``, or when the SLO monitor (common/slo.py) sheds —
+  every window's burn rate past ``ZOO_SLO_SHED_BURN`` — so load
+  balancers back off on *measured* p99/error burn before the raw
+  backlog ever looks scary.
+- ``GET  /slo``      → the SLO monitor's full report: per-objective,
+  per-window burn rates, bad fractions, and the shed decision.
 - ``GET  /``         → liveness
 
 stdlib ``ThreadingHTTPServer`` — no framework dependency; each request
@@ -30,13 +42,58 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from analytics_zoo_tpu.common import profiling, telemetry
+from analytics_zoo_tpu.common import fleet, profiling, slo, telemetry
 from analytics_zoo_tpu.serving import schema
 from analytics_zoo_tpu.serving.broker import BrokerClient
 from analytics_zoo_tpu.serving.client import (INPUT_STREAM, InputQueue,
                                               OutputQueue)
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+#: per-peer timeout for ?scope=fleet scrapes — bounded so one dead
+#: replica delays, never wedges, the federated response
+FLEET_SCRAPE_TIMEOUT_S = 2.0
+
+
+def scrape_fleet(broker_host: str, broker_port: int,
+                 own_replica_id: Optional[str] = None,
+                 timeout_s: float = FLEET_SCRAPE_TIMEOUT_S):
+    """Merge the local registry snapshot with every live replica's
+    ``/metrics?format=snapshot``. Returns ``(merged, meta)`` where meta
+    lists scraped/failed/stale replica ids; a peer that cannot be
+    scraped (no advertised port, HTTP error, unmergeable snapshot)
+    lands in ``failed`` and increments
+    ``zoo_fleet_scrape_errors_total{replica}`` — the fleet view degrades
+    to partial rather than erroring. Raises the broker's
+    ``ConnectionError``/``OSError`` only when the registry itself is
+    unreachable."""
+    import urllib.request
+    registry = fleet.ReplicaRegistry(broker_host, broker_port)
+    live, stale = registry.partition()
+    merged = telemetry.snapshot()
+    errs = telemetry.get_registry().counter(
+        "zoo_fleet_scrape_errors_total",
+        "Replica snapshot scrapes that failed during fleet federation",
+        ("replica",))
+    scraped, failed = [], []
+    for r in live:
+        if own_replica_id is not None and r.replica_id == own_replica_id:
+            scraped.append(r.replica_id)   # self = the local snapshot
+            continue
+        try:
+            if r.port <= 0:
+                raise ValueError("replica advertises no scrape port")
+            with urllib.request.urlopen(
+                    f"http://{r.host}:{r.port}/metrics?format=snapshot",
+                    timeout=timeout_s) as resp:
+                peer = json.loads(resp.read())
+            merged = telemetry.MetricsRegistry.merge_snapshot(merged, peer)
+            scraped.append(r.replica_id)
+        except Exception:
+            errs.labels(r.replica_id).inc()
+            failed.append(r.replica_id)
+    return merged, {"scraped": scraped, "failed": failed,
+                    "stale": [r.replica_id for r in stale]}
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -69,16 +126,46 @@ class _Handler(BaseHTTPRequestHandler):
     def _wants_prometheus(self) -> bool:
         if "format=prometheus" in self.path:
             return True
+        if "format=snapshot" in self.path:
+            return False
         accept = (self.headers.get("Accept") or "").lower()
         return "text/plain" in accept or "openmetrics" in accept
 
     def _metrics(self):
+        if "scope=fleet" in self.path:
+            self._metrics_fleet()
+            return
+        if "format=snapshot" in self.path:
+            # the mergeable wire format peers scrape during federation
+            self._json(200, telemetry.snapshot(), path="/metrics")
+            return
         if self._wants_prometheus():
             self._text(200, telemetry.prometheus_text(),
                        PROMETHEUS_CONTENT_TYPE)
             return
         engine = self.server.engine  # type: ignore[attr-defined]
         self._json(200, engine.metrics() if engine else {},
+                   path="/metrics")
+
+    def _metrics_fleet(self):
+        srv = self.server  # type: ignore[assignment]
+        own = srv.engine.replica_id if srv.engine else None
+        try:
+            merged, meta = scrape_fleet(srv.broker_host, srv.broker_port,
+                                        own_replica_id=own)
+        except (ConnectionError, OSError) as e:
+            self._json(503, {"error": f"fleet registry unreachable: {e}"},
+                       path="/metrics")
+            return
+        if self._wants_prometheus():
+            # rebuild a registry from the merged snapshot so the fleet
+            # view speaks the same 0.0.4 exposition as scope=self
+            text = telemetry.MetricsRegistry.from_snapshot(
+                merged).prometheus_text()
+            self._text(200, text, PROMETHEUS_CONTENT_TYPE)
+            return
+        self._json(200, {"scope": "fleet", "partial": bool(meta["failed"]),
+                         "replicas": meta, "metrics": merged},
                    path="/metrics")
 
     def _healthz(self):
@@ -108,6 +195,27 @@ class _Handler(BaseHTTPRequestHandler):
                 client.close()
         if code == 200 and out["queue_depth"] > srv.max_backlog:
             out["status"] = "overloaded"
+            out["reason"] = "backlog"
+            code = 503
+        # fleet view: who else is serving, by heartbeat freshness
+        if out["broker"] == "up":
+            try:
+                live, stale = fleet.ReplicaRegistry(
+                    srv.broker_host, srv.broker_port).partition()
+                out["fleet"] = {"replicas": len(live), "stale": len(stale)}
+            except Exception:
+                out["fleet"] = {"replicas": 0, "stale": 0}
+        # burn-rate shedding: the *measured* overload signal — p99/error
+        # budget burning past ZOO_SLO_SHED_BURN on every window trips 503
+        # while the raw backlog may still look fine (the backlog check
+        # above survives only as the coarse fallback)
+        mon = slo.get_monitor()
+        mon.tick_if_stale()
+        shedding = mon.overloaded()
+        out["slo"] = {"burn_rates": mon.burn_rates(), "shedding": shedding}
+        if code == 200 and shedding:
+            out["status"] = "overloaded"
+            out["reason"] = "slo-burn"
             code = 503
         # surface the JAX backend so a CPU-fallback or wedged-device
         # replica is visible from the probe itself; the probe thread is
@@ -135,6 +243,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._healthz()
         elif path == "/trace":
             self._trace()
+        elif path == "/slo":
+            mon = slo.get_monitor()
+            mon.tick_if_stale()
+            self._json(200, mon.report(), path="/slo")
         else:
             self._json(200, {"status": "ok"}, path=path)
 
@@ -219,6 +331,12 @@ class FrontEnd:
         # timeouts; keep our own name distinct
         self._httpd.timeout = None                  # type: ignore[attr-defined]
         self.port = self._httpd.server_address[1]
+        if engine is not None and hasattr(engine, "set_advertise"):
+            # tell the engine's heartbeat where peers can scrape this
+            # replica; a wildcard bind advertises loopback (peers cannot
+            # dial 0.0.0.0)
+            adv = "127.0.0.1" if host in ("", "0.0.0.0", "::") else host
+            engine.set_advertise(adv, self.port)
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "FrontEnd":

@@ -70,19 +70,34 @@ def decode_tensor(obj: dict):
 
 
 def encode_record(uri: str, inputs: Dict[str, np.ndarray],
-                  cipher: Cipher = None) -> str:
-    body = json.dumps(
-        {"uri": uri,
-         "inputs": {k: encode_tensor(v if isinstance(v, ImageBytes)
-                                     else np.asarray(v))
-                    for k, v in inputs.items()}}).encode()
+                  cipher: Cipher = None,
+                  trace: Optional[Dict[str, Any]] = None) -> str:
+    """``trace`` is the optional end-to-end tracing stamp the client
+    attaches (``{"id", "t_pc", "t_wall", "s"}`` — enqueue time on both
+    the monotonic and wall clocks plus the sampling flag); the engine
+    turns it into the measured ``queue_wait`` span and the
+    ``zoo_queue_wait_seconds`` / ``zoo_serving_latency_seconds``
+    histograms. Decoders that ignore it (``decode_record``) are
+    unaffected — the field is additive."""
+    obj: Dict[str, Any] = {
+        "uri": uri,
+        "inputs": {k: encode_tensor(v if isinstance(v, ImageBytes)
+                                    else np.asarray(v))
+                   for k, v in inputs.items()}}
+    if trace:
+        obj["trace"] = trace
+    body = json.dumps(obj).encode()
     if cipher is not None:
         body = cipher[0](body)
     return base64.b64encode(body).decode()
 
 
-def decode_record(payload_b64: str, cipher: Cipher = None
-                  ) -> Tuple[str, Dict[str, np.ndarray]]:
+def decode_record_meta(payload_b64: str, cipher: Cipher = None
+                       ) -> Tuple[str, Dict[str, np.ndarray],
+                                  Dict[str, Any]]:
+    """(uri, inputs, meta): like :func:`decode_record` but keeps the
+    record's side-channel metadata (the client's ``trace`` stamp; ``{}``
+    when absent — Arrow-format reference records carry none)."""
     body = base64.b64decode(payload_b64)
     if cipher is not None:
         body = cipher[1](body)
@@ -90,9 +105,17 @@ def decode_record(payload_b64: str, cipher: Cipher = None
     if "data" in obj and "inputs" not in obj:
         # reference-client record shape: {"uri", "data": b64(arrow)}
         # (ref client.py:144-147 enqueue)
-        return obj["uri"], decode_arrow_inputs(obj["data"])
-    return obj["uri"], {k: decode_tensor(v)
-                        for k, v in obj["inputs"].items()}
+        return obj["uri"], decode_arrow_inputs(obj["data"]), {}
+    meta = obj.get("trace")
+    return (obj["uri"],
+            {k: decode_tensor(v) for k, v in obj["inputs"].items()},
+            meta if isinstance(meta, dict) else {})
+
+
+def decode_record(payload_b64: str, cipher: Cipher = None
+                  ) -> Tuple[str, Dict[str, np.ndarray]]:
+    uri, inputs, _ = decode_record_meta(payload_b64, cipher)
+    return uri, inputs
 
 
 # ------------------------- reference Arrow wire encoding ----------------

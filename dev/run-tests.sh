@@ -8,7 +8,7 @@
 #   dev/run-tests.sh core         # one lane
 #   dev/run-tests.sh smoke        # fast pre-push subset (<5 min, 1 core)
 #   Lanes: smoke core data keras models zouwu automl serving interop
-#          examples telemetry zoolint
+#          examples telemetry fleet zoolint
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -98,6 +98,10 @@ print(f"compile-ahead OK: growth={rec['serving_bucket_growth']} "
       f"recompiles=0 cold_start={rec['serving_cold_start_seconds']}s")
 PY
             ;;
+  # fleet observability (ISSUE 6): snapshot merge algebra, replica
+  # registry + SLO burn units, and the two-replica federation smoke
+  # (subprocess engines, one broker, merged /metrics?scope=fleet)
+  fleet)    run -m "not slow" tests/test_fleet.py ;;
   release)  bash "$(dirname "$0")/release.sh" ;;
   all)      lint_zoolint
             run tests/ ;;

@@ -1,0 +1,8 @@
+"""Seeded fixture package: registers ONE documented fleet metric; the
+docs also declare ``zoo_fleet_ghost_total`` which nothing registers —
+the scan must flag it ``metric-undeclared``."""
+
+from analytics_zoo_tpu.common import telemetry
+
+telemetry.get_registry().counter(
+    "zoo_fleet_present_total", "Registered and documented", ("replica",))

@@ -1,0 +1,449 @@
+"""Fleet observability (ISSUE 6): mergeable-snapshot algebra, the
+replica registry, the burn-rate SLO monitor, and the two-replica
+federation smoke (subprocess engines, one broker, one merged
+``/metrics?scope=fleet`` view)."""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import fleet, slo, telemetry
+from analytics_zoo_tpu.common.telemetry import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _hist_pair(name, streams, seed):
+    """Two registries observing disjoint random streams + the union
+    stream observed into a third — the ground truth for merge algebra."""
+    rng = random.Random(seed)
+    regs = [MetricsRegistry() for _ in range(len(streams) + 1)]
+    union = regs[-1]
+    for reg, n in zip(regs, streams):
+        h = reg.histogram(name, "d", ("s",)).labels("x")
+        hu = union.histogram(name, "d", ("s",)).labels("x")
+        for _ in range(n):
+            v = rng.expovariate(2.0)
+            h.observe(v)
+            hu.observe(v)
+    return regs
+
+
+class TestMergeAlgebra:
+    def test_merge_equals_union_stream(self):
+        """Property: merge(A, B) has exactly the bucket counts, count,
+        and sum of the union stream, and its quantile estimates sit
+        within one bucket width of the union registry's."""
+        a, b, union = _hist_pair("zoo_t_seconds", (500, 1500), seed=7)
+        merged = MetricsRegistry.merge_snapshot(a.snapshot(), b.snapshot())
+        want = union.snapshot()["zoo_t_seconds"]["s=x"]
+        got = merged["zoo_t_seconds"]["s=x"]
+        assert got["count"] == want["count"] == 2000
+        assert got["sum"] == pytest.approx(want["sum"])
+        assert got["le"] == want["le"]
+        assert got["bucket_counts"] == want["bucket_counts"]
+        # quantiles: merged values are bucket-derived (upper edge), so
+        # they can differ from the union's reservoir quantile by at most
+        # the width of the bucket that holds the rank
+        le = got["le"]
+        for q in ("p50", "p99"):
+            edge_i = next(i for i, e in enumerate(le) if got[q] <= e)
+            lo = 0.0 if edge_i == 0 else le[edge_i - 1]
+            assert lo <= want[q] <= le[edge_i] + 1e-12, \
+                f"{q}: merged {got[q]} vs union {want[q]}"
+        # reservoir stays bounded and sorted
+        r = got["reservoir"]
+        assert len(r) <= telemetry.SNAPSHOT_RESERVOIR and r == sorted(r)
+
+    def test_merge_is_commutative_and_leaves_inputs_alone(self):
+        a, b, _ = _hist_pair("zoo_t_seconds", (64, 256), seed=3)
+        sa, sb = a.snapshot(), b.snapshot()
+        sa0 = json.loads(json.dumps(sa))
+        ab = MetricsRegistry.merge_snapshot(sa, sb)
+        ba = MetricsRegistry.merge_snapshot(sb, sa)
+        assert ab["zoo_t_seconds"]["s=x"]["bucket_counts"] == \
+            ba["zoo_t_seconds"]["s=x"]["bucket_counts"]
+        assert sa == sa0, "merge mutated its input snapshot"
+
+    def test_counters_gauges_and_disjoint_families_sum(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.counter("zoo_n_total", "d", ("s",)).labels("x").inc(3)
+        b.counter("zoo_n_total", "d", ("s",)).labels("x").inc(4)
+        b.counter("zoo_n_total", "d", ("s",)).labels("y").inc(5)
+        a.gauge("zoo_depth").set(2)
+        b.gauge("zoo_depth").set(7)
+        a.counter("zoo_only_a_total").inc(1)
+        m = MetricsRegistry.merge_snapshot(a.snapshot(), b.snapshot())
+        assert m["zoo_n_total"] == {"s=x": 7.0, "s=y": 5.0}
+        assert m["zoo_depth"] == 9.0       # gauges sum (fleet totals)
+        assert m["zoo_only_a_total"] == 1.0
+
+    def test_mismatched_buckets_raise(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.histogram("zoo_h_seconds", "d", buckets=(0.1, 1.0)).observe(0.2)
+        b.histogram("zoo_h_seconds", "d", buckets=(0.5, 2.0)).observe(0.2)
+        with pytest.raises(ValueError, match="bucket"):
+            MetricsRegistry.merge_snapshot(a.snapshot(), b.snapshot())
+
+    def test_from_snapshot_round_trips_to_prometheus(self):
+        a, b, _ = _hist_pair("zoo_t_seconds", (10, 20), seed=1)
+        a.counter("zoo_n_total").inc(2)
+        b.counter("zoo_n_total").inc(3)
+        merged = MetricsRegistry.merge_snapshot(a.snapshot(), b.snapshot())
+        text = MetricsRegistry.from_snapshot(merged).prometheus_text()
+        assert 'zoo_t_seconds_count{s="x"} 30' in text
+        assert "zoo_n_total 5" in text
+        # and the rebuilt registry snapshots back to the same counts
+        again = MetricsRegistry.from_snapshot(merged).snapshot()
+        assert again["zoo_t_seconds"]["s=x"]["bucket_counts"] == \
+            merged["zoo_t_seconds"]["s=x"]["bucket_counts"]
+
+
+class TestReplicaRegistry:
+    @pytest.fixture()
+    def broker(self):
+        from analytics_zoo_tpu.serving.broker import Broker
+        with Broker.launch(backend="python") as b:
+            yield b
+
+    def test_publish_list_partition_remove(self, broker):
+        telemetry.reset_for_tests()
+        reg = fleet.ReplicaRegistry("127.0.0.1", broker.port)
+        now = time.time()
+        fresh = fleet.ReplicaInfo("serving:1:aaa", port=81,
+                                  started_at=now, last_heartbeat=now,
+                                  records_total=5)
+        old = fleet.ReplicaInfo("serving:2:bbb", port=82,
+                                started_at=now - 600,
+                                last_heartbeat=now - 600)
+        reg.publish(fresh)
+        reg.publish(old)
+        live, stale = reg.partition()
+        assert [r.replica_id for r in live] == ["serving:1:aaa"]
+        assert [r.replica_id for r in stale] == ["serving:2:bbb"]
+        assert live[0].records_total == 5 and live[0].port == 81
+        snap = telemetry.snapshot()
+        assert snap["zoo_fleet_replicas"] == {"state=live": 1.0,
+                                              "state=stale": 1.0}
+        reg.remove("serving:1:aaa")
+        live, stale = reg.partition()
+        assert live == [] and len(stale) == 1
+
+    def test_heartbeater_counts_failures_without_raising(self):
+        telemetry.reset_for_tests()
+        # port 1: nothing listens — every beat must fail quietly
+        reg = fleet.ReplicaRegistry("127.0.0.1", 1)
+        info = fleet.ReplicaInfo("serving:3:ccc")
+        hb = fleet.Heartbeater(reg, lambda: info, interval_s=60)
+        assert hb.beat_once() is False
+        fam = telemetry.snapshot()["zoo_fleet_heartbeat_errors_total"]
+        assert fam == {"replica=serving:3:ccc": 1.0}
+        hb.stop()   # deregister against a dead broker must not raise
+
+    def test_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("ZOO_FLEET_HEARTBEAT_S", "0.5")
+        assert fleet.heartbeat_interval_s() == 0.5
+        assert fleet.stale_after_s() == 5.0  # 5 × max(interval, 1)
+        monkeypatch.setenv("ZOO_FLEET_STALE_S", "42")
+        assert fleet.stale_after_s() == 42.0
+
+
+class TestSLOMonitor:
+    def _setup(self):
+        telemetry.reset_for_tests()
+        reg = telemetry.get_registry()
+        return (reg.histogram("zoo_serving_latency_seconds", "d",
+                              ("stream",)).labels("s"),
+                reg.counter("zoo_serving_records_total", "d",
+                            ("stream",)).labels("s"),
+                reg.counter("zoo_serving_record_errors_total", "d",
+                            ("stream",)).labels("s"))
+
+    def test_latency_burn_math(self):
+        h, good, _ = self._setup()
+        mon = slo.SLOMonitor(windows=(10.0,), shed_burn=2.0, tick_s=1.0)
+        mon.tick(now=0.0)
+        # 90 fast + 10 slow: bad fraction 0.10 against a 0.99 objective
+        # → burn = 0.10 / 0.01 = 10
+        for _ in range(90):
+            h.observe(0.01)
+        for _ in range(10):
+            h.observe(5.0)
+        mon.tick(now=5.0)
+        assert mon.burn_rates()["serving_p99_latency"]["10s"] == \
+            pytest.approx(10.0)
+        assert mon.overloaded()
+        snap = telemetry.snapshot()
+        assert snap["zoo_slo_burn_rate"][
+            "slo=serving_p99_latency,window=10s"] == pytest.approx(10.0)
+        assert snap["zoo_slo_shedding"] == 1.0
+
+    def test_availability_burn_math(self):
+        _, good, bad = self._setup()
+        mon = slo.SLOMonitor(windows=(10.0,), shed_burn=2.0, tick_s=1.0)
+        mon.tick(now=0.0)
+        good.inc(999)
+        bad.inc(1)
+        mon.tick(now=5.0)
+        # bad fraction 1/1000 at objective 0.999 → burn exactly 1.0:
+        # spending the budget at precisely the sustainable rate
+        assert mon.burn_rates()["serving_availability"]["10s"] == \
+            pytest.approx(1.0)
+        assert not mon.overloaded()
+
+    def test_multi_window_guard_blocks_blip_shedding(self):
+        h, _, _ = self._setup()
+        mon = slo.SLOMonitor(windows=(5.0, 60.0), shed_burn=2.0,
+                             tick_s=1.0)
+        mon.tick(now=0.0)
+        for _ in range(2000):
+            h.observe(0.01)
+        mon.tick(now=50.0)
+        for _ in range(20):
+            h.observe(5.0)          # a late burst
+        mon.tick(now=55.0)
+        br = mon.burn_rates()["serving_p99_latency"]
+        # short window sees only the burst (100% bad → burn 100), long
+        # window dilutes it below the budget (20/2020 bad ≈ burn 0.99)
+        # — multi-window agreement must NOT shed on the blip
+        assert br["5s"] > 2.0 > br["60s"]
+        assert not mon.overloaded()
+
+    def test_no_traffic_means_no_burn(self):
+        self._setup()
+        mon = slo.SLOMonitor(windows=(10.0,))
+        mon.tick(now=0.0)
+        mon.tick(now=5.0)
+        assert all(v == 0.0
+                   for per in mon.burn_rates().values()
+                   for v in per.values())
+        assert not mon.overloaded()
+        assert mon.report()["shedding"] is False
+
+    def test_registry_reset_reads_as_empty_window(self):
+        h, _, _ = self._setup()
+        mon = slo.SLOMonitor(windows=(10.0,))
+        for _ in range(10):
+            h.observe(9.0)
+        mon.tick(now=0.0)
+        telemetry.reset_for_tests()     # cumulative series drops to zero
+        mon.tick(now=5.0)
+        assert not mon.overloaded()     # clamped, never negative/stuck
+
+    def test_env_defaults(self, monkeypatch):
+        monkeypatch.setenv("ZOO_SLO_P99_MS", "250")
+        monkeypatch.setenv("ZOO_SLO_AVAILABILITY", "0.99")
+        monkeypatch.setenv("ZOO_SLO_WINDOWS", "30,120")
+        monkeypatch.setenv("ZOO_SLO_SHED_BURN", "3.5")
+        mon = slo.SLOMonitor()
+        lat = next(s for s in mon.slos if s.kind == "latency")
+        avail = next(s for s in mon.slos if s.kind == "availability")
+        assert lat.threshold_s == pytest.approx(0.25)
+        assert avail.objective == 0.99
+        assert mon.windows == (30.0, 120.0) and mon.shed_burn == 3.5
+
+
+# --------------------------------------------------------------- federation
+
+_REPLICA_SCRIPT = """
+import sys
+import numpy as np
+from analytics_zoo_tpu.serving.engine import ClusterServing
+from analytics_zoo_tpu.serving.frontend import FrontEnd
+
+class Duck:
+    def predict(self, x):
+        return np.asarray(x) * 2.0
+
+port, consumer = int(sys.argv[1]), sys.argv[2]
+eng = ClusterServing(Duck(), port, batch_size=4, consumer=consumer)
+fe = FrontEnd(port, engine=eng)
+eng.start()
+fe.start()
+print("READY", fe.port, eng.replica_id, flush=True)
+sys.stdin.readline()                    # parent closes stdin to stop us
+eng.stop()
+fe.stop()
+print("DONE", flush=True)
+"""
+
+
+def _get_json(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def test_two_replica_federation_smoke():
+    """Acceptance (ISSUE 6): two live subprocess replicas on one broker;
+    ``GET /metrics?scope=fleet`` from either serves merged counters and
+    histograms whose ``records_total`` equals the sum over replicas, and
+    ``/healthz`` reports both replicas live."""
+    from analytics_zoo_tpu.serving.broker import Broker
+    from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               ZOO_FLEET_HEARTBEAT_S="0.25")
+    n_records = 20
+    with Broker.launch(backend="python") as broker:
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _REPLICA_SCRIPT,
+             str(broker.port), f"c{i}"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            text=True, cwd=REPO, env=env) for i in range(2)]
+        try:
+            ready = [p.stdout.readline().split() for p in procs]
+            assert all(r and r[0] == "READY" for r in ready), ready
+            ports = [int(r[1]) for r in ready]
+            replica_ids = {r[2] for r in ready}
+
+            in_q = InputQueue(port=broker.port)
+            out_q = OutputQueue(port=broker.port)
+            uris = in_q.enqueue_batch(
+                (f"fed{i}", {"x": np.full(3, i, np.float32)})
+                for i in range(n_records))
+            res = out_q.query_many(uris, timeout=60.0)
+            assert all(v is not None for v in res.values()), \
+                [u for u, v in res.items() if v is None]
+
+            # wait until BOTH replicas' heartbeats carry the final
+            # records_total (heartbeat period 0.25s)
+            reg = fleet.ReplicaRegistry("127.0.0.1", broker.port)
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                live, _ = reg.partition()
+                if len(live) == 2 and \
+                        sum(r.records_total for r in live) == n_records:
+                    break
+                time.sleep(0.2)
+            live, _ = reg.partition()
+            assert {r.replica_id for r in live} == replica_ids
+            assert sum(r.records_total for r in live) == n_records
+            # both replicas took work (group fan-out, 2 consumers)
+            assert all(r.records_total > 0 for r in live), \
+                [(r.replica_id, r.records_total) for r in live]
+
+            # the merged fleet view from replica 0 equals the sum
+            flt = _get_json(
+                f"http://127.0.0.1:{ports[0]}/metrics?scope=fleet")
+            assert flt["scope"] == "fleet" and flt["partial"] is False, \
+                flt["replicas"]
+            assert sorted(flt["replicas"]["scraped"]) == \
+                sorted(replica_ids)
+            m = flt["metrics"]
+            assert m["zoo_serving_records_total"][
+                "stream=serving_stream"] == n_records
+            # histograms merged too: fleet-wide latency distribution
+            # carries every record and its bucket boundaries
+            lat = m["zoo_serving_latency_seconds"]["stream=serving_stream"]
+            assert lat["count"] == n_records
+            assert sum(lat["bucket_counts"]) == n_records
+            assert lat["le"] == list(telemetry.DEFAULT_BUCKETS)
+            # per-replica snapshots really do sum to the fleet view
+            parts = [_get_json(f"http://127.0.0.1:{p}/metrics"
+                               f"?format=snapshot") for p in ports]
+            by_replica = [
+                part.get("zoo_serving_records_total", {})
+                .get("stream=serving_stream", 0.0) for part in parts]
+            assert sum(by_replica) == n_records
+
+            # healthz sees the whole fleet
+            hz = _get_json(f"http://127.0.0.1:{ports[1]}/healthz")
+            assert hz["fleet"]["replicas"] == 2, hz["fleet"]
+            assert hz["status"] == "ok"
+
+            # prometheus flavor of the merged view
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{ports[0]}/metrics?scope=fleet"
+                    f"&format=prometheus", timeout=10) as resp:
+                text = resp.read().decode()
+            assert (f'zoo_serving_records_total{{stream="serving_stream"}}'
+                    f" {n_records}") in text
+        finally:
+            for p in procs:
+                try:
+                    p.stdin.close()
+                except OSError:
+                    pass
+            for p in procs:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait(timeout=10)
+
+
+def test_dead_replica_degrades_fleet_view_to_partial():
+    """A registered replica that cannot be scraped lands in ``failed``
+    (+``zoo_fleet_scrape_errors_total``) — the view degrades, the
+    request still answers."""
+    from analytics_zoo_tpu.serving.broker import Broker
+    from analytics_zoo_tpu.serving.frontend import scrape_fleet
+
+    telemetry.reset_for_tests()
+    with Broker.launch(backend="python") as broker:
+        reg = fleet.ReplicaRegistry("127.0.0.1", broker.port)
+        now = time.time()
+        # port 1: nothing listens there
+        reg.publish(fleet.ReplicaInfo("serving:9:dead", port=1,
+                                      started_at=now, last_heartbeat=now))
+        telemetry.get_registry().counter(
+            "zoo_local_records_total").inc(4)
+        merged, meta = scrape_fleet("127.0.0.1", broker.port,
+                                    timeout_s=0.5)
+        assert meta["failed"] == ["serving:9:dead"]
+        assert merged["zoo_local_records_total"] == 4.0  # local survives
+        snap = telemetry.snapshot()
+        assert snap["zoo_fleet_scrape_errors_total"] == \
+            {"replica=serving:9:dead": 1.0}
+
+
+def test_healthz_sheds_on_slo_burn_not_backlog():
+    """Acceptance (ISSUE 6): /healthz flips 503 under a synthetic p99
+    burn while the raw queue depth stays far below ``max_backlog`` —
+    overload is now the measured signal, not the coarse backlog."""
+    from analytics_zoo_tpu.serving.broker import Broker
+    from analytics_zoo_tpu.serving.frontend import FrontEnd
+
+    telemetry.reset_for_tests()
+    with Broker.launch(backend="python") as broker:
+        fe = FrontEnd(broker.port, engine=None, max_backlog=10000)
+        mon = slo.SLOMonitor(windows=(10.0,), shed_burn=2.0, tick_s=0.01)
+        slo.set_monitor(mon)
+        try:
+            fe.start()
+            mon.tick()
+            hz = _get_json(f"http://127.0.0.1:{fe.port}/healthz")
+            assert hz["status"] == "ok" and hz["slo"]["shedding"] is False
+
+            h = telemetry.get_registry().histogram(
+                "zoo_serving_latency_seconds", "d",
+                ("stream",)).labels("serving_stream")
+            for _ in range(50):
+                h.observe(9.0)          # every record blows the 1s p99
+            time.sleep(0.05)            # tick_if_stale refires on read
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{fe.port}/healthz", timeout=10)
+            assert ei.value.code == 503
+            body = json.loads(ei.value.read())
+            assert body["status"] == "overloaded"
+            assert body["reason"] == "slo-burn"
+            assert body["queue_depth"] == 0     # backlog is NOT the cause
+            assert body["slo"]["shedding"] is True
+
+            rep = _get_json(f"http://127.0.0.1:{fe.port}/slo")
+            assert rep["shedding"] is True
+            burn = rep["slos"][0]["windows"]["10s"]["burn"]
+            assert burn > 2.0
+        finally:
+            fe.stop()
+            slo.set_monitor(None)
+            telemetry.reset_for_tests()

@@ -976,8 +976,18 @@ class TestTelemetryServing:
             client_e2e = time.perf_counter() - t_c0
 
         spans = {s.name: s for s in obs.trace("traced-1")}
-        assert set(spans) == {"dequeue", "preprocess", "dispatch",
-                              "device", "postprocess", "serve"}
+        assert set(spans) == {"client_enqueue", "queue_wait", "dequeue",
+                              "preprocess", "dispatch", "device",
+                              "postprocess", "serve"}
+        # the cross-process head of the timeline (ISSUE 6): the client's
+        # enqueue span starts the trace, the measured broker queue wait
+        # bridges it to the engine's dequeue — strictly before the
+        # engine stages on the shared perf_counter clock
+        ce, qw = spans["client_enqueue"], spans["queue_wait"]
+        assert ce.parent is None and qw.parent is None
+        assert ce.start <= qw.start <= qw.end
+        assert qw.end <= spans["dequeue"].end + 1e-9
+        assert qw.start <= spans["preprocess"].start
         root = spans["serve"]
         children = [spans[n] for n in ("dequeue", "preprocess", "device",
                                        "postprocess")]

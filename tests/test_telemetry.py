@@ -112,6 +112,12 @@ class TestRegistry:
         h = snap["zoo_h_seconds"]
         assert h["count"] == 1 and h["sum"] == pytest.approx(0.2)
         assert h["p50"] == pytest.approx(0.2)
+        # the snapshot carries the bucket boundaries + per-bucket counts
+        # (ISSUE 6: the histogram JSON is mergeable, not just a summary)
+        assert h["le"] == list(telemetry.DEFAULT_BUCKETS)
+        assert len(h["bucket_counts"]) == len(h["le"]) + 1  # +Inf bucket
+        assert sum(h["bucket_counts"]) == h["count"]
+        assert h["reservoir"] == [pytest.approx(0.2)]
 
 
 class TestPrometheusExposition:

@@ -485,6 +485,21 @@ def test_metric_undeclared_requires_full_package_scan(tmp_path):
     assert fs == []
 
 
+def test_fleet_fixture_trips_metric_undeclared():
+    """The on-disk seeded fixture for the catalog rule the main fixture
+    can't fire (ISSUE 6): a documented ``zoo_fleet_*`` metric that no
+    code registers must read ``metric-undeclared`` on a full-package
+    scan of the fixture root."""
+    root = os.path.join(REPO, "tests", "fixtures", "zoolint_fleet")
+    fs = analyze_paths([os.path.join(root, "analytics_zoo_tpu")],
+                       root=root)
+    undeclared = [f for f in fs if f.rule == "metric-undeclared"]
+    assert len(undeclared) == 1, [f.format() for f in fs]
+    assert "zoo_fleet_ghost_total" in undeclared[0].message
+    # the registered-and-documented twin stays clean
+    assert not any("zoo_fleet_present_total" in f.message for f in fs)
+
+
 def test_cli_partial_scan_keeps_baseline_quiet(monkeypatch, capsys):
     # gan.py's baselined findings are out of scope when scanning
     # serving/ only — neither surfaced nor reported stale
