@@ -42,7 +42,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from analytics_zoo_tpu.common import telemetry
+from analytics_zoo_tpu.common import resilience, telemetry
 
 __all__ = [
     "BucketLadder", "ExecutableCache", "configure_persistent_cache",
@@ -297,6 +297,10 @@ class ExecutableCache:
         self.name = name
         self._lock = threading.Lock()
         self._execs: Dict[Tuple, Any] = {}
+        # CPU-fallback executables (ZOO_CPU_FALLBACK): same signatures,
+        # compiled pinned to the host CPU device so serving can keep
+        # answering while the accelerator tunnel is wedged
+        self._cpu_execs: Dict[Tuple, Any] = {}
         self._inflight: set = set()
         reg = registry if registry is not None else telemetry.get_registry()
         self._tracer = tracer if tracer is not None else \
@@ -374,10 +378,48 @@ class ExecutableCache:
             logger.exception("AOT warmup compile failed for %s", self.name)
             return False
 
-    def warm_async(self, aval_sets: Sequence[Tuple]) -> threading.Thread:
+    def warm_cpu(self, *avals) -> bool:
+        """AOT-compile one signature pinned to the host CPU device — the
+        failover rung serving swaps to when the backend wedges. No-op when
+        already built (or when no CPU device is visible). The name is
+        load-bearing for zoolint's jit-compile-in-serve-loop rule: this is
+        warmup, not hot-path compilation."""
+        sig = self.signature(avals)
+        with self._lock:
+            if sig in self._cpu_execs:
+                return True
+        try:
+            import jax
+            cpu = jax.devices("cpu")[0]
+            configure_persistent_cache()
+            t0 = perf_counter()
+            with jax.default_device(cpu):
+                exe = self._jitted.lower(*avals).compile()
+            t1 = perf_counter()
+            self._compile_hist.observe(t1 - t0)
+            self._tracer.record(WARMUP_TRACE_ID, "compile", t0, t1)
+            with self._lock:
+                self._cpu_execs[sig] = exe
+            return True
+        except Exception:
+            logger.exception("CPU-fallback warmup compile failed for %s",
+                             self.name)
+            return False
+
+    def cpu_ready(self, *args) -> bool:
+        """True when a CPU-fallback executable exists for this shape."""
+        sig = self.signature(args)
+        with self._lock:
+            return sig in self._cpu_execs
+
+    def warm_async(self, aval_sets: Sequence[Tuple],
+                   cpu_also: bool = False) -> threading.Thread:
         """Spawn a daemon thread that warms every signature in
         ``aval_sets`` (a list of argument-aval tuples), smallest first so
-        the rung most likely to be needed next lands earliest."""
+        the rung most likely to be needed next lands earliest. With
+        ``cpu_also`` each rung's CPU-fallback executable is built right
+        after its device one (failover is useless for rungs that would
+        compile on the serve thread mid-wedge)."""
         sets = [tuple(s) for s in aval_sets]
 
         def worker():
@@ -385,6 +427,8 @@ class ExecutableCache:
                 if _draining.is_set():
                     return
                 self.warm(*avals)
+                if cpu_also and not _draining.is_set():
+                    self.warm_cpu(*avals)
 
         t = threading.Thread(target=worker, daemon=True,
                              name=f"zoo-warmup-{self.name}")
@@ -394,6 +438,9 @@ class ExecutableCache:
 
     # --------------------------------------------------------- dispatch
     def __call__(self, *args):
+        # fault-injection dispatch seam (suppressed when a DevicePipeline
+        # already owns this logical dispatch — one arrival per batch)
+        resilience.maybe_fault("dispatch")
         sig = self.signature(args)
         with self._lock:
             exe = self._execs.get(sig)
@@ -412,6 +459,30 @@ class ExecutableCache:
         except Exception:
             # executable/arg mismatch (sharding drift, weak types): the
             # jitted path is always correct, just not compile-proof
+            return self._jitted(*args)
+
+    def cpu_call(self, *args):
+        """Dispatch through the CPU-fallback executable for this call's
+        signature, building it first if warmup never got to this rung.
+        Never consults the fault-injection dispatch seam: injected faults
+        model the *accelerator* tunnel, and the whole point of this path
+        is to keep serving while that tunnel is wedged."""
+        sig = self.signature(args)
+        with self._lock:
+            exe = self._cpu_execs.get(sig)
+        if exe is None:
+            self.warm_cpu(*_tree_avals(args))
+            with self._lock:
+                exe = self._cpu_execs.get(sig)
+        if exe is not None:
+            try:
+                return exe(*args)
+            except Exception:
+                logger.exception("CPU-fallback executable call failed for "
+                                 "%s; retrying via jit on the CPU device",
+                                 self.name)
+        import jax
+        with jax.default_device(jax.devices("cpu")[0]):
             return self._jitted(*args)
 
 

@@ -34,7 +34,7 @@ from typing import Any, Callable, Dict, Iterable, List, NamedTuple, Optional
 
 import numpy as np
 
-from analytics_zoo_tpu.common import telemetry
+from analytics_zoo_tpu.common import resilience, telemetry
 
 
 class StageTimer:
@@ -163,7 +163,11 @@ class DevicePipeline:
             done.append(self._retire())
         t0 = time.perf_counter()
         try:
-            pending = self._submit_fn(batch)
+            # fault_scope owns the "dispatch" arrival for this batch: the
+            # executable cache's seam underneath is suppressed, so a
+            # planned `wedge@dispatch:N` wedges exactly the Nth batch
+            with resilience.fault_scope("dispatch"):
+                pending = self._submit_fn(batch)
             err = None
         except Exception as e:
             # a dispatch-time failure rides the window like any other batch
@@ -184,6 +188,7 @@ class DevicePipeline:
                              t0, dispatch_s)
         t_fetch = time.perf_counter()
         try:
+            resilience.maybe_fault("fetch")
             host = self._fetch_fn(pending)
             err = None
         except Exception as e:

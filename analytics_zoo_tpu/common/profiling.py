@@ -318,6 +318,10 @@ class FlightRecorder:
         self._attached = False
         self._prev_handlers: Dict[int, Any] = {}
         self._seq = 0
+        # dump_once latch: trigger -> written path. The supervisor's
+        # wedge dump and a later SIGTERM dump each own a trigger key, so
+        # layered failure paths chain without double-writing an artifact.
+        self._dumped: Dict[str, str] = {}
         # explicit dir wins; otherwise resolved at dump time so the env
         # override works even on a singleton created before it was set
         self.dump_dir = dump_dir
@@ -389,9 +393,27 @@ class FlightRecorder:
         except Exception:
             return ""
 
+    def dump_once(self, trigger: str, reason: str = "",
+                  path: Optional[str] = None) -> str:
+        """Write at most one postmortem per ``trigger`` key for the life
+        of this recorder; repeat calls return the first call's path
+        (possibly "" if that dump failed — failure latches too, so a
+        dying process never retries dump I/O in a loop). This is how the
+        supervisor's wedge dump and the SIGTERM handler layer without
+        double-dumping."""
+        with self._lock:
+            if trigger in self._dumped:
+                return self._dumped[trigger]
+        out = self.dump(reason=reason or trigger, path=path)
+        with self._lock:
+            self._dumped.setdefault(trigger, out)
+            return self._dumped[trigger]
+
     # --------------------------------------------------------- signals
     def _handler(self, signum, frame):
-        self.dump(reason=f"signal-{signal.Signals(signum).name}")
+        self.dump_once(
+            trigger=f"signal-{signal.Signals(signum).name}",
+            reason=f"signal-{signal.Signals(signum).name}")
         prev = self._prev_handlers.get(signum)
         if callable(prev):
             prev(signum, frame)
@@ -408,7 +430,11 @@ class FlightRecorder:
         try:
             for sig in signals:
                 prev = signal.signal(sig, self._handler)
-                if sig not in self._prev_handlers:
+                # never chain to ourselves: re-arming after a prior arm
+                # would otherwise store self._handler as "previous" and
+                # recurse (double-dump) on delivery
+                if sig not in self._prev_handlers and \
+                        prev is not self._handler:
                     self._prev_handlers[sig] = prev
         except ValueError:
             return False
@@ -454,16 +480,25 @@ def maybe_arm_from_env() -> Optional[FlightRecorder]:
 _BACKEND_CACHE: Dict[str, Any] = {}
 
 
-def backend_state(timeout_s: float = 2.0) -> dict:
+def backend_state(timeout_s: float = 2.0, import_jax: bool = False) -> dict:
     """JAX backend/platform/device-count without ever blocking the
     caller: the probe runs in a daemon thread joined with a timeout, so a
     wedged accelerator tunnel yields ``{"status": "wedged"}`` instead of
     hanging a health endpoint. A successful probe is cached (the backend
     never changes within a process). If jax was never imported, reports
-    that rather than triggering device init from a mere probe."""
+    that rather than triggering device init from a mere probe — unless
+    ``import_jax`` (bench's watchdog *wants* the probe thread to pay the
+    init and prove it returns)."""
+    # fault-injection probe seam — checked before the success cache so a
+    # planned `wedge@probe` drill works even on an already-probed process
+    from analytics_zoo_tpu.common import resilience
+    injected = resilience.probe_fault()
+    if injected is not None:
+        return {"status": "wedged", "injected": injected,
+                "probe_timeout_s": timeout_s}
     if _BACKEND_CACHE.get("status") == "ok":
         return dict(_BACKEND_CACHE)
-    if "jax" not in sys.modules:
+    if not import_jax and "jax" not in sys.modules:
         return {"status": "jax-not-imported"}
     result: Dict[str, Any] = {}
 

@@ -750,6 +750,9 @@ def reset_for_tests():
     slo = sys.modules.get("analytics_zoo_tpu.common.slo")
     if slo is not None:
         slo.reset_for_tests()
+    res = sys.modules.get("analytics_zoo_tpu.common.resilience")
+    if res is not None:
+        res.reset_for_tests()
 
 
 def bench_snapshot() -> Dict[str, Any]:

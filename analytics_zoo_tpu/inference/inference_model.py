@@ -36,7 +36,7 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
-from analytics_zoo_tpu.common import compile_ahead, telemetry
+from analytics_zoo_tpu.common import compile_ahead, resilience, telemetry
 
 
 def _as_tuple(x):
@@ -320,18 +320,24 @@ class InferenceModel:
             return None
         if rungs is None:
             rungs = ladder.rungs if ladder is not None else ()
+        # ZOO_CPU_FALLBACK=1: each rung also gets a CPU executable so a
+        # wedged backend fails over to already-compiled code (ISSUE 7)
+        want_cpu = resilience.cpu_fallback_enabled()
         todo = []
         for rung in sorted({int(r) for r in rungs}):
             avals = self._aot_avals(params, spec, rung)
-            if not cache.ready(*avals):
+            if not cache.ready(*avals) or \
+                    (want_cpu and not cache.cpu_ready(*avals)):
                 todo.append(avals)
         if not todo:
             return None
         if block:
             for avals in todo:
                 cache.warm(*avals)
+                if want_cpu:
+                    cache.warm_cpu(*avals)
             return None
-        t = cache.warm_async(todo)
+        t = cache.warm_async(todo, cpu_also=want_cpu)
         with self._lock:
             self._warm_threads = [w for w in self._warm_threads
                                   if w.is_alive()] + [t]
@@ -487,6 +493,23 @@ class InferenceModel:
     def predict_fetch(self, pending):
         """Blocking host side of ``predict_async``."""
         return telemetry.traced_device_get(pending)
+
+    def predict_cpu(self, x):
+        """Synchronously predict ONE already-batched input on the host
+        CPU device — the serving engine's failover dispatch while the
+        accelerator backend is wedged. Goes through the executable
+        cache's CPU rung (pre-built during warmup under
+        ``ZOO_CPU_FALLBACK=1``) and deliberately bypasses the accelerator
+        dispatch path — and its fault-injection seam — entirely."""
+        import jax
+
+        params, jitted, n_inputs, cache, _ = self._snapshot()
+        xs = self._coerce(x, n_inputs)
+        self._remember_spec(xs)
+        if cache is not None:
+            return jax.device_get(cache.cpu_call(params, *xs))
+        with jax.default_device(jax.devices("cpu")[0]):
+            return jax.device_get(jitted(params, *xs))
 
     def predict_classes(self, x, batch_size: Optional[int] = None,
                         zero_based_label: bool = True) -> np.ndarray:

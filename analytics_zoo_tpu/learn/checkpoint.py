@@ -73,11 +73,65 @@ def find_latest_checkpoint(ckpt_dir: str) -> Optional[Tuple[str, int]]:
     return os.path.join(ckpt_dir, f"ckpt-{v}"), v
 
 
-def load_checkpoint(path: str, target: Any) -> Tuple[Any, dict]:
-    """Restore into the structure of ``target`` (a template state pytree)."""
+def validate_state(state: Any, target: Any) -> None:
+    """Check a restored ``state`` against the live ``target`` pytree:
+    same tree structure, and every array leaf with the shape/dtype the
+    live state expects. Raises ``ValueError`` on any mismatch — the
+    auto-resume path treats that exactly like a torn file and falls back
+    to the previous version instead of resuming into garbage."""
+    s_leaves, s_def = jax.tree_util.tree_flatten(state)
+    t_leaves, t_def = jax.tree_util.tree_flatten(target)
+    if s_def != t_def:
+        raise ValueError(
+            f"checkpoint tree structure mismatch: {s_def} != {t_def}")
+    for i, (s, t) in enumerate(zip(s_leaves, t_leaves)):
+        ss, ts = np.shape(s), np.shape(t)
+        if ss != ts:
+            raise ValueError(
+                f"checkpoint leaf {i} shape mismatch: {ss} != {ts}")
+        sd = getattr(s, "dtype", None)
+        td = getattr(t, "dtype", None)
+        if sd is not None and td is not None and np.dtype(sd) != \
+                np.dtype(td):
+            raise ValueError(
+                f"checkpoint leaf {i} dtype mismatch: {sd} != {td}")
+
+
+def load_checkpoint(path: str, target: Any,
+                    validate: bool = True) -> Tuple[Any, dict]:
+    """Restore into the structure of ``target`` (a template state pytree).
+
+    With ``validate`` (default), the restored tree is checked against
+    ``target`` for structure/shape/dtype drift — a truncated msgpack
+    already raises inside flax, but a *complete* file holding the wrong
+    model must not restore silently either."""
     from flax import serialization
     with open(os.path.join(path, "state.msgpack"), "rb") as fh:
         state = serialization.from_bytes(_to_host(target), fh.read())
     with open(os.path.join(path, "meta.json")) as fh:
         meta = json.load(fh)
+    if validate:
+        validate_state(state, target)
     return state, meta
+
+
+def load_latest_checkpoint(ckpt_dir: str, target: Any
+                           ) -> Optional[Tuple[Any, dict, str]]:
+    """Restore the newest checkpoint that loads *and validates* against
+    ``target``, walking versions newest→oldest past any corrupt one (a
+    torn ``state.msgpack`` from a crash mid-write, a missing meta, a
+    shape mismatch). Returns ``(state, meta, path)`` or None when no
+    version survives — the resilient read side of ``save_checkpoint``'s
+    atomic-rename write side, and what ``fit(auto_resume=True)`` reloads
+    through."""
+    for v in sorted(_list_versions(ckpt_dir), reverse=True):
+        path = os.path.join(ckpt_dir, f"ckpt-{v}")
+        try:
+            state, meta = load_checkpoint(path, target)
+            return state, meta, path
+        except Exception as e:
+            import logging
+            logging.getLogger(__name__).warning(
+                "checkpoint %s unusable (%s); trying the previous "
+                "version", path, e)
+    return None

@@ -36,7 +36,7 @@ import numpy as np
 
 from analytics_zoo_tpu.common import compile_ahead
 from analytics_zoo_tpu.common import profiling as profiling_lib
-from analytics_zoo_tpu.common import telemetry
+from analytics_zoo_tpu.common import resilience, telemetry
 from analytics_zoo_tpu.data.dataset import ShardedDataset, to_sharded_dataset
 from analytics_zoo_tpu.data.shard import HostXShards, XShards
 from analytics_zoo_tpu.learn import checkpoint as ckpt_lib
@@ -735,7 +735,8 @@ class JaxEstimator:
             steps_per_loop: int = 1,
             cache: Optional[str] = None,
             profile: bool = False,
-            profile_steps: Optional[Sequence[int]] = None
+            profile_steps: Optional[Sequence[int]] = None,
+            auto_resume: bool = False
             ) -> Dict[str, List[float]]:
         """(ref orca/learn/tf/estimator.py fit:486; batch_size is the GLOBAL
         batch — the reference required batch_size % num_workers == 0, here it
@@ -764,7 +765,18 @@ class JaxEstimator:
         decomposition through the telemetry registry: ``zoo_step_flops``
         (XLA ``cost_analysis`` of the compiled step), ``zoo_mfu``,
         ``zoo_hbm_bytes`` and the ``zoo_train_phase_seconds`` histogram
-        (data_wait/dispatch/device/callback) — see docs/observability.md."""
+        (data_wait/dispatch/device/callback) — see docs/observability.md.
+
+        ``auto_resume=True`` hardens the retry-from-snapshot boundary for
+        backend loss (a wedged/lost accelerator, or an injected
+        ``ZOO_FAULT_PLAN`` fault): the reload goes through
+        ``load_latest_checkpoint`` — which validates each version against
+        the live state and walks past corrupt ones — the retry budget is
+        ``ZOO_FIT_MAX_RESUMES`` (default ``failure_retry_times``), and the
+        failure is reported to the backend supervisor when one is
+        running. Step/epoch counters and data order restore exactly, so a
+        resumed run converges to the bitwise-identical loss of an
+        unfaulted one."""
         ds = self._coerce(to_sharded_dataset(data, feature_cols, label_cols))
         val_ds = (self._coerce(to_sharded_dataset(validation_data, feature_cols,
                                                   label_cols))
@@ -813,19 +825,31 @@ class JaxEstimator:
                         train_writer, checkpoint_trigger,
                         steps_per_loop=steps_per_loop, cache=cache,
                         step_prof=step_prof, profile_window=profile_window)
-                except Exception:
+                except Exception as e:
                     # elastic retry-from-snapshot (ref Topology.scala:1255-1337)
                     retries += 1
-                    if not self.model_dir or \
-                            retries > self.failure_retry_times:
+                    limit = self.failure_retry_times
+                    if auto_resume:
+                        resilience.note_backend_loss(e)
+                        limit = resilience.fit_max_resumes(limit)
+                    if not self.model_dir or retries > limit:
                         raise
-                    found = ckpt_lib.find_latest_checkpoint(self.model_dir)
-                    if found is None:
-                        raise
+                    if auto_resume:
+                        # validated reload: walks past torn/corrupt
+                        # versions instead of resuming into garbage
+                        path = self._auto_resume_reload()
+                        if path is None:
+                            raise
+                    else:
+                        found = ckpt_lib.find_latest_checkpoint(
+                            self.model_dir)
+                        if found is None:
+                            raise
+                        path = found[0]
+                        self.load_orca_checkpoint(path)
                     logger.exception(
                         "training step failed; retry %d/%d from %s",
-                        retries, self.failure_retry_times, found[0])
-                    self.load_orca_checkpoint(found[0])
+                        retries, limit, path)
                     continue
                 history["loss"].append(epoch_loss)
                 self._epoch += 1
@@ -1034,6 +1058,9 @@ class JaxEstimator:
                 t1 = time.perf_counter()
                 sampled = step_prof is not None and \
                     step_prof.should_sample(self._py_step)
+                # fault-injection step seam: one arrival per compiled
+                # train dispatch (a fused scan counts once)
+                resilience.maybe_fault("step")
                 self._state, loop_losses = self._train_scan(self._state,
                                                             (x, y))
                 t2 = time.perf_counter()
@@ -1068,6 +1095,8 @@ class JaxEstimator:
                 t1 = time.perf_counter()
                 sampled = step_prof is not None and \
                     step_prof.should_sample(self._py_step)
+                # fault-injection step seam: one arrival per train step
+                resilience.maybe_fault("step")
                 self._state, logs = self._train_step(self._state, x, y)
                 t2 = time.perf_counter()
                 device_s = None
@@ -1205,6 +1234,23 @@ class JaxEstimator:
         self._epoch = int(meta.get("epoch", 0))
         self._py_step = int(meta.get("iteration", 0))
         return self
+
+    def _auto_resume_reload(self) -> Optional[str]:
+        """Reload the newest checkpoint that validates against the live
+        state tree (``fit(auto_resume=True)``'s retry boundary). Restores
+        step/epoch counters for metric continuity; returns the restored
+        path, or None when no version in ``model_dir`` is usable."""
+        import jax
+        self._init_state()
+        host_state = jax.device_get(self._state)
+        loaded = ckpt_lib.load_latest_checkpoint(self.model_dir, host_state)
+        if loaded is None:
+            return None
+        state, meta, path = loaded
+        self._state = jax.device_put(state, self._state_sharding_tree)
+        self._epoch = int(meta.get("epoch", 0))
+        self._py_step = int(meta.get("iteration", 0))
+        return path
 
     def get_model(self):
         """Current host-side params pytree (ref spark_estimator.get_model)."""

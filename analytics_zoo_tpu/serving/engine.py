@@ -34,7 +34,8 @@ import numpy as np
 
 # StageTimer moved to the shared pipeline layer; re-exported here because
 # the engine is its historical home.
-from analytics_zoo_tpu.common import compile_ahead, fleet, telemetry
+from analytics_zoo_tpu.common import compile_ahead, fleet, resilience, \
+    telemetry
 from analytics_zoo_tpu.common.pipeline_io import (  # noqa: F401
     Completed,
     DevicePipeline,
@@ -205,6 +206,16 @@ class ClusterServing:
         self._advertise = ("127.0.0.1", 0)
         self._started_wall = 0.0
         self._heartbeater: Optional[fleet.Heartbeater] = None
+        # wedge failover (ISSUE 7): with ZOO_CPU_FALLBACK=1 a backend-loss
+        # error drains the window onto pre-built CPU executables and keeps
+        # serving degraded until the supervisor reports recovered. The
+        # flag/t0/seconds are written on the serve thread and read from
+        # frontend/bench threads — all under _state_lock.
+        self._cpu_fallback = resilience.cpu_fallback_enabled()
+        self._supervisor: Optional[resilience.BackendSupervisor] = None
+        self._failover = False
+        self._failover_t0: Optional[float] = None
+        self.failover_seconds: List[float] = []
 
     def _decode_images(self, inputs):
         """Decode any raw-image entries and run the preprocessing chain
@@ -334,7 +345,9 @@ class ClusterServing:
         # dispatch/device timing into per-uri spans
         trace = (t_dq0, t_dq1, t0, t_pp1) \
             if self._tracer.should_sample() else None
-        return x, (uris, err_cmds, ack_cmds, n, trace, metas)
+        # x rides the ctx too so a backend-lost batch can be re-dispatched
+        # on the CPU fallback at retire time (_failover_redispatch)
+        return x, (uris, err_cmds, ack_cmds, n, trace, metas, x)
 
     def _queue_wait(self, meta, t_dq1: float):
         """Measure one record's broker queue wait from its client stamp.
@@ -467,7 +480,14 @@ class ClusterServing:
     def _dispatch(self, x):
         """Device stage: non-blocking when the model supports it (an
         InferenceModel dispatches the jitted executable and returns device
-        futures); duck-typed models fall back to their blocking predict."""
+        futures); duck-typed models fall back to their blocking predict.
+        While failover is active, dispatch routes to the pre-built CPU
+        rung instead — synchronous by nature, the host result rides the
+        pipeline window as-is."""
+        if self.failover_active:
+            cpu_predict = getattr(self.model, "predict_cpu", None)
+            if cpu_predict is not None:
+                return cpu_predict(x)
         fn = getattr(self.model, "predict_async", None)
         return fn(x) if fn is not None else self.model.predict(x)
 
@@ -475,10 +495,74 @@ class ClusterServing:
         fn = getattr(self.model, "predict_fetch", None)
         return np.asarray(fn(pending) if fn is not None else pending)
 
+    # ----------------------------------------------------------- failover
+    @property
+    def failover_active(self) -> bool:
+        """True while dispatch is swapped onto the CPU fallback rungs —
+        /healthz reports degraded-but-serving (never 503) in this mode."""
+        with self._state_lock:
+            return self._failover
+
+    def _enter_failover(self, err):
+        with self._state_lock:
+            if self._failover:
+                return
+            self._failover = True
+            self._failover_t0 = time.perf_counter()
+        logger.warning("backend loss (%s); draining onto the CPU "
+                       "fallback rungs", err)
+        if self._supervisor is not None:
+            self._supervisor.report_failure(err)
+
+    def _exit_failover(self):
+        with self._state_lock:
+            if not self._failover:
+                return
+            self._failover = False
+            self._failover_t0 = None
+        logger.warning("backend recovered; dispatch swapped back to the "
+                       "accelerator rungs")
+
+    def _failover_redispatch(self, client: BrokerClient,
+                             comp: Completed) -> Optional[int]:
+        """Re-run one backend-lost batch through the pre-built CPU
+        executable and flush its real results — the drain half of
+        failover. Returns the flushed record count, or None when this
+        batch cannot fail over (no CPU predict on the model, a ctx that
+        predates the wiring, or the CPU path failing too) — the caller
+        then falls through to the normal error-result path."""
+        x = comp.ctx[6] if len(comp.ctx) > 6 else None
+        cpu_predict = getattr(self.model, "predict_cpu", None)
+        if x is None or cpu_predict is None:
+            return None
+        self._enter_failover(comp.error)
+        try:
+            preds = np.asarray(cpu_predict(x))
+        except Exception:
+            logger.exception("CPU failover redispatch failed; falling "
+                             "back to error results")
+            return None
+        with self._state_lock:
+            t0, self._failover_t0 = self._failover_t0, None
+        if t0 is not None:
+            # drain → first CPU result: serving_failover_seconds in bench
+            dt = time.perf_counter() - t0
+            with self._state_lock:
+                self.failover_seconds.append(dt)
+            self.timer.record("failover", dt)
+        return self._finish(client, comp._replace(result=preds, error=None))
+
     def _finish(self, client: BrokerClient, comp: Completed) -> int:
         """Drain stage: postprocess + result/ack flush for one retired
-        batch."""
-        uris, err_cmds, ack_cmds, n, trace, metas = comp.ctx
+        batch. A batch lost to the *backend* (not a model bug) first gets
+        one shot at the CPU failover path — only when that is off or also
+        fails do its records get error results."""
+        if comp.error is not None and self._cpu_fallback \
+                and resilience.is_backend_loss(comp.error):
+            served = self._failover_redispatch(client, comp)
+            if served is not None:
+                return served
+        uris, err_cmds, ack_cmds, n, trace, metas = comp.ctx[:6]
         if err_cmds:
             self._err_counter.inc(len(err_cmds))
         if comp.error is not None:
@@ -607,6 +691,12 @@ class ClusterServing:
                     # loaded yet) — kick the ladder warmup the moment it
                     # can describe its shapes
                     self._kick_warmup()
+                if self._supervisor is not None and self.failover_active \
+                        and self._supervisor.state == \
+                        resilience.BackendSupervisor.OK:
+                    # the supervisor's probe streak says the backend is
+                    # back: swap dispatch off the CPU rungs
+                    self._exit_failover()
                 self._serve_once(client, pipe)
             except (ConnectionError, OSError):
                 # broker died or the socket went bad: DROP the client and
@@ -662,6 +752,14 @@ class ClusterServing:
         # replica leaves evidence of what its pipeline was doing
         from analytics_zoo_tpu.common import profiling
         profiling.maybe_arm_from_env()
+        # supervise the backend only when failover can act on its verdicts
+        # (or a fault drill wants to observe them) — plain deployments get
+        # no extra thread
+        if self._cpu_fallback or resilience.fault_plan_active():
+            sup = resilience.get_supervisor()
+            with self._state_lock:
+                self._supervisor = sup
+            sup.ensure_started()
         if self._warmup_enabled:
             # persistent XLA cache + background AOT over the whole ladder:
             # the serve thread then swaps buckets without ever compiling
@@ -690,6 +788,12 @@ class ClusterServing:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        # the supervisor is a process singleton, but the engine is the
+        # process's deployment unit — stop the probe loop with the serving
+        with self._state_lock:
+            sup, self._supervisor = self._supervisor, None
+        if sup is not None:
+            sup.stop()
 
     def metrics(self) -> Dict:
         """Throughput + stage latencies (ref Flink numRecordsOutPerSecond +

@@ -42,7 +42,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from analytics_zoo_tpu.common import fleet, profiling, slo, telemetry
+from analytics_zoo_tpu.common import fleet, profiling, resilience, slo, \
+    telemetry
 from analytics_zoo_tpu.serving import schema
 from analytics_zoo_tpu.serving.broker import BrokerClient
 from analytics_zoo_tpu.serving.client import (INPUT_STREAM, InputQueue,
@@ -213,7 +214,16 @@ class _Handler(BaseHTTPRequestHandler):
         mon.tick_if_stale()
         shedding = mon.overloaded()
         out["slo"] = {"burn_rates": mon.burn_rates(), "shedding": shedding}
-        if code == 200 and shedding:
+        # CPU failover (ISSUE 7): a replica still answering every record
+        # on its fallback rungs is degraded, NOT down — shedding it would
+        # turn a survived wedge into an outage, so the SLO trip (whose
+        # burn is dominated by the wedge itself) is suppressed while the
+        # engine reports failover
+        failover = bool(engine is not None
+                        and getattr(engine, "failover_active", False))
+        if failover:
+            out["failover"] = "cpu-fallback"
+        if code == 200 and shedding and not failover:
             out["status"] = "overloaded"
             out["reason"] = "slo-burn"
             code = 503
@@ -221,7 +231,13 @@ class _Handler(BaseHTTPRequestHandler):
         # replica is visible from the probe itself; the probe thread is
         # timeout-joined, so a wedged backend can never hang /healthz
         out["backend"] = profiling.backend_state(timeout_s=2.0)
-        if out["backend"].get("status") == "wedged" and code == 200:
+        sup = resilience.supervisor_snapshot()
+        if sup is not None:
+            out["backend_supervisor"] = sup
+        if code == 200 and (failover
+                            or out["backend"].get("status") == "wedged"
+                            or (sup or {}).get("state")
+                            in ("suspect", "wedged", "recovering")):
             out["status"] = "degraded"
         self._json(code, out, path="/healthz")
 

@@ -46,12 +46,14 @@ REGRESSION_THRESHOLD = float(
 
 def _flight_dump(note: str, reason: str = "bench-wedge") -> str:
     """Best-effort flight-recorder postmortem under zoo_tpu_logs/ — a
-    wedged run leaves its last spans + metrics snapshot. Never raises."""
+    wedged run leaves its last spans + metrics snapshot. Never raises.
+    Goes through the ``dump_once`` latch so a SIGTERM or supervisor dump
+    for the same trigger cannot double-write the artifact."""
     try:
         from analytics_zoo_tpu.common import profiling
         fr = profiling.get_flight_recorder()
         fr.note(note)
-        path = fr.dump(reason=reason)
+        path = fr.dump_once(trigger=reason, reason=reason)
         if path:
             print(f"# bench: flight recorder dumped to {path}",
                   file=sys.stderr, flush=True)
@@ -468,6 +470,58 @@ def _measure_cold_start():
     }
 
 
+def measure_serving_failover():
+    """Wedge→CPU-failover drill (ISSUE 7): under a deterministic
+    ``ZOO_FAULT_PLAN`` the accelerator dispatch dies mid-stream; the
+    engine must drain onto the CPU executables pre-built at warmup and
+    answer EVERY record, then swap back when the supervisor reports
+    recovery. ``serving_failover_seconds`` (backend loss → first CPU
+    result) is the gated lower-better headline. Fixed tiny shapes in
+    both smoke and full mode — the drill measures failover latency and
+    completeness, not throughput."""
+    import numpy as np
+    import flax.linen as nn
+    from analytics_zoo_tpu.common import resilience
+    from analytics_zoo_tpu.inference import InferenceModel
+    from analytics_zoo_tpu.serving import (
+        Broker, ClusterServing, InputQueue, OutputQueue,
+    )
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(3)(nn.relu(nn.Dense(16)(x)))
+
+    n, batch = 48, 4
+    rng = np.random.default_rng(7)
+    payloads = rng.standard_normal((n, 5)).astype(np.float32)
+    im = InferenceModel().load_flax(Net(), payloads[:batch])
+    # wedge the 6th-7th dispatches and the first two health probes: the
+    # stream starts on-device, loses the backend mid-flight, serves the
+    # rest on CPU, and recovers once the probe plan is exhausted
+    with resilience.fault_drill("wedge@dispatch:6+2,wedge@probe:1+2"), \
+            Broker.launch() as broker:
+        eng = ClusterServing(im, broker.port, batch_size=batch,
+                             max_batch_size=batch, pipeline_window=2)
+        with eng.start():
+            eng.wait_warm(timeout=120.0)
+            in_q = InputQueue(port=broker.port)
+            out_q = OutputQueue(port=broker.port)
+            uris = in_q.enqueue_batch(
+                (f"fo{i}", {"x": payloads[i]}) for i in range(n))
+            res = out_q.query_many(uris, timeout=90.0)
+            missing = [u for u, v in res.items() if v is None]
+            failover_s = list(eng.failover_seconds)
+            sup = eng._supervisor.snapshot() if eng._supervisor else {}
+    assert not missing, f"{len(missing)} records dropped during failover"
+    assert failover_s, "fault plan armed but no failover was recorded"
+    return {
+        "serving_failover_seconds": round(failover_s[0], 4),
+        "serving_failover_records": n,
+        "serving_failover_episodes": int(sup.get("episodes", 0)),
+    }
+
+
 def measure_tcn():
     """Zouwu TCN (ref tcn.py:91): training steps/sec on rolling windows."""
     import numpy as np
@@ -756,9 +810,19 @@ def _cpu_fallback_line(wedge_note: str, timeout_s: float = 2400.0):
 
 
 def _emit_cpu_fallback_and_exit(note: str, timeout_s: float = 2400.0):
-    """Shared wedge protocol: flight-recorder postmortem, then the labeled
-    CPU-fallback line (or the 0.0 stub if even that fails), then exit 3."""
-    _flight_dump(note)
+    """Shared wedge protocol: the verdict flows through the backend
+    supervisor (``zoo_backend_state`` gauge, ``zoo_backend_failovers_total``
+    counter, ONE latched flight-recorder postmortem — the same path the
+    serving engine fails over through), then the labeled CPU-fallback line
+    (or the 0.0 stub if even that fails), then exit 3. The subprocess
+    fallback itself must stay: a wedged backend *init* holds jax's global
+    backend lock in-process, so no in-process CPU swap is possible here —
+    only the engine's dispatch-level failover can swap in-process."""
+    try:
+        from analytics_zoo_tpu.common import resilience
+        resilience.get_supervisor(import_jax=True).force_wedged(note)
+    except Exception:
+        _flight_dump(note)      # supervisor unavailable: direct postmortem
     line, failure = _cpu_fallback_line(note, timeout_s=timeout_s)
     if line is None:
         line = json.dumps({
@@ -834,9 +898,12 @@ def _find_previous_bench_record(bench_dir: str | None = None):
 # ahead headline and must stay lower-better even if the generic _seconds
 # rule is ever narrowed. Likewise _p50_ms/_p99_ms (ISSUE 6): the serving
 # latency tail is the SLO headline — it must gate lower-better even if
-# the blanket _ms rule is ever narrowed to per-op timings
+# the blanket _ms rule is ever narrowed to per-op timings. Same for
+# failover_seconds (ISSUE 7): drain→first-CPU-result is the resilience
+# headline and must stay lower-better independent of the _seconds rule
 _LOWER_BETTER_SUFFIXES = ("_p50_ms", "_p99_ms", "_ms", "_ms_per_batch32",
-                          "cold_start_seconds", "_seconds", "_s")
+                          "cold_start_seconds", "failover_seconds",
+                          "_seconds", "_s")
 # bookkeeping fields that are numeric but not performance metrics
 _GATE_SKIP = {"n", "rc"}
 
@@ -1019,7 +1086,8 @@ def _cpu_emit():
             "vs_baseline": rec.get("vs_baseline")}
     except Exception:
         pass
-    print(json.dumps(_assemble_record(out, (measure_tcn, measure_serving))))
+    print(json.dumps(_assemble_record(
+        out, (measure_tcn, measure_serving, measure_serving_failover))))
 
 
 def _device_watchdog(timeout_s: float = 180.0):
@@ -1069,7 +1137,7 @@ def _smoke():
         "mode": "smoke",
         "device": jax.devices()[0].device_kind,
     }
-    rec = _assemble_record(out, (measure_serving,))
+    rec = _assemble_record(out, (measure_serving, measure_serving_failover))
     if fr is not None:
         # armed smoke leaves the artifact the CI lane asserts on
         fr.note("smoke complete")
@@ -1109,8 +1177,9 @@ def main():
     }
     _run_with_deadline(
         out, (measure_bert, measure_tcn, measure_serving,
-              measure_flash_attention, measure_int8_predict,
-              measure_resnet50_train, measure_widedeep_train),
+              measure_serving_failover, measure_flash_attention,
+              measure_int8_predict, measure_resnet50_train,
+              measure_widedeep_train),
         deadline_s=float(os.environ.get("BENCH_DEADLINE_S", 2700)))
 
 

@@ -8,7 +8,7 @@
 #   dev/run-tests.sh core         # one lane
 #   dev/run-tests.sh smoke        # fast pre-push subset (<5 min, 1 core)
 #   Lanes: smoke core data keras models zouwu automl serving interop
-#          examples telemetry fleet zoolint
+#          examples telemetry fleet resilience zoolint
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -102,6 +102,35 @@ PY
   # registry + SLO burn units, and the two-replica federation smoke
   # (subprocess engines, one broker, merged /metrics?scope=fleet)
   fleet)    run -m "not slow" tests/test_fleet.py ;;
+  # wedge resilience (ISSUE 7): fault injector, backend supervisor,
+  # checkpoint fallback, fit auto-resume, serving failover — then an
+  # armed bench smoke whose built-in wedge drill must leave a
+  # backend-wedged postmortem AND a completed CPU failover on the record
+  resilience) run -m "not slow" tests/test_resilience.py
+            echo "== bench --smoke resilience (wedge drill armed)"
+            frdir="$(mktemp -d)"
+            ZOO_FLIGHT_RECORDER=1 ZOO_FLIGHT_RECORDER_DIR="$frdir" \
+              JAX_PLATFORMS=cpu python bench.py --smoke resilience \
+              > "$frdir/smoke.json"
+            python - "$frdir" <<'PY'
+import glob, json, sys
+frdir = sys.argv[1]
+rec = json.load(open(frdir + "/smoke.json"))
+assert rec["mode"] == "smoke", rec.keys()
+# the drill's wedge completed a measured failover: every record served,
+# drain->first-CPU-result latency on the (lower-better-gated) record
+assert rec.get("serving_failover_seconds", -1) >= 0, \
+    f"no completed failover on record: {rec.get('serving_failover_seconds')}"
+assert rec.get("serving_failover_episodes", 0) >= 1, \
+    "supervisor never entered wedged during the drill"
+# the supervisor wedge verdict left exactly one latched postmortem
+dumps = [p for p in glob.glob(frdir + "/flightrec_*.json")
+         if json.load(open(p)).get("reason") == "backend-wedged"]
+assert len(dumps) == 1, f"expected 1 backend-wedged dump, got {len(dumps)}"
+print(f"failover OK: {rec['serving_failover_seconds']}s "
+      f"episodes={rec['serving_failover_episodes']} dump={dumps[0]}")
+PY
+            ;;
   release)  bash "$(dirname "$0")/release.sh" ;;
   all)      lint_zoolint
             run tests/ ;;
