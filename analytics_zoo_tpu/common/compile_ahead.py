@@ -419,7 +419,13 @@ class ExecutableCache:
         the rung most likely to be needed next lands earliest. With
         ``cpu_also`` each rung's CPU-fallback executable is built right
         after its device one (failover is useless for rungs that would
-        compile on the serve thread mid-wedge)."""
+        compile on the serve thread mid-wedge).
+
+        After the rungs land, the thread also works off any queued kernel
+        autotune requests (ops/autotune.py ``tune_pending``): shapes whose
+        verdict was missing when a traced call first saw them get measured
+        here, off the serve thread, so the next dispatch picks the tuned
+        kernel without ever paying tuning latency in-band."""
         sets = [tuple(s) for s in aval_sets]
 
         def worker():
@@ -429,6 +435,14 @@ class ExecutableCache:
                 self.warm(*avals)
                 if cpu_also and not _draining.is_set():
                     self.warm_cpu(*avals)
+            if _draining.is_set():
+                return
+            try:
+                from analytics_zoo_tpu.ops import autotune
+                autotune.tune_pending()
+            except Exception:
+                logger.exception("background autotune failed for %s",
+                                 self.name)
 
         t = threading.Thread(target=worker, daemon=True,
                              name=f"zoo-warmup-{self.name}")

@@ -318,20 +318,54 @@ class Masking(KerasLayer):
 
 # ---------------- embeddings ----------------
 
+class _EmbedTable(nn.Module):
+    """Bare embedding-table parameter, bit-compatible with ``nn.Embed``:
+    same param name ("embedding"), same init call signature, fp32 param —
+    so a checkpoint / param_rules regex written against nn.Embed keeps
+    working — but ``__call__`` returns the TABLE itself, letting callers
+    feed the pallas gather/pool kernels (ops/embedding_bag.py) instead of
+    nn.Embed's per-table ``jnp.take``."""
+
+    vocab: int
+    features: int
+    init: Callable = nn.initializers.normal(0.05)
+
+    @nn.compact
+    def __call__(self):
+        return self.param("embedding", self.init,
+                          (self.vocab, self.features), jnp.float32)
+
+
 class Embedding(KerasLayer):
     """(ref keras/layers/embeddings.py; Scala Embedding.scala). On TPU the
     lookup lowers to a one-hot matmul/gather on the MXU; the table can be
-    model-parallel via param_rules matching 'embedding'."""
+    model-parallel via param_rules matching 'embedding'.
+
+    ``pooling``: None (default) keeps the per-id lookup ``[..., k] →
+    [..., k, dim]``; "sum"/"mean" treat the last input axis as a BAG of
+    ids and pool rows into one ``[..., dim]`` vector per bag via the
+    fused embedding-bag kernel (ops/embedding_bag.py) — the multi-hot
+    recommendation pattern without materializing the gathered rows."""
 
     def __init__(self, input_dim: int, output_dim: int, init="uniform",
                  input_length=None, input_shape=None, name=None,
-                 zero_based_id: bool = True):
+                 zero_based_id: bool = True,
+                 pooling: Optional[str] = None):
         super().__init__(name, input_shape)
         self.input_dim, self.output_dim = input_dim, output_dim
         self.init = get_init(init)
         self.zero_based_id = zero_based_id
+        if pooling not in (None, "sum", "mean"):
+            raise ValueError(f"pooling must be None/'sum'/'mean', "
+                             f"got {pooling!r}")
+        self.pooling = pooling
 
     def make_module(self):
+        if self.pooling is not None:
+            # bag mode needs the raw table for the pallas kernel; the
+            # param tree stays identical to the nn.Embed formulation
+            return _EmbedTable(self.input_dim, self.output_dim,
+                               init=self.init, name=self.name)
         return nn.Embed(self.input_dim, self.output_dim,
                         embedding_init=self.init, dtype=self.compute_dtype,
                         name=self.name)
@@ -340,11 +374,87 @@ class Embedding(KerasLayer):
         ids = args[0].astype(jnp.int32)
         if not self.zero_based_id:
             ids = ids - 1  # ref WordEmbedding 1-based vocab ids
-        return module(ids)
+        if self.pooling is None:
+            return module(ids)
+        from analytics_zoo_tpu.ops.embedding_bag import embedding_bag
+        table = module()
+        if self.compute_dtype is not None:
+            table = table.astype(self.compute_dtype)
+        return embedding_bag(table, ids, mode=self.pooling)
 
     def _infer_shape(self, in_shapes):
         s = in_shapes[0]
-        return tuple(s) + (self.output_dim,) if s is not None else None
+        if s is None:
+            return None
+        if self.pooling is not None:
+            return tuple(s[:-1]) + (self.output_dim,)
+        return tuple(s) + (self.output_dim,)
+
+
+class FusedEmbeddings(KerasLayer):
+    """N per-column embedding tables served by ONE fused lookup.
+
+    ``specs``: sequence of ``(table_name, vocab, dim)``. The input is
+    ``[batch, n_tables]`` integer ids — ``ids[:, t]`` indexes table ``t``
+    — and the rows combine per ``combine``: "concat" (side by side, the
+    Wide&Deep / NCF-MLP pattern), "sum"/"mean"/"mul" (elementwise, equal
+    dims; "mul" is the NCF GMF branch). On TPU the whole thing is one
+    pallas kernel (ops/embedding_bag.py ``fused_embedding_lookup``) whose
+    scalar-prefetch grid DMAs exactly the gathered rows — replacing
+    n_tables separate Select→Embed gathers with one VMEM pass. Dispatch
+    is verdict-driven (ops/autotune.py): the kernel only engages where a
+    measurement beat the pure-jax reference.
+
+    Each table materializes as a top-level ``_EmbedTable`` child named
+    ``table_name``, so the param tree — names, shapes, AND init values
+    (flax derives the init RNG from the module path) — is identical to
+    the per-column ``Embedding(name=table_name)`` formulation this
+    replaces; checkpoints and tp param_rules carry over unchanged."""
+
+    def __init__(self, specs, combine: str = "concat", init="uniform",
+                 zero_based_id: bool = True,
+                 use_kernel: Optional[bool] = None,
+                 input_shape=None, name=None):
+        super().__init__(name, input_shape)
+        self.specs = [(str(n), int(v), int(d)) for n, v, d in specs]
+        assert self.specs, "FusedEmbeddings needs at least one table"
+        if combine not in ("concat", "sum", "mean", "mul"):
+            raise ValueError(f"unknown combine {combine!r}")
+        if combine != "concat":
+            dims = {d for _, _, d in self.specs}
+            assert len(dims) == 1, \
+                f"combine={combine!r} needs equal dims, got {sorted(dims)}"
+        self.combine = combine
+        self.init = get_init(init)
+        self.zero_based_id = zero_based_id
+        self.use_kernel = use_kernel
+
+    def make_module(self):
+        return None  # tables instantiate inside apply (compact context)
+
+    def apply(self, module, args, train):
+        from analytics_zoo_tpu.ops.embedding_bag import (
+            fused_embedding_lookup,
+        )
+        ids = args[0].astype(jnp.int32)
+        if not self.zero_based_id:
+            ids = ids - 1
+        tables = []
+        for tname, vocab, dim in self.specs:
+            t = _EmbedTable(vocab, dim, init=self.init, name=tname)()
+            if self.compute_dtype is not None:
+                t = t.astype(self.compute_dtype)
+            tables.append(t)
+        return fused_embedding_lookup(tables, ids, combine=self.combine,
+                                      use_kernel=self.use_kernel)
+
+    def _infer_shape(self, in_shapes):
+        s = in_shapes[0]
+        if s is None:
+            return None
+        d = (sum(d for _, _, d in self.specs) if self.combine == "concat"
+             else self.specs[0][2])
+        return tuple(s[:-1]) + (d,)
 
 
 # ---------------- normalization ----------------
@@ -1775,7 +1885,10 @@ class SparseDense(Dense):
 
 
 class SparseEmbedding(Embedding):
-    """(ref embeddings.py SparseEmbedding; dense gather on TPU)."""
+    """(ref embeddings.py SparseEmbedding). On TPU "sparse" gradients buy
+    nothing (the scatter-add is dense anyway), so this is Embedding — with
+    the same ``pooling="sum"/"mean"`` bag mode riding the fused
+    embedding-bag kernel for multi-hot columns."""
 
 
 class WordEmbedding(KerasLayer):

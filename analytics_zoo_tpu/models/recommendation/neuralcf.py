@@ -37,25 +37,27 @@ class NeuralCF(Recommender):
         self.model = self.build_model()
 
     def build_model(self):
-        # (ref neuralcf.py:70-96 build_model, layer-for-layer)
+        # (ref neuralcf.py:70-96 build_model). Same graph, but each
+        # branch's Select→Embedding pairs collapse into ONE fused
+        # two-table lookup (zl.FusedEmbeddings → ops/embedding_bag.py):
+        # the [batch, 2] input feeds the kernel directly, user and item
+        # rows gather in a single VMEM pass and combine in-kernel
+        # ("concat" for the MLP tower, "mul" for GMF). Table names /
+        # param tree are unchanged from the per-column formulation.
         inp = Input(shape=(2,))
-        user = zl.Select(1, 0)(inp)   # [batch] user ids
-        item = zl.Select(1, 1)(inp)
-        mlp_user = zl.Embedding(self.user_count + 1, self.user_embed,
-                                init="uniform", name="mlp_user_embed")(user)
-        mlp_item = zl.Embedding(self.item_count + 1, self.item_embed,
-                                init="uniform", name="mlp_item_embed")(item)
-        latent = zl.merge([mlp_user, mlp_item], mode="concat")
+        latent = zl.FusedEmbeddings(
+            [("mlp_user_embed", self.user_count + 1, self.user_embed),
+             ("mlp_item_embed", self.item_count + 1, self.item_embed)],
+            combine="concat", init="uniform", name="mlp_embed_bag")(inp)
         linear = zl.Dense(self.hidden_layers[0], activation="relu")(latent)
         for units in self.hidden_layers[1:]:
             linear = zl.Dense(units, activation="relu")(linear)
         if self.include_mf:
             assert self.mf_embed > 0
-            mf_user = zl.Embedding(self.user_count + 1, self.mf_embed,
-                                   init="uniform", name="mf_user_embed")(user)
-            mf_item = zl.Embedding(self.item_count + 1, self.mf_embed,
-                                   init="uniform", name="mf_item_embed")(item)
-            mf_latent = zl.merge([mf_user, mf_item], mode="mul")
+            mf_latent = zl.FusedEmbeddings(
+                [("mf_user_embed", self.user_count + 1, self.mf_embed),
+                 ("mf_item_embed", self.item_count + 1, self.mf_embed)],
+                combine="mul", init="uniform", name="mf_embed_bag")(inp)
             concated = zl.merge([linear, mf_latent], mode="concat")
             out = zl.Dense(self.class_num, activation="softmax")(concated)
         else:

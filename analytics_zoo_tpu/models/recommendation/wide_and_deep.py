@@ -98,11 +98,19 @@ class WideAndDeep(Recommender):
     def _deep_merge(self, input_ind, input_emb, input_con):
         info = self.column_info
         embeds = []
-        for i, (in_dim, out_dim) in enumerate(zip(info.embed_in_dims,
-                                                  info.embed_out_dims)):
-            ids = zl.Select(1, i)(input_emb)
-            embeds.append(zl.Embedding(in_dim + 1, out_dim, init="normal",
-                                       name=f"embed_{i}")(ids))
+        if info.embed_cols:
+            # all categorical columns in ONE fused lookup
+            # (zl.FusedEmbeddings → ops/embedding_bag.py): the
+            # [batch, n_cols] id tensor feeds the kernel directly and the
+            # per-column rows concatenate in-kernel — replacing n_cols
+            # Select→Embedding gathers. Table names embed_{i} / param
+            # tree unchanged from the per-column formulation.
+            embeds.append(zl.FusedEmbeddings(
+                [(f"embed_{i}", in_dim + 1, out_dim)
+                 for i, (in_dim, out_dim) in enumerate(
+                     zip(info.embed_in_dims, info.embed_out_dims))],
+                combine="concat", init="normal",
+                name="embed_columns")(input_emb))
         has_ind = len(info.indicator_dims) > 0
         has_emb = len(info.embed_cols) > 0
         has_con = len(info.continuous_cols) > 0

@@ -27,33 +27,51 @@ def dot_product_attention(q, k, v, mask=None, causal: bool = False,
                           use_flash: Optional[bool] = None):
     """q,k,v: [batch, seq, heads, head_dim] → [batch, seq, heads, head_dim].
 
-    ``use_flash=None`` auto-selects the pallas kernel on TPU when shapes are
-    tile-aligned.
+    ``use_flash=None`` auto-selects the pallas path on TPU: a persisted
+    autotuner verdict for the shape wins outright; without one, the HBM
+    heuristic below decides. ``use_flash=True`` routes through
+    ``ops.autotune.auto_flash_attention`` — the tuned block config when
+    the measurement says the kernel beats blockwise, the blockwise
+    reference otherwise — so forcing flash can never be slower than the
+    fallback (the 0.676× regression class from BENCH r5).
     """
     if use_flash is None:
         use_flash = _flash_ok(q, k, mask)
-    if use_flash:
-        from analytics_zoo_tpu.ops.flash_attention import flash_attention
-        return flash_attention(q, k, v, causal=causal)
+    if use_flash and mask is None:
+        from analytics_zoo_tpu.ops.autotune import auto_flash_attention
+        return auto_flash_attention(q, k, v, causal=causal)
     return _reference_attention(q, k, v, mask=mask, causal=causal)
 
 
 def _flash_ok(q, k, mask) -> bool:
-    """Use the pallas kernel only where it wins: long sequences whose full
-    [b,h,sq,sk] score matrix would blow HBM (measured on v5e: XLA's fused
-    attention is faster up to ~4k seq; beyond that the O(s²) buffer
-    dominates)."""
+    """Use the pallas path only where it wins. A persisted autotune verdict
+    for this exact shape is the ground truth; with no verdict yet, the
+    structural heuristic: long sequences whose full [b,h,sq,sk] score
+    matrix would blow HBM (measured on v5e: XLA's fused attention is
+    faster up to ~4k seq; beyond that the O(s²) buffer dominates). The
+    kernels pad internally now, so neither ragged seq lengths nor
+    head_dim % 128 != 0 (the 64-dim BERT class) disqualify a shape."""
     if mask is not None:
         return False
     try:
         on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     except Exception:  # pragma: no cover
         return False
+    if not on_tpu:
+        return False
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    aligned = sq % 128 == 0 and sk % 128 == 0 and d % 128 == 0
+    try:
+        from analytics_zoo_tpu.ops import autotune
+        rec = autotune.get_tuner().lookup(
+            autotune.attention_key(b, sq, sk, h, d, q.dtype, False),
+            "flash_attention")
+        if rec is not None:
+            return bool(rec.get("use_kernel"))
+    except Exception:  # pragma: no cover - verdict cache is best-effort
+        pass
     scores_bytes = 4 * b * h * sq * sk
-    return on_tpu and aligned and scores_bytes > (1 << 31)  # > 2 GiB
+    return scores_bytes > (1 << 31)  # > 2 GiB
 
 
 def _reference_attention(q, k, v, mask=None, causal=False,
@@ -125,6 +143,10 @@ class AttentionModule(nn.Module):
     causal: bool = False
     dtype: Optional[jnp.dtype] = None
     self_attention: Optional[bool] = None
+    # None → dot_product_attention's auto-select; True forces the tuned
+    # pallas path (auto_flash_attention: kernel only where measured
+    # faster); False pins the reference einsum chain
+    use_flash: Optional[bool] = None
 
     @nn.compact
     def __call__(self, q_in, kv_in=None, mask=None, train: bool = False):
@@ -154,7 +176,8 @@ class AttentionModule(nn.Module):
             q = proj(q_in, wq, bq)
             k = proj(kv_in, wk, bk)
             v = proj(kv_in, wv, bv)
-        out = dot_product_attention(q, k, v, mask=mask, causal=self.causal)
+        out = dot_product_attention(q, k, v, mask=mask, causal=self.causal,
+                                    use_flash=self.use_flash)
         out = nn.DenseGeneral(q_in.shape[-1], axis=(-2, -1),
                               dtype=self.dtype, name="out")(out)
         if self.dropout > 0:

@@ -16,16 +16,58 @@ ref pyzoo/zoo/pipeline/api/keras/layers/self_attention.py). Two tiers:
   dtype with fp32 accumulation. If the backward kernels can't be built
   for a shape/backend, the vjp falls back to rematerialising through
   ``blockwise_attention``.
+
+Coverage (docs/kernels.md has the full matrix): shapes no longer need to
+be tile-aligned. ``head_dim % 128 != 0`` (the 64-dim BERT class) is
+zero-padded to the 128 lane — zero lanes contribute nothing to the q·k
+dots and the softmax scale stays ``1/sqrt(d_orig)`` — and ragged sequence
+lengths are padded to the block grid with the padded key positions masked
+to −∞ inside the kernels (the same ``k_pos < kv_len`` guard
+``blockwise_attention`` applies to its tail block). Padded query rows and
+head lanes are sliced off the outputs and gradients.
+
+``ZOO_PALLAS_INTERPRET=1`` runs every kernel through the pallas
+interpreter, which works on CPU — the parity tests in
+tests/test_attention.py exercise the real kernel bodies without a TPU.
+Block-size choice is empirical: ops/autotune.py measures candidate
+(block_q, block_k) configs per shape and only dispatches the kernel when
+it beats this file's blockwise reference.
 """
 
 from __future__ import annotations
 
 import functools
+import math
+import os
 
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+
+#: TPU vector lane width — the last dim tile the MXU/VPU want
+LANE = 128
+
+
+def ceil_to(x: int, m: int) -> int:
+    """Smallest multiple of ``m`` that is >= ``x``."""
+    return ((x + m - 1) // m) * m
+
+
+def pallas_interpret() -> bool:
+    """``ZOO_PALLAS_INTERPRET``: run pallas kernels in interpret mode —
+    slow, but executes the real kernel bodies on any backend (CPU parity
+    tests). Read at trace time, so tests can flip it per-case."""
+    return os.environ.get("ZOO_PALLAS_INTERPRET", "").strip().lower() in (
+        "1", "true", "on", "yes")
+
+
+def _interp_kw() -> dict:
+    """Kwargs for ``pl.pallas_call``: pass ``interpret=True`` only when
+    forced — omitting it otherwise keeps tests that monkeypatch
+    ``functools.partial(pallas_call, interpret=True)`` working (an
+    explicit ``interpret=False`` would override their partial)."""
+    return {"interpret": True} if pallas_interpret() else {}
 
 
 # ---------------------------------------------------------------- blockwise
@@ -86,22 +128,34 @@ def blockwise_attention(q, k, v, causal: bool = False, block_k: int = 128,
 
 def default_use_flash(seq: int, head_dim: int, block: int = 128) -> bool:
     """Shared auto-select for the sequence-parallel compositions (ring /
-    Ulysses): pallas kernels on TPU when the per-device attention shapes
-    are tile-aligned. ``head_dim % 128 != 0`` (e.g. 64, the BERT-class
-    default) always returns False — callers fall back to their blockwise
-    path for those models."""
+    Ulysses): pallas kernels on TPU. Since the kernels pad both the head
+    dim (to the 128 lane) and ragged sequence tails internally,
+    ``head_dim % 128 != 0`` (e.g. 64, the BERT-class default) and
+    ``seq % block != 0`` no longer disqualify a shape. The remaining
+    exclusions are economic, not correctness: sequences shorter than one
+    block (padding waste dominates) and head dims past 512 (VMEM scratch
+    pressure at padded width)."""
     try:
         on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     except Exception:  # pragma: no cover
         on_tpu = False
-    return on_tpu and seq % block == 0 and head_dim % 128 == 0
+    return on_tpu and seq >= block and head_dim <= 512
+
+
+def _pad_axis(a, axis: int, to: int):
+    pad = to - a.shape[axis]
+    if pad <= 0:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(a, widths)
 
 
 # ---------------------------------------------------------------- pallas fwd
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                       block_k: int, causal: bool, block_q: int, nk: int,
-                      causal_off: int):
+                      causal_off: int, sm_scale: float, kv_len):
     import jax.experimental.pallas as pl
 
     # rest = (lse_ref?, o_scr, m_scr, l_scr): the lse output only exists
@@ -128,20 +182,31 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         # MXU matmuls stay in the input dtype (bf16 doubles throughput on
         # v5e); softmax state and the output accumulator are fp32 — the
         # standard flash mixed-precision split. preferred_element_type
-        # gives fp32 accumulation inside the MXU either way.
+        # gives fp32 accumulation inside the MXU either way. sm_scale is
+        # 1/sqrt(d_orig) from the caller: q may be zero-padded past the
+        # model's head_dim, so q.shape[-1] is the wrong denominator here.
         q = q_ref[0]                             # [block_q, d]
         k_blk = k_ref[0]                         # [block_k, d] (streamed)
         v_blk = v_ref[0]
-        scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
         s = jax.lax.dot_general(                 # [block_q, block_k] fp32
             q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            preferred_element_type=jnp.float32) * sm_scale
+        masked = None
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
-            s = jnp.where(k_pos <= q_pos + causal_off, s, NEG_INF)
+            masked = k_pos > q_pos + causal_off
+        if kv_len is not None:
+            # ragged tail: padded key positions contribute nothing — the
+            # kernel-side mirror of blockwise_attention's `k_pos < sk`
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            over = k_pos >= kv_len
+            masked = over if masked is None else (masked | over)
+        if masked is not None:
+            s = jnp.where(masked, NEG_INF, s)
         m = m_scr[:, 0]
         l = l_scr[:, 0]
         m_new = jnp.maximum(m, s.max(-1))
@@ -165,6 +230,22 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
             lse_ref[0] = m_scr[:, 0] + jnp.log(l_fin)
 
 
+def _pad_blocks(q, k, v, block_q: int, block_k: int):
+    """Clamp blocks to the (tile-rounded) sequence lengths, then pad seq
+    dims to the block grid and the head dim to the lane width. Returns the
+    padded tensors, effective blocks, and the padded dims."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, ceil_to(sq, 16))
+    block_k = min(block_k, ceil_to(sk, 16))
+    sq_p, sk_p = ceil_to(sq, block_q), ceil_to(sk, block_k)
+    d_p = ceil_to(d, LANE)
+    q = _pad_axis(_pad_axis(q, 1, sq_p), 3, d_p)
+    k = _pad_axis(_pad_axis(k, 1, sk_p), 3, d_p)
+    v = _pad_axis(_pad_axis(v, 1, sk_p), 3, d_p)
+    return q, k, v, block_q, block_k, sq_p, sk_p, d_p
+
+
 def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
                return_lse: bool = False):
     import jax.experimental.pallas as pl
@@ -172,46 +253,52 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
 
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    if sq % block_q or sk % block_k:
-        raise ValueError(
-            f"flash_attention requires seq lengths divisible by the block "
-            f"sizes (sq={sq} %% {block_q}, sk={sk} %% {block_k}); use "
-            f"blockwise_attention for ragged shapes")
+    # the causal offset is defined by the ORIGINAL lengths (bottom-right
+    # aligned mask, see blockwise_attention); padding must not shift it
+    causal_off = sk - sq
+    sm_scale = 1.0 / math.sqrt(d)
+    q, k, v, block_q, block_k, sq_p, sk_p, d_p = _pad_blocks(
+        q, k, v, block_q, block_k)
     # fold (batch, heads) into the leading grid dim; k/v stream through VMEM
     # one block per innermost grid step (pallas double-buffers the HBM loads),
     # accumulators persist in VMEM scratch across the k dimension.
-    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    nk = sk // block_k
-    grid = (b * h, sq // block_q, nk)
-    out_shape = [jax.ShapeDtypeStruct((b * h, sq, d), q.dtype)]
-    out_specs = [pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0))]
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq_p, d_p)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d_p)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d_p)
+    nk = sk_p // block_k
+    grid = (b * h, sq_p // block_q, nk)
+    out_shape = [jax.ShapeDtypeStruct((b * h, sq_p, d_p), q.dtype)]
+    out_specs = [pl.BlockSpec((1, block_q, d_p), lambda i, qi, ki: (i, qi, 0))]
     if return_lse:
-        out_shape.append(jax.ShapeDtypeStruct((b * h, sq), jnp.float32))
+        out_shape.append(jax.ShapeDtypeStruct((b * h, sq_p), jnp.float32))
         out_specs.append(pl.BlockSpec((1, block_q),
                                       lambda i, qi, ki: (i, qi)))
     res = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, block_k=block_k,
                           causal=causal, block_q=block_q, nk=nk,
-                          causal_off=sk - sq),
+                          causal_off=causal_off, sm_scale=sm_scale,
+                          kv_len=sk if sk_p != sk else None),
         out_shape=tuple(out_shape),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, qi, ki: (i, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, qi, ki: (i, ki, 0)),
+            pl.BlockSpec((1, block_q, d_p), lambda i, qi, ki: (i, qi, 0)),
+            pl.BlockSpec((1, block_k, d_p), lambda i, qi, ki: (i, ki, 0)),
+            pl.BlockSpec((1, block_k, d_p), lambda i, qi, ki: (i, ki, 0)),
         ],
         out_specs=tuple(out_specs),
         scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, d_p), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
+        **_interp_kw(),
     )(qt, kt, vt)
     out, lse = res if return_lse else (res[0], None)
-    out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
-    return (out, lse) if return_lse else out
+    out = out.reshape(b, h, sq_p, d_p).transpose(0, 2, 1, 3)
+    out = out[:, :sq, :, :d]                    # drop padded rows/lanes
+    if return_lse:
+        return out, lse[:, :sq]
+    return out
 
 
 # ---------------------------------------------------------------- pallas bwd
@@ -224,32 +311,40 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
 # input dtype with fp32 accumulation; accumulators live in VMEM scratch.
 
 def _bwd_block(q, k_blk, v_blk, do, lse, delta, glse, qi, ki, *,
-               block_q, block_k, causal, causal_off):
+               block_q, block_k, causal, causal_off, sm_scale, kv_len):
     """Shared per-tile math: returns (p, ds) as fp32 [block_q, block_k].
     ``glse`` is the cotangent of the row logsumexp (zero for plain
     attention): since ∂lse_i/∂s_ij = p_ij, it folds into the same
-    softmax-Jacobian term as Δ."""
-    scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
+    softmax-Jacobian term as Δ. Padded query rows arrive with lse = +1e30
+    so p (and everything downstream) is exactly zero for them."""
     s = jax.lax.dot_general(
         q, k_blk, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale
+        preferred_element_type=jnp.float32) * sm_scale
+    masked = None
     if causal:
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 0)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
-        s = jnp.where(k_pos <= q_pos + causal_off, s, NEG_INF)
+        masked = k_pos > q_pos + causal_off
+    if kv_len is not None:
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        over = k_pos >= kv_len
+        masked = over if masked is None else (masked | over)
+    if masked is not None:
+        s = jnp.where(masked, NEG_INF, s)
     p = jnp.exp(s - lse[:, None])                     # [bq, bk] fp32
     dp = jax.lax.dot_general(                         # dO · Vᵀ
         do, v_blk, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
-    ds = p * (dp - delta[:, None] + glse[:, None]) * scale
+    ds = p * (dp - delta[:, None] + glse[:, None]) * sm_scale
     return p, ds
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          glse_ref, dq_ref, dq_scr, *, block_q, block_k,
-                         nk, causal, causal_off):
+                         nk, causal, causal_off, sm_scale, kv_len):
     import jax.experimental.pallas as pl
 
     qi, ki = pl.program_id(1), pl.program_id(2)
@@ -267,7 +362,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         _, ds = _bwd_block(q, k_blk, v_blk, do_ref[0], lse_ref[0],
                            delta_ref[0], glse_ref[0], qi, ki,
                            block_q=block_q, block_k=block_k, causal=causal,
-                           causal_off=causal_off)
+                           causal_off=causal_off, sm_scale=sm_scale,
+                           kv_len=kv_len)
         dq_scr[...] += jax.lax.dot_general(           # dS · K
             ds.astype(q.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -279,7 +375,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           glse_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                          block_q, block_k, nq, causal, causal_off):
+                          block_q, block_k, nq, causal, causal_off,
+                          sm_scale, kv_len):
     import jax.experimental.pallas as pl
 
     ki, qi = pl.program_id(1), pl.program_id(2)
@@ -298,7 +395,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p, ds = _bwd_block(q, k_blk, v_blk, do, lse_ref[0], delta_ref[0],
                            glse_ref[0], qi, ki, block_q=block_q,
                            block_k=block_k, causal=causal,
-                           causal_off=causal_off)
+                           causal_off=causal_off, sm_scale=sm_scale,
+                           kv_len=kv_len)
         dv_scr[...] += jax.lax.dot_general(           # Pᵀ · dO
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -319,52 +417,68 @@ def _flash_bwd(q, k, v, o, lse, g, causal: bool, block_q: int,
 
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    dot = g.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    # Δ = rowsum(dO ⊙ O): cheap elementwise, stays outside the kernels
-    delta = jnp.sum(dot.astype(jnp.float32)
-                    * o.transpose(0, 2, 1, 3).reshape(
-                        b * h, sq, d).astype(jnp.float32), axis=-1)
+    causal_off = sk - sq
+    sm_scale = 1.0 / math.sqrt(d)
     if g_lse is None:
         g_lse = jnp.zeros_like(lse)
-    g_lse = g_lse.astype(jnp.float32)
-    nq, nk = sq // block_q, sk // block_k
-    causal_off = sk - sq
+    q, k, v, block_q, block_k, sq_p, sk_p, d_p = _pad_blocks(
+        q, k, v, block_q, block_k)
+    o = _pad_axis(_pad_axis(o, 1, sq_p), 3, d_p)
+    g = _pad_axis(_pad_axis(g, 1, sq_p), 3, d_p)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq_p, d_p)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d_p)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d_p)
+    dot = g.transpose(0, 2, 1, 3).reshape(b * h, sq_p, d_p)
+    # Δ = rowsum(dO ⊙ O): cheap elementwise, stays outside the kernels.
+    # Padded query rows have dO = 0, so Δ = 0 there.
+    delta = jnp.sum(dot.astype(jnp.float32)
+                    * o.transpose(0, 2, 1, 3).reshape(
+                        b * h, sq_p, d_p).astype(jnp.float32), axis=-1)
+    # padded query rows get lse = +1e30 → p = exp(s − 1e30) ≡ 0 in the
+    # tiles, so they contribute exactly nothing to dk/dv (and their dq
+    # rows, whatever they hold, are sliced off below)
+    lse = jnp.pad(lse.astype(jnp.float32), ((0, 0), (0, sq_p - sq)),
+                  constant_values=-NEG_INF)
+    g_lse = _pad_axis(g_lse.astype(jnp.float32), 1, sq_p)
+    nq, nk = sq_p // block_q, sk_p // block_k
     common = dict(block_q=block_q, block_k=block_k, causal=causal,
-                  causal_off=causal_off)
-    q_spec = pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0))
-    k_spec = pl.BlockSpec((1, block_k, d), lambda i, qi, ki: (i, ki, 0))
+                  causal_off=causal_off, sm_scale=sm_scale,
+                  kv_len=sk if sk_p != sk else None)
+    q_spec = pl.BlockSpec((1, block_q, d_p), lambda i, qi, ki: (i, qi, 0))
+    k_spec = pl.BlockSpec((1, block_k, d_p), lambda i, qi, ki: (i, ki, 0))
     r_spec = pl.BlockSpec((1, block_q), lambda i, qi, ki: (i, qi))
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, nk=nk, **common),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d_p), q.dtype),
         grid=(b * h, nq, nk),
         in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec, r_spec],
         out_specs=q_spec,
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_q, d_p), jnp.float32)],
+        **_interp_kw(),
     )(qt, kt, vt, dot, lse, delta, g_lse)
     # dkv grid: key blocks resident, query blocks innermost
-    qk_spec = pl.BlockSpec((1, block_q, d), lambda i, ki, qi: (i, qi, 0))
-    kk_spec = pl.BlockSpec((1, block_k, d), lambda i, ki, qi: (i, ki, 0))
+    qk_spec = pl.BlockSpec((1, block_q, d_p), lambda i, ki, qi: (i, qi, 0))
+    kk_spec = pl.BlockSpec((1, block_k, d_p), lambda i, ki, qi: (i, ki, 0))
     rk_spec = pl.BlockSpec((1, block_q), lambda i, ki, qi: (i, qi))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, nq=nq, **common),
-        out_shape=(jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
-                   jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)),
+        out_shape=(jax.ShapeDtypeStruct((b * h, sk_p, d_p), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, sk_p, d_p), v.dtype)),
         grid=(b * h, nk, nq),
         in_specs=[qk_spec, kk_spec, kk_spec, qk_spec, rk_spec, rk_spec,
                   rk_spec],
         out_specs=(kk_spec, kk_spec),
-        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
-                        pltpu.VMEM((block_k, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_k, d_p), jnp.float32),
+                        pltpu.VMEM((block_k, d_p), jnp.float32)],
+        **_interp_kw(),
     )(qt, kt, vt, dot, lse, delta, g_lse)
 
     def unfold(a, s):
-        return a.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+        return a.reshape(b, h, s, d_p).transpose(0, 2, 1, 3)
 
-    return unfold(dq, sq), unfold(dk, sk), unfold(dv, sk)
+    return (unfold(dq, sq_p)[:, :sq, :, :d],
+            unfold(dk, sk_p)[:, :sk, :, :d],
+            unfold(dv, sk_p)[:, :sk, :, :d])
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -373,7 +487,10 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
     """Pallas forward + pallas FlashAttention-2 backward (dq and dk/dv
     kernels over the saved logsumexp); falls back to rematerialising
     through ``blockwise_attention`` if the backward kernels can't be
-    built for the shape/backend."""
+    built for the shape/backend. Ragged seq lengths and unaligned head
+    dims are padded internally (module docstring); callers wanting the
+    measured-fastest block config should go through
+    ``ops.autotune.auto_flash_attention`` instead of picking blocks."""
     return _flash_fwd(q, k, v, causal, block_q, block_k)
 
 

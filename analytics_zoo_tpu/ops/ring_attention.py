@@ -153,12 +153,12 @@ def ring_attention(q, k, v, mesh=None, axis_name: str = mesh_lib.SEQ_AXIS,
     ``use_flash``: run each resident block through the pallas flash
     kernels and merge ring steps via logsumexp — O(block) memory inside
     each step on top of the ring's O(s/p). ``None`` auto-selects on TPU
-    when the local block and head_dim are tile-aligned. Note the
-    tile-alignment rule excludes ``head_dim % 128 != 0``: auto-select
-    NEVER engages flash for e.g. head_dim=64 (BERT-class models) — those
-    shapes take the blockwise-jax path. ``use_flash=True`` overrides the
-    heuristic but the kernel does not pad head_dim, so an unaligned lane
-    dimension is left to the Mosaic compiler (may relayout or reject).
+    whenever the local block spans at least one flash tile
+    (``default_use_flash``). The kernels pad internally now —
+    ``head_dim % 128 != 0`` (e.g. 64, the BERT class) packs into the 128
+    lane and ragged local blocks get a masked tail tile — so neither
+    disqualifies a shape anymore; the remaining blockwise fallbacks are
+    economic (tiny local blocks), not correctness limits.
     """
     # cross-version shard_map (jax >= 0.8 top-level with check_vma,
     # older jax under experimental with check_rep)
@@ -177,8 +177,8 @@ def ring_attention(q, k, v, mesh=None, axis_name: str = mesh_lib.SEQ_AXIS,
         use_flash = default_use_flash(s_loc, d, flash_block)
     spec = P(batch_axis, axis_name, None, None)
     if use_flash:
-        assert s_loc % flash_block == 0, \
-            f"local seq {s_loc} must divide by flash_block {flash_block}"
+        # ragged local blocks are fine: the kernel pads the tail k-block
+        # and masks padded key positions to −∞ (flash_attention.py)
         fn = functools.partial(_ring_flash_local, axis_name=axis_name,
                                causal=causal, block=flash_block,
                                n_shards=p)

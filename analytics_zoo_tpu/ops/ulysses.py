@@ -56,13 +56,11 @@ def ulysses_attention(q, k, v, *, mesh=None, causal: bool = False,
     flash kernels (fwd + FA-2 bwd) instead of materializing the [s, s]
     score matrix — after the all-to-all each device holds the FULL
     sequence for its heads, so long-context Ulysses without flash is
-    O(s²) HBM per device. ``None`` auto-selects on TPU when seq and
-    head_dim are tile-aligned. Note the tile-alignment rule excludes
-    ``head_dim % 128 != 0``: auto-select NEVER engages flash for e.g.
-    head_dim=64 (BERT-class models) — those shapes fall back to the
-    materialized [s, s] attention. ``use_flash=True`` overrides the
-    heuristic but the kernel does not pad head_dim, so an unaligned lane
-    dimension is left to the Mosaic compiler (may relayout or reject).
+    O(s²) HBM per device. ``None`` auto-selects on TPU whenever the
+    sequence spans at least one flash tile (``default_use_flash``). The
+    kernels pad internally now — ``head_dim % 128 != 0`` (e.g. 64, the
+    BERT class) packs into the 128 lane and ragged sequences get a
+    masked tail tile — so neither disqualifies a shape anymore.
     """
     if mesh is None:
         mesh = mesh_lib.get_default_mesh()

@@ -55,6 +55,11 @@ class BertConfig:
     # FLOPs — for LONG sequences / big batches that otherwise don't fit
     # HBM. Off by default: when everything fits, remat only costs MFU.
     remat: bool = False
+    # attention backend: None → ops/attention.py auto-select, True → the
+    # tuned pallas path (ops/autotune.py auto_flash_attention — engages
+    # the kernel only where a measurement beat blockwise; head_dim 64 is
+    # covered via lane padding), False → reference einsum attention
+    use_flash: Optional[bool] = None
 
     @property
     def head_dim(self) -> int:
@@ -81,6 +86,8 @@ class EncoderBlock(nn.Module):
     # erf gelu for BERT-checkpoint fidelity (HF trained with exact);
     # the GPT-style causal stack keeps the canonical tanh approximation
     gelu_exact: bool = False
+    # threaded to AttentionModule (see BertConfig.use_flash)
+    use_flash: Optional[bool] = None
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = False):
@@ -88,6 +95,7 @@ class EncoderBlock(nn.Module):
             num_heads=self.n_head,
             head_dim=self.hidden_size // self.n_head,
             dropout=self.attn_drop, causal=self.causal, dtype=self.dtype,
+            use_flash=self.use_flash,
             name="attention")(x, mask=mask, train=train)
         x = nn.LayerNorm(epsilon=1e-12, dtype=self.dtype,
                          name="attn_norm")(x + attn)
@@ -151,6 +159,7 @@ class BertModule(nn.Module):
                 intermediate_size=cfg.intermediate_size,
                 dropout=cfg.hidden_drop, attn_drop=cfg.attn_drop,
                 dtype=cfg.dtype, gelu_exact=cfg.gelu_exact,
+                use_flash=cfg.use_flash,
                 name=f"block_{i}")(x, mask, train)
         pooled = nn.tanh(nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
                                   name="pooler")(x[:, 0]))

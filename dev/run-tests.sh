@@ -8,7 +8,7 @@
 #   dev/run-tests.sh core         # one lane
 #   dev/run-tests.sh smoke        # fast pre-push subset (<5 min, 1 core)
 #   Lanes: smoke core data keras models zouwu automl serving interop
-#          examples telemetry fleet resilience zoolint
+#          examples telemetry fleet resilience zoolint kernels
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -96,6 +96,37 @@ assert rec.get("serving_cold_start_seconds", -1) >= 0, \
 print(f"flight recorder OK: {len(d['spans'])} spans in {dumps[0]}")
 print(f"compile-ahead OK: growth={rec['serving_bucket_growth']} "
       f"recompiles=0 cold_start={rec['serving_cold_start_seconds']}s")
+PY
+            ;;
+  # pallas kernels + autotuner (ISSUE 8): flash/embedding-bag parity on
+  # the CPU interpreter, then a smoke proving the autotune dispatch NEVER
+  # picks a config slower than the numerics-reference fallback — the
+  # invariant that turns a kernel regression into a fallback, not a perf
+  # bug (lint first: new kernels must be zoolint-clean, and the catalog
+  # cross-check must know the zoo_autotune_* metrics)
+  kernels)  lint_zoolint
+            run -m "not slow" tests/test_autotune.py \
+                tests/test_embedding_bag.py tests/test_attention.py
+            echo "== autotune never-slower smoke"
+            JAX_PLATFORMS=cpu ZOO_PALLAS_INTERPRET=1 python - <<'PY'
+import os, tempfile
+os.environ["ZOO_AUTOTUNE_CACHE"] = os.path.join(tempfile.mkdtemp(),
+                                                "autotune.json")
+os.environ["ZOO_AUTOTUNE_ITERS"] = "2"
+import jax.numpy as jnp
+from analytics_zoo_tpu.ops import autotune
+rec = autotune.tune_attention(1, 64, 2, 64, dtype=jnp.float32,
+                              causal=True)
+assert rec["best"] is not None, rec["errors"]
+# the dispatch invariant: the kernel only engages when its measured time
+# BEAT the blockwise reference — use_kernel=True with best>=reference
+# would mean the autotuner can select a slower config
+if rec["use_kernel"]:
+    assert rec["best_ms"] < rec["reference_ms"], rec
+else:
+    assert rec["best_ms"] >= rec["reference_ms"], rec
+print(f"autotune OK: best={rec['best']} {rec['best_ms']}ms "
+      f"ref={rec['reference_ms']}ms use_kernel={rec['use_kernel']}")
 PY
             ;;
   # fleet observability (ISSUE 6): snapshot merge algebra, replica

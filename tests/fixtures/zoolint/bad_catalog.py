@@ -8,4 +8,9 @@ def register_bogus(registry):
     c = registry.counter("zoo_fixture_bogus_total",
                          "not in docs")  # VIOLATION metric-undocumented
     flag = os.getenv("ZOO_FIXTURE_BOGUS")  # VIOLATION envvar-undocumented
-    return c, flag
+    # an autotune-family name the catalog does NOT list: proves the drift
+    # check covers newly added zoo_autotune_* metrics, not a stale prefix
+    g = registry.gauge("zoo_autotune_bogus_ms",
+                       "not in docs")  # VIOLATION metric-undocumented
+    knob = os.getenv("ZOO_AUTOTUNE_BOGUS")  # VIOLATION envvar-undocumented
+    return c, flag, g, knob

@@ -188,6 +188,97 @@ def test_flash_backward_kernel_interpret_mode(orca_ctx):
         pl.pallas_call = orig
 
 
+def test_flash_head_dim_64_parity(orca_ctx, monkeypatch):
+    """head_dim 64 (the BERT class) packs into the 128 lane: forward
+    parity vs the reference, full and causal, plus a ragged sequence
+    (s % block != 0 — the padded tail k-block must mask to −∞, ISSUE 8
+    satellite). Runs via ZOO_PALLAS_INTERPRET so the real kernel bodies
+    execute on CPU, exercising the same knob docs/kernels.md documents."""
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.ops import flash_attention as fa
+
+    monkeypatch.setenv("ZOO_PALLAS_INTERPRET", "1")
+    for s, causal in ((256, False), (256, True), (200, True), (40, False)):
+        q, k, v = _qkv(b=1, s=s, h=2, d=64, seed=17 + s)
+        out = np.asarray(fa.flash_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal,
+            128, 128))
+        ref = _reference(q, k, v, causal)
+        np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-4,
+                                   err_msg=f"s={s} causal={causal}")
+
+
+def test_flash_head_dim_64_backward(orca_ctx, monkeypatch):
+    """FA-2 backward kernels at head_dim 64, aligned AND ragged seq: the
+    kernels are called directly (the custom_vjp would silently fall back
+    to blockwise on a broken kernel, making the comparison vacuous).
+    Padded lse rows carry +1e30 so padded-row p is exactly 0 — grads for
+    real rows must match the blockwise vjp."""
+    import jax
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.ops import flash_attention as fa
+
+    monkeypatch.setenv("ZOO_PALLAS_INTERPRET", "1")
+    for s, causal in ((256, True), (200, False), (200, True)):
+        q, k, v = _qkv(b=1, s=s, h=2, d=64, seed=29 + s)
+        g = np.asarray(jax.random.normal(
+            jax.random.PRNGKey(31), (1, s, 2, 64)), np.float32)
+        out, lse = fa._flash_fwd(jnp.asarray(q), jnp.asarray(k),
+                                 jnp.asarray(v), causal=causal,
+                                 block_q=128, block_k=128,
+                                 return_lse=True)
+        gf = fa._flash_bwd(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                           out, lse, jnp.asarray(g), causal, 128, 128)
+
+        def f_block(q, k, v):
+            return (fa.blockwise_attention(q, k, v, causal=causal)
+                    * jnp.asarray(g)).sum()
+
+        gb = jax.grad(f_block, argnums=(0, 1, 2))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        for name, a, b in zip("qkv", gf, gb):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=5e-4,
+                err_msg=f"d{name} s={s} causal={causal}")
+
+
+def test_flash_cross_attention_ragged_kv(orca_ctx, monkeypatch):
+    """Cross-attention with sq < sk and a ragged kv length (the KV-cache
+    decode shape): the causal offset comes from the ORIGINAL lengths —
+    bottom-right alignment must not shift when the tail k-block pads.
+    sq stays <= sk: a causal query with ZERO visible keys is degenerate
+    (every implementation emits a different 'uniform' placeholder)."""
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.ops import flash_attention as fa
+
+    monkeypatch.setenv("ZOO_PALLAS_INTERPRET", "1")
+    rng = np.random.default_rng(41)
+    q = rng.normal(size=(1, 16, 2, 64)).astype(np.float32)
+    k = rng.normal(size=(1, 24, 2, 64)).astype(np.float32)
+    v = rng.normal(size=(1, 24, 2, 64)).astype(np.float32)
+    for causal in (False, True):
+        out = np.asarray(fa.flash_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal, 16, 16))
+        ref = _reference(q, k, v, causal)
+        np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-4,
+                                   err_msg=f"causal={causal}")
+
+
+def test_default_use_flash_relaxed(orca_ctx):
+    """head_dim 64 and ragged seq no longer disqualify a shape (the
+    kernels pad internally); the remaining exclusions are economic:
+    sub-block sequences and head dims past 512. Off-TPU always False."""
+    import jax
+    from analytics_zoo_tpu.ops.flash_attention import default_use_flash
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    # CPU test env: the gate must still say no (pallas needs the TPU)
+    assert default_use_flash(2048, 64) == on_tpu
+    assert default_use_flash(2000, 64) == on_tpu   # ragged seq eligible
+    assert not default_use_flash(64, 64)           # shorter than a block
+    assert not default_use_flash(2048, 1024)       # VMEM pressure
+
+
 def test_ring_flash_composition(orca_ctx):
     """ring_attention(use_flash=True): each resident block runs the
     pallas kernels and ring steps merge via logsumexp (the lse cotangent

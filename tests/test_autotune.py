@@ -1,0 +1,254 @@
+"""Autotuner tests (ops/autotune.py): verdict measurement, cache
+persistence, the never-selects-slower invariant, the background tuning
+queue, and the flash-attention front end on the CPU interpreter.
+
+All timing-based assertions use grossly mismatched workloads (one matmul
+tower vs an add) so they hold on any shared CI box.
+"""
+
+import json
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.ops import autotune
+
+
+@pytest.fixture
+def tuner_env(monkeypatch, tmp_path):
+    """Point the verdict cache at a tmp file and keep iters tiny."""
+    path = str(tmp_path / "autotune.json")
+    monkeypatch.setenv("ZOO_AUTOTUNE_CACHE", path)
+    monkeypatch.setenv("ZOO_AUTOTUNE_ITERS", "2")
+    monkeypatch.delenv("ZOO_AUTOTUNE", raising=False)
+    autotune.reset_tuner()
+    yield path
+    autotune.reset_tuner()
+    autotune._pending.clear()
+
+
+def _heavy(x):
+    # ~200 chained matmuls: reliably slower than an add on any host
+    for _ in range(200):
+        x = x @ x * 0.5
+    return x
+
+
+def _light(x):
+    return x + 1.0
+
+
+X = jnp.ones((64, 64), jnp.float32) * 0.1
+
+
+# ------------------------------------------------------------ measurement
+
+def test_tune_picks_faster_candidate(tuner_env):
+    rec = autotune.get_tuner().tune(
+        "demo", "demo|fast", {"light": _light}, _heavy, (X,), iters=2)
+    assert rec["best"] == "light"
+    assert rec["use_kernel"] is True
+    assert rec["best_ms"] < rec["reference_ms"]
+    assert rec["speedup"] > 1.0
+
+
+def test_tune_falls_back_when_reference_wins(tuner_env):
+    rec = autotune.get_tuner().tune(
+        "demo", "demo|slow", {"heavy": _heavy}, _light, (X,), iters=2)
+    assert rec["best"] == "heavy"
+    assert rec["use_kernel"] is False
+    # the structural invariant: use_kernel is ONLY set when the candidate
+    # strictly beat the reference, so dispatch can never pick a loser
+    assert rec["best_ms"] >= rec["reference_ms"]
+
+
+def test_tune_records_candidate_errors(tuner_env):
+    def broken(x):
+        raise RuntimeError("no such kernel on this backend")
+
+    rec = autotune.get_tuner().tune(
+        "demo", "demo|err", {"broken": broken, "light": _light},
+        _heavy, (X,), iters=2)
+    assert "broken" in rec["errors"]
+    assert rec["best"] == "light" and rec["use_kernel"]
+
+    rec2 = autotune.get_tuner().tune(
+        "demo", "demo|allerr", {"broken": broken}, _light, (X,), iters=2)
+    assert rec2["best"] is None
+    assert rec2["use_kernel"] is False
+    assert rec2["best_ms"] is None
+
+
+# ------------------------------------------------------------ persistence
+
+def test_verdict_persists_across_tuner_instances(tuner_env):
+    autotune.get_tuner().tune(
+        "demo", "demo|persist", {"light": _light}, _heavy, (X,), iters=2)
+    with open(tuner_env) as f:
+        on_disk = json.load(f)
+    assert on_disk["demo|persist"]["best"] == "light"
+
+    autotune.reset_tuner()                      # fresh process simulation
+    rec = autotune.get_tuner().lookup("demo|persist", "demo")
+    assert rec is not None and rec["use_kernel"]
+
+
+def test_corrupt_cache_file_is_ignored(tuner_env):
+    with open(tuner_env, "w") as f:
+        f.write("{not json")
+    assert autotune.get_tuner().lookup("anything") is None
+    # and recording over the corrupt file heals it
+    autotune.get_tuner().record("k", {"kernel": "demo", "use_kernel": False})
+    autotune.reset_tuner()
+    assert autotune.get_tuner().lookup("k")["kernel"] == "demo"
+
+
+# ---------------------------------------------------------- pending queue
+
+def test_pending_queue_dedupes_and_drains(tuner_env):
+    ran = []
+    autotune.enqueue_tune("q|a", lambda: ran.append("a"))
+    autotune.enqueue_tune("q|a", lambda: ran.append("dup"))
+    autotune.enqueue_tune("q|b", lambda: ran.append("b"))
+    assert autotune.pending_count() == 2
+    assert autotune.tune_pending(limit=1) == 1
+    assert autotune.pending_count() == 1
+    assert autotune.tune_pending() == 1
+    assert autotune.pending_count() == 0
+    assert sorted(ran) == ["a", "b"]            # the dup never ran
+
+
+def test_pending_thunk_failure_is_contained(tuner_env):
+    def boom():
+        raise RuntimeError("tuning exploded")
+
+    autotune.enqueue_tune("q|boom", boom)
+    assert autotune.tune_pending() == 1         # no raise
+    assert autotune.pending_count() == 0
+
+
+def test_enqueue_noop_when_off_or_already_cached(tuner_env, monkeypatch):
+    autotune.get_tuner().record("q|done", {"use_kernel": False})
+    autotune.enqueue_tune("q|done", lambda: None)
+    assert autotune.pending_count() == 0
+
+    monkeypatch.setenv("ZOO_AUTOTUNE", "off")
+    autotune.enqueue_tune("q|off", lambda: None)
+    assert autotune.pending_count() == 0
+
+
+def test_warm_async_worker_drains_queue(tuner_env):
+    """The compile-ahead warmup thread is the queue's consumer: after the
+    rungs land it must call tune_pending()."""
+    from analytics_zoo_tpu.common import compile_ahead, telemetry
+
+    drained = threading.Event()
+    autotune.enqueue_tune("q|warm", drained.set)
+    cache = compile_ahead.ExecutableCache(
+        jax.jit(lambda x: x * 2.0), name="t_autotune_drain",
+        registry=telemetry.MetricsRegistry(), tracer=telemetry.Tracer())
+    t = cache.warm_async([(jax.ShapeDtypeStruct((2, 2), np.float32),)])
+    t.join(timeout=60)
+    assert not t.is_alive()
+    assert drained.is_set()
+    assert autotune.pending_count() == 0
+
+
+# ------------------------------------------------- flash attention front
+
+def _attn_args(s_q=64, s_k=64, d=64, dtype=jnp.float32):
+    key = jax.random.PRNGKey(7)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, s_q, 2, d), dtype)
+    k = jax.random.normal(kk, (1, s_k, 2, d), dtype)
+    v = jax.random.normal(kv, (1, s_k, 2, d), dtype)
+    return q, k, v
+
+
+def test_attention_candidates_filter():
+    # full grid survives at bench shapes; tiny shapes get one clamped cfg
+    big = autotune._attention_candidates(2048, 2048)
+    assert set(big) == {"128x128", "128x256", "256x256", "256x512",
+                        "512x512"}
+    tiny = autotune._attention_candidates(64, 64)
+    assert tiny == {"64x64": (64, 64)}
+
+
+def test_tune_attention_on_cpu_interpreter(tuner_env, monkeypatch):
+    monkeypatch.setenv("ZOO_PALLAS_INTERPRET", "1")
+    rec = autotune.tune_attention(1, 64, 2, 64, dtype=jnp.float32,
+                                  causal=True)
+    assert rec["best"] is not None, rec["errors"]
+    # never-selects-slower, whichever way the measurement went
+    if rec["use_kernel"]:
+        assert rec["best_ms"] < rec["reference_ms"]
+    else:
+        assert rec["best_ms"] >= rec["reference_ms"]
+    key = autotune.attention_key(1, 64, 64, 2, 64, jnp.float32, True)
+    assert autotune.get_tuner().lookup(key) == rec
+
+
+def test_attention_decision_off_and_unavailable(tuner_env, monkeypatch):
+    monkeypatch.setenv("ZOO_AUTOTUNE", "off")
+    assert autotune.attention_decision(
+        1, 64, 64, 2, 64, jnp.float32, False, True) is None
+    # mode on, but CPU without interpret mode: kernels can't run at all
+    monkeypatch.delenv("ZOO_AUTOTUNE", raising=False)
+    monkeypatch.delenv("ZOO_PALLAS_INTERPRET", raising=False)
+    assert autotune.attention_decision(
+        1, 64, 64, 2, 64, jnp.float32, False, True) is None
+    assert autotune.pending_count() == 0
+
+
+def test_attention_decision_miss_enqueues_under_trace(tuner_env,
+                                                      monkeypatch):
+    monkeypatch.setenv("ZOO_PALLAS_INTERPRET", "1")
+    assert autotune.attention_decision(
+        1, 64, 64, 2, 64, jnp.float32, True, concrete=False) is None
+    assert autotune.pending_count() == 1
+
+
+def test_auto_flash_matches_blockwise_when_off(tuner_env, monkeypatch):
+    from analytics_zoo_tpu.ops.flash_attention import blockwise_attention
+    monkeypatch.setenv("ZOO_AUTOTUNE", "off")
+    q, k, v = _attn_args()
+    out = autotune.auto_flash_attention(q, k, v, causal=True)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(blockwise_attention(q, k, v,
+                                                        causal=True)))
+
+
+def test_auto_flash_sync_tunes_and_stays_correct(tuner_env, monkeypatch):
+    from analytics_zoo_tpu.ops.flash_attention import blockwise_attention
+    monkeypatch.setenv("ZOO_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("ZOO_AUTOTUNE", "sync")
+    q, k, v = _attn_args()
+    out = autotune.auto_flash_attention(q, k, v, causal=True)
+    # first concrete call in sync mode tuned on the spot
+    key = autotune.attention_key(1, 64, 64, 2, 64, jnp.float32, True)
+    assert autotune.get_tuner().lookup(key) is not None
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.asarray(blockwise_attention(q, k, v, causal=True)),
+        rtol=2e-3, atol=2e-3)
+
+
+def test_auto_flash_dispatches_tuned_kernel(tuner_env, monkeypatch):
+    """A persisted winning verdict routes dispatch through the pallas
+    kernel at the recorded block config — numerics must hold there too."""
+    from analytics_zoo_tpu.ops.flash_attention import blockwise_attention
+    monkeypatch.setenv("ZOO_PALLAS_INTERPRET", "1")
+    q, k, v = _attn_args()
+    key = autotune.attention_key(1, 64, 64, 2, 64, jnp.float32, False)
+    autotune.get_tuner().record(key, {
+        "kernel": "flash_attention", "best": "64x64", "use_kernel": True,
+        "best_ms": 1.0, "reference_ms": 2.0, "speedup": 2.0})
+    out = autotune.auto_flash_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.asarray(blockwise_attention(q, k, v, causal=False)),
+        rtol=2e-3, atol=2e-3)
