@@ -33,13 +33,14 @@ import time
 import uuid
 from base64 import b64decode, b64encode
 from dataclasses import asdict, dataclass, field
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from analytics_zoo_tpu.common import telemetry
 
 __all__ = [
     "REPLICA_HASH", "ReplicaInfo", "ReplicaRegistry", "Heartbeater",
-    "heartbeat_interval_s", "stale_after_s", "default_replica_id",
+    "ReplicaSupervisor", "heartbeat_interval_s", "stale_after_s",
+    "default_replica_id",
 ]
 
 #: broker hash holding one field per replica (field = replica_id)
@@ -219,6 +220,14 @@ class Heartbeater:
         return self
 
     def stop(self, deregister: bool = True):
+        """Stop beating and (by default) remove the registry record.
+
+        Ordering contract: the engine calls this only AFTER its final
+        drain has acked (engine.stop joins the serve thread first).
+        Deregistering while a drain is still in flight would let a peer's
+        ReplicaSupervisor classify the drain's entries as orphans and
+        reclaim work that is about to be acked — a double-processing
+        window."""
         t, self._thread = self._thread, None
         self._stop.set()
         if t is not None:
@@ -228,3 +237,117 @@ class Heartbeater:
                 self.registry.remove(self.info_fn().replica_id)
             except Exception:
                 pass            # broker already gone: TTL will collect us
+
+
+def reclaim_interval_s() -> float:
+    """Cadence of orphan detection / lease reclaim sweeps
+    (``ZOO_SERVING_RECLAIM_S``; default: one heartbeat period, floored
+    at 1s so an idle fleet stays cheap)."""
+    raw = os.environ.get("ZOO_SERVING_RECLAIM_S", "").strip()
+    if raw:
+        return float(raw)
+    return max(heartbeat_interval_s(), 1.0)
+
+
+class ReplicaSupervisor:
+    """Fleet watchdog: detects crashed replicas and the entries they
+    stranded. Each sweep partitions the registry into live/stale, pulls
+    the broker's per-consumer pending breakdown (``XPENDING DETAIL``) and
+    classifies entries owned by consumers with no live heartbeat as
+    ORPHANS — publishing ``zoo_serving_orphan_entries`` and invoking
+    ``on_orphans(count)`` so the owning engine can expedite its
+    lease-reclaim sweep instead of waiting out the rate limiter. The
+    latest sweep's delivery state (pending-per-replica, orphans) is
+    surfaced through ``/healthz`` by the frontend; membership counts
+    there come fresh from the registry, not this cache.
+
+    Detection only: the actual redelivery stays with the broker's lease
+    arbitration (XCLAIM), so a flapping supervisor can never hand the
+    same entry to two replicas."""
+
+    def __init__(self, registry: ReplicaRegistry, stream: str,
+                 group: str = "serving", broker_host: str = "127.0.0.1",
+                 broker_port: int = 6399,
+                 interval_s: Optional[float] = None,
+                 own_replica_id: Optional[str] = None,
+                 on_orphans: Optional[Callable[[int], None]] = None):
+        self.registry = registry
+        self.stream, self.group = stream, group
+        self.broker_host, self.broker_port = broker_host, int(broker_port)
+        self.interval_s = reclaim_interval_s() if interval_s is None \
+            else float(interval_s)
+        self.own_replica_id = own_replica_id
+        self.on_orphans = on_orphans
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._last: Dict = {}
+        self._sweeps = 0
+        self._orphan_gauge = telemetry.get_registry().gauge(
+            "zoo_serving_orphan_entries",
+            "Pending entries owned by consumers with no live heartbeat",
+            ("stream",)).labels(stream)
+
+    def sweep(self) -> Dict:
+        """One detection pass; returns (and caches) the fleet view."""
+        live, stale = self.registry.partition()
+        live_ids = {r.replica_id for r in live}
+        if self.own_replica_id:
+            live_ids.add(self.own_replica_id)   # we are demonstrably alive
+        from analytics_zoo_tpu.serving.broker import BrokerClient
+        client = BrokerClient(host=self.broker_host, port=self.broker_port)
+        try:
+            per_consumer = client.xpending_detail(self.stream, self.group)
+        finally:
+            client.close()
+        orphans = sum(n for c, n in per_consumer.items()
+                      if c not in live_ids)
+        self._orphan_gauge.set(orphans)
+        with self._lock:
+            self._sweeps += 1
+            snap = {
+                "live": len(live), "stale": len(stale),
+                "replicas": sorted(r.replica_id for r in live),
+                "pending_per_replica": per_consumer,
+                "orphan_entries": orphans,
+                "sweeps": self._sweeps,
+            }
+            self._last = snap
+        if orphans and self.on_orphans is not None:
+            logger.warning(
+                "%d orphaned pending entries on stream %s (stale "
+                "replicas: %s); expediting reclaim", orphans, self.stream,
+                [r.replica_id for r in stale] or "none registered")
+            self.on_orphans(orphans)
+        return snap
+
+    def snapshot(self) -> Dict:
+        """Latest sweep result (empty dict before the first sweep)."""
+        with self._lock:
+            return dict(self._last)
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.sweep()
+            except Exception:
+                # broker flap or registry hiccup: the watchdog must not
+                # die with its patient
+                logger.debug("replica supervisor sweep failed",
+                             exc_info=True)
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "ReplicaSupervisor":
+        if self._thread is not None or self.interval_s <= 0:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="zoo-replica-supervisor")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        t, self._thread = self._thread, None
+        self._stop.set()
+        if t is not None:
+            t.join(timeout=5)

@@ -46,6 +46,9 @@ from __future__ import annotations
 import logging
 import os
 import re
+import signal
+import subprocess
+import sys
 import threading
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -54,8 +57,9 @@ from analytics_zoo_tpu.common import telemetry
 
 __all__ = [
     "InjectedFault", "FaultInjector", "BackendSupervisor",
-    "get_injector", "install_plan", "fault_plan_active", "maybe_fault",
-    "fault_scope", "probe_fault", "fault_drill", "is_backend_loss",
+    "ServingReplicaProc", "get_injector", "install_plan",
+    "fault_plan_active", "maybe_fault", "fault_scope", "probe_fault",
+    "fault_drill", "maybe_kill_replica", "is_backend_loss",
     "cpu_fallback_enabled", "fit_max_resumes", "get_supervisor",
     "supervisor_snapshot", "note_backend_loss", "reset_for_tests",
 ]
@@ -267,6 +271,117 @@ def fault_drill(plan: str, cpu_fallback: bool = True):
             else:
                 os.environ["ZOO_CPU_FALLBACK"] = prev_env
         _drop_supervisor()
+
+
+# --------------------------------------------------------- replica kill
+# The crash the multi-replica delivery contract exists for: SIGKILL of a
+# serving replica mid-stream (no drain, no deregister, no goodbye). The
+# seam is plan-driven like every other site — ``kill@replica:N`` kills on
+# the Nth arrival — so chaos drills are exactly reproducible.
+
+_REPLICA_SCRIPT = """\
+import sys, time
+import numpy as np
+from analytics_zoo_tpu.serving import ClusterServing, FrontEnd
+
+class Duck:
+    def __init__(self, sleep_s):
+        self.sleep_s = sleep_s
+    def predict(self, x):
+        if self.sleep_s:
+            time.sleep(self.sleep_s)   # models the accelerator round-trip
+        return np.asarray(x) * 2.0
+
+sleep_ms, port, batch = float(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+eng = ClusterServing(Duck(sleep_ms / 1000.0), port, batch_size=batch,
+                     max_batch_size=batch).start()
+fe = FrontEnd(port, engine=eng).start()
+print("READY", fe.port, eng.replica_id, flush=True)
+sys.stdin.readline()
+eng.stop()
+fe.stop()
+"""
+
+
+class ServingReplicaProc:
+    """One serving replica in its own OS process (engine + frontend over
+    a shared broker) — the unit :func:`maybe_kill_replica` SIGKILLs. The
+    model is a duck-typed doubler whose per-batch ``predict`` sleep
+    models the accelerator round-trip, so multi-replica scaling and
+    failover drills measure the *delivery* layer, deterministically,
+    without a device. Lease/heartbeat knobs ride ``env_extra``."""
+
+    def __init__(self, broker_port: int, batch_size: int = 4,
+                 predict_sleep_ms: float = 0.0,
+                 env_extra: Optional[Dict[str, str]] = None,
+                 ready_timeout_s: float = 60.0):
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.update(env_extra or {})
+        self.proc = subprocess.Popen(
+            [sys.executable, "-c", _REPLICA_SCRIPT,
+             str(predict_sleep_ms), str(broker_port), str(batch_size)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, env=env)
+        t = threading.Thread(target=self._read_ready, daemon=True)
+        t.start()
+        t.join(ready_timeout_s)
+        line = getattr(self, "_ready_line", "")
+        parts = line.split()
+        if len(parts) != 3 or parts[0] != "READY":
+            self.kill()
+            raise RuntimeError(
+                f"serving replica failed to come up (got {line!r})")
+        self.http_port = int(parts[1])
+        self.replica_id = parts[2]
+
+    def _read_ready(self):
+        self._ready_line = self.proc.stdout.readline()
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def kill(self):
+        """SIGKILL — the crash path. No drain, no deregister; the
+        replica's pending entries become orphaned leases."""
+        if self.proc.poll() is None:
+            os.kill(self.proc.pid, signal.SIGKILL)
+        self.proc.wait()
+
+    def stop(self, timeout_s: float = 30.0):
+        """Graceful path: closing stdin lets the replica run its full
+        drain (stop reading → flush in-flight → ack → deregister)."""
+        if self.proc.poll() is None:
+            try:
+                self.proc.stdin.close()
+            except OSError:
+                pass
+            try:
+                self.proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                self.kill()
+        else:
+            self.proc.wait()
+
+
+def maybe_kill_replica(replica: ServingReplicaProc) -> bool:
+    """The replica-kill fault seam. Counts one arrival at site
+    ``replica``; when the armed plan schedules a ``kill`` for this
+    arrival (``kill@replica:N``), SIGKILL the subprocess and return
+    True. Call it at every natural drill checkpoint (e.g. each client
+    poll round) — the plan decides which arrival strikes."""
+    inj = get_injector()
+    if inj is None or _suppressed("replica"):
+        return False
+    fault = inj.check("replica")
+    if fault is None or fault.kind != "kill":
+        return False
+    logger.warning("injected replica kill: SIGKILL pid %d (%s)",
+                   replica.proc.pid,
+                   getattr(replica, "replica_id", "?"))
+    replica.kill()
+    return True
 
 
 def is_backend_loss(err: Optional[BaseException]) -> bool:

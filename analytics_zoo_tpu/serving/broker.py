@@ -13,6 +13,7 @@ zbroker.cpp header for the command set).
 
 from __future__ import annotations
 
+import errno
 import os
 import socket
 import socketserver
@@ -49,17 +50,41 @@ def build_native_broker(force: bool = False) -> Optional[str]:
     return binary
 
 
+def _reconnects_total():
+    # lazy import: broker must stay importable without the telemetry stack
+    from analytics_zoo_tpu.common import telemetry
+    return telemetry.get_registry().counter(
+        "zoo_broker_reconnects_total",
+        "transparent client reconnects after transient socket errors")
+
+
 class BrokerClient:
     """One TCP connection to the broker. Thread-compatible: callers must
     not share one client across threads (make one per thread — connects
     are cheap; matches redis-py usage in the reference client)."""
 
+    # commands safe to transparently resend after a transient socket
+    # error: pure reads plus XACK (double-ack is a no-op returning 0).
+    # XADD/HSET/HDEL/DEL are NOT here — resending them after an ambiguous
+    # failure could duplicate a record or clobber a newer write.
+    _IDEMPOTENT = frozenset({
+        "PING", "XLEN", "XREADGROUP", "XCLAIM", "XPENDING", "XACK",
+        "HGET", "HKEYS",
+    })
+    RECONNECT_TRIES = 3
+    RECONNECT_BACKOFF_S = 0.05
+
     def __init__(self, host: str = "127.0.0.1", port: int = 6399,
                  timeout: float = 30.0):
         self.addr = (host, port)
+        self._timeout = timeout
         self.sock = socket.create_connection(self.addr, timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._buf = b""
+        # bumped on every transparent _reconnect: callers holding state
+        # keyed by broker entry ids (the engine's dedupe ring) watch this
+        # to learn the peer may be a RESTARTED broker with fresh ids
+        self.generation = 0
 
     # --- wire ---
     def _send(self, *parts: str):
@@ -92,9 +117,57 @@ class BrokerClient:
             return err
         raise RuntimeError(f"bad reply line: {line!r}")
 
+    @staticmethod
+    def _transient(e: BaseException) -> bool:
+        """ECONNRESET/EPIPE-class errors worth one transparent retry.
+        A clean peer close (empty recv → ConnectionError in _readline)
+        counts: that is how a broker restart looks to this client.
+        Timeouts do NOT — the command may still be executing."""
+        if isinstance(e, (socket.timeout, TimeoutError)):
+            return False
+        if isinstance(e, (ConnectionResetError, BrokenPipeError,
+                          ConnectionError)):
+            return True
+        return getattr(e, "errno", None) in (errno.ECONNRESET, errno.EPIPE)
+
+    def _reconnect(self):
+        """Redial self.addr with bounded exponential backoff and count the
+        reconnect (zoo_broker_reconnects_total)."""
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self._buf = b""
+        delay = self.RECONNECT_BACKOFF_S
+        last: Optional[BaseException] = None
+        for _ in range(self.RECONNECT_TRIES):
+            try:
+                self.sock = socket.create_connection(
+                    self.addr, timeout=self._timeout)
+                self.sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self.generation += 1
+                _reconnects_total().inc()
+                return
+            except OSError as e:
+                last = e
+                time.sleep(delay)
+                delay *= 2
+        raise ConnectionError(
+            f"broker reconnect to {self.addr} failed: {last}")
+
     def _cmd(self, *parts: str):
-        self._send(*parts)
-        return self._reply()
+        try:
+            self._send(*parts)
+            return self._reply()
+        except (ConnectionError, OSError) as e:
+            if parts[0] not in self._IDEMPOTENT or not self._transient(e):
+                raise
+            # reconnect once, resend once: at-most-one transparent retry
+            # per command keeps the backoff bounded under a dead broker
+            self._reconnect()
+            self._send(*parts)
+            return self._reply()
 
     # writes are chunked so the broker can drain its send buffer between
     # chunks — one giant sendall can deadlock both peers once the replies
@@ -147,8 +220,11 @@ class BrokerClient:
 
     def xclaim(self, stream: str, group: str, consumer: str,
                min_idle_ms: int, count: int) -> List[Tuple[int, str]]:
-        """Re-deliver pending entries idle >= min_idle_ms (dead-consumer
-        recovery; Redis XAUTOCLAIM analog)."""
+        """Re-deliver pending entries idle >= min_idle_ms that belong to
+        OTHER consumers, transferring ownership to ``consumer`` (dead-
+        consumer recovery; Redis XAUTOCLAIM analog). A consumer's own
+        in-flight entries are never handed back to it — idle time is a
+        lease, and you cannot steal your own lease."""
         lines = self._cmd("XCLAIM", stream, group, consumer,
                           str(min_idle_ms), str(count))
         out = []
@@ -162,6 +238,16 @@ class BrokerClient:
 
     def xpending(self, stream: str, group: str) -> int:
         return self._cmd("XPENDING", stream, group)
+
+    def xpending_detail(self, stream: str, group: str) -> Dict[str, int]:
+        """Per-consumer pending breakdown: consumer id -> count of
+        delivered-but-unacked entries it currently owns (Redis
+        ``XPENDING <key> <group>`` summary analog)."""
+        out: Dict[str, int] = {}
+        for ln in self._cmd("XPENDING", stream, group, "DETAIL"):
+            consumer, n = ln.rsplit(" ", 1)
+            out[consumer] = int(n)
+        return out
 
     def hset(self, key: str, field: str, value_b64: str):
         return self._cmd("HSET", key, field, value_b64)
@@ -275,7 +361,9 @@ class _PyState:
             name, {"entries": [], "next_id": 1, "groups": {}})
 
     def group(self, st, name):
-        # pending: entry id -> last delivery time (ms), for XCLAIM idle checks
+        # pending: entry id -> [owner consumer, last delivery ms, delivery
+        # count]. The owner+timestamp pair is the delivery lease XCLAIM
+        # arbitrates on; the count makes redelivery observable.
         return st["groups"].setdefault(name, {"cursor": 0, "pending": {}})
 
 
@@ -312,7 +400,7 @@ class _PyHandler(socketserver.StreamRequestHandler):
                     n = len(state.stream(p[1])["entries"])
                 w.write(f":{n}\n".encode())
             elif cmd == "XREADGROUP" and len(p) >= 6:
-                group, stream = p[1], p[3]
+                group, consumer, stream = p[1], p[2], p[3]
                 count, block_ms = int(p[4]), int(p[5])
 
                 def deliver():
@@ -325,7 +413,7 @@ class _PyHandler(socketserver.StreamRequestHandler):
                             continue
                         got.append((eid, payload))
                         gr["cursor"] = eid
-                        gr["pending"][eid] = now_ms
+                        gr["pending"][eid] = [consumer, now_ms, 1]
                         if len(got) >= count:
                             break
                     return got
@@ -366,24 +454,43 @@ class _PyHandler(socketserver.StreamRequestHandler):
                 w.write(f":{n}\n".encode())
             elif cmd == "XCLAIM" and len(p) >= 6:
                 # XCLAIM <stream> <group> <consumer> <min_idle_ms> <count>:
-                # re-deliver pending entries idle >= min_idle_ms (the
+                # re-deliver pending entries whose lease expired — idle
+                # >= min_idle_ms AND owned by a DIFFERENT consumer (the
                 # recovery path for entries a dead consumer never acked —
-                # Redis XAUTOCLAIM analog). Claiming refreshes idle time.
+                # Redis XAUTOCLAIM analog). Claiming transfers ownership,
+                # refreshes the lease clock and bumps the delivery count.
+                claimer = p[3]
                 min_idle, cnt = int(p[4]), int(p[5])
                 with state.lock:
                     st = state.stream(p[1])
                     gr = state.group(st, p[2])
                     now_ms = int(time.monotonic() * 1000)
-                    ids = sorted(eid for eid, ts in gr["pending"].items()
-                                 if now_ms - ts >= min_idle)[:cnt]
+                    ids = sorted(
+                        eid for eid, (owner, ts, _) in gr["pending"].items()
+                        if owner != claimer and now_ms - ts >= min_idle
+                    )[:cnt]
                     payloads = dict(st["entries"])
                     got = []
                     for eid in ids:
                         if eid in payloads:
-                            gr["pending"][eid] = now_ms
+                            rec = gr["pending"][eid]
+                            gr["pending"][eid] = [claimer, now_ms,
+                                                  rec[2] + 1]
                             got.append((eid, payloads[eid]))
                 out = [f"*{len(got)}\n"]
                 out += [f"{eid} {payload}\n" for eid, payload in got]
+                w.write("".join(out).encode())
+            elif cmd == "XPENDING" and len(p) >= 4:
+                # XPENDING <stream> <group> DETAIL: per-consumer breakdown
+                # (consumer id -> owned pending count), the fleet
+                # supervisor's view of who is holding which leases
+                with state.lock:
+                    gr = state.group(state.stream(p[1]), p[2])
+                    per: Dict[str, int] = {}
+                    for owner, _, _ in gr["pending"].values():
+                        per[owner] = per.get(owner, 0) + 1
+                out = [f"*{len(per)}\n"]
+                out += [f"{c} {n}\n" for c, n in sorted(per.items())]
                 w.write("".join(out).encode())
             elif cmd == "XPENDING" and len(p) >= 3:
                 with state.lock:
@@ -513,7 +620,11 @@ class Broker:
         server = _PyBrokerServer(("127.0.0.1", port), _PyHandler)
         state = _PyState(hash_ttl_ms)
         server.state = state  # type: ignore[attr-defined]
-        threading.Thread(target=server.serve_forever, daemon=True).start()
+        # serve_forever's default 0.5s poll makes every shutdown() wait
+        # out the poll loop — a tax paid on each launch/stop cycle
+        threading.Thread(target=server.serve_forever,
+                         kwargs={"poll_interval": 0.02},
+                         daemon=True).start()
         broker = cls(port, server=server)
         if hash_ttl_ms > 0:
             # periodic sweeper (the native broker's SweeperLoop analog):

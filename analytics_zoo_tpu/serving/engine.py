@@ -24,11 +24,12 @@ during every broker round-trip (VERDICT.md weak #5/#7).
 
 from __future__ import annotations
 
+import collections
 import logging
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -105,6 +106,14 @@ class ClusterServing:
     thread. On by default for models that support it (InferenceModel);
     ``ZOO_WARMUP_BUCKETS=0`` disables it process-wide, any other integer
     caps how many rungs (smallest first) are warmed.
+
+    Multi-replica fan-out: ``consumer`` defaults to this replica's fleet
+    id, so N engines sharing one ``group`` split the stream with
+    at-least-once delivery — each delivered entry carries a per-consumer
+    lease (``claim_min_idle_ms``, env ``ZOO_SERVING_LEASE_MS``), and a
+    periodic reclaim sweep (env ``ZOO_SERVING_RECLAIM_S``) claims peers'
+    expired leases so a crashed replica's entries are re-served with zero
+    loss (docs/observability.md "Multi-replica deployment").
     """
 
     #: consecutive full dequeues that count as "sustained backlog"
@@ -112,14 +121,20 @@ class ClusterServing:
     #: consecutive under-half-full dequeues before stepping DOWN one rung
     #: (bounds pad waste after a burst; empty polls count as idle too)
     IDLE_SHRINK_AFTER = 32
+    #: max entries one reclaim sweep claims — a crashed replica's whole
+    #: pending set transfers in ONE XCLAIM (overflow feeds _claim_backlog)
+    RECLAIM_BATCH = 256
+    #: finished-entry-id ring size for the redelivery dedupe
+    DEDUPE_WINDOW = 65536
 
     def __init__(self, model, broker_port: int, batch_size: int = 8,
                  stream: str = INPUT_STREAM, result_key: str = RESULT_HASH,
-                 group: str = "serving", consumer: str = "c0",
+                 group: str = "serving", consumer: Optional[str] = None,
                  input_cols: Optional[List[str]] = None,
                  cipher: schema.Cipher = None,
                  postprocess=None, block_ms: int = 50,
-                 claim_min_idle_ms: int = 30000,
+                 claim_min_idle_ms: Optional[int] = None,
+                 reclaim_interval_s: Optional[float] = None,
                  broker_host: str = "127.0.0.1",
                  image_preprocess=None,
                  pipeline_window: int = 2,
@@ -153,17 +168,48 @@ class ClusterServing:
         self.broker_host = broker_host
         self.broker_port = broker_port
         self.stream, self.result_key = stream, result_key
-        self.group, self.consumer = group, consumer
+        # fleet identity first: the default consumer id IS the replica id,
+        # so N replicas sharing one group fan out with per-consumer leases
+        # instead of all reading as "c0" (single-consumer legacy)
+        self.replica_id = replica_id or fleet.default_replica_id(stream)
+        self.group = group
+        self.consumer = consumer or self.replica_id
         self.input_cols = input_cols
         self.cipher = cipher
         self.postprocess = postprocess
         self.image_preprocess = image_preprocess
         self.block_ms = block_ms
+        # the delivery lease: entries idle past this are claimable by any
+        # OTHER consumer (at-least-once redelivery after a replica crash)
+        if claim_min_idle_ms is None:
+            raw = os.environ.get("ZOO_SERVING_LEASE_MS", "").strip()
+            claim_min_idle_ms = int(raw) if raw else 30000
         self.claim_min_idle_ms = int(claim_min_idle_ms)
-        # claim at most ~1/s — recovery is a rare path, the hot read loop
-        # must not pay a broker round-trip per poll
-        self._claim_interval_s = max(0.5, self.claim_min_idle_ms / 2000.0)
+        # claim at most ~1/s by default — recovery is a rare path, the hot
+        # read loop must not pay a broker round-trip per poll
+        if reclaim_interval_s is None:
+            raw = os.environ.get("ZOO_SERVING_RECLAIM_S", "").strip()
+            reclaim_interval_s = float(raw) if raw \
+                else max(0.5, self.claim_min_idle_ms / 2000.0)
+        self._claim_interval_s = float(reclaim_interval_s)
         self._last_claim = 0.0
+        # supervisor-thread → serve-thread "sweep now" signal (Event: the
+        # rate-limiter clock itself stays serve-thread-confined)
+        self._reclaim_asap = threading.Event()
+        # one reclaim sweep claims every expired lease in a single XCLAIM
+        # (up to RECLAIM_BATCH); beyond-batch entries queue here and feed
+        # subsequent dispatches, so "sweeps fired" stays 1 per crash
+        self._claim_backlog: Deque[Tuple[int, str]] = collections.deque()
+        # entry-id dedupe ring: ids in flight or already finished by THIS
+        # consumer are dropped on re-arrival, making result writes
+        # idempotent under at-least-once redelivery. Serve-thread only.
+        self._inflight_ids: set = set()
+        self._done_ids: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+        # broker connection generation: a redial invalidates the dedupe
+        # ring (a restarted broker reuses entry ids from 1)
+        self._conn_gen = 0
+        self._seen_client_gen = 0
         self.timer = StageTimer()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -199,13 +245,26 @@ class ClusterServing:
             "zoo_serving_latency_seconds",
             "End-to-end record latency: client enqueue to result flush",
             ("stream",)).labels(stream)
-        # fleet identity: heartbeats ride the broker hash so any frontend
+        # at-least-once delivery observability: redeliveries received via
+        # XCLAIM and the reclaim sweeps that produced them
+        self._redeliver_counter = reg.counter(
+            "zoo_serving_redelivered_total",
+            "Entries re-delivered via lease reclaim (XCLAIM)",
+            ("stream",)).labels(stream)
+        self._reclaim_counter = reg.counter(
+            "zoo_serving_lease_reclaims_total",
+            "Reclaim sweeps that claimed at least one expired lease",
+            ("stream",)).labels(stream)
+        # cross-thread-readable mirrors for /healthz and tests
+        self.records_redelivered = 0
+        self.lease_reclaims = 0
+        # fleet identity heartbeats ride the broker hash so any frontend
         # can enumerate live replicas (common/fleet.py); the frontend
         # fills in the advertised metrics host/port at start()
-        self.replica_id = replica_id or fleet.default_replica_id(stream)
         self._advertise = ("127.0.0.1", 0)
         self._started_wall = 0.0
         self._heartbeater: Optional[fleet.Heartbeater] = None
+        self._replica_supervisor: Optional[fleet.ReplicaSupervisor] = None
         # wedge failover (ISSUE 7): with ZOO_CPU_FALLBACK=1 a backend-loss
         # error drains the window onto pre-built CPU executables and keeps
         # serving degraded until the supervisor reports recovered. The
@@ -245,20 +304,67 @@ class ClusterServing:
         # recover entries a dead/crashed consumer never acked (ref: the
         # Redis-streams recovery path the reference LACKS an analog of —
         # XPENDING counts them but they were lost forever; here XCLAIM
-        # re-delivers once they have been idle claim_min_idle_ms).
-        # Rate-limited: recovery polling must not tax the hot read loop.
+        # re-delivers another consumer's entries once their delivery lease
+        # has been idle claim_min_idle_ms). Rate-limited: recovery polling
+        # must not tax the hot read loop. One sweep claims EVERY expired
+        # lease (up to RECLAIM_BATCH); the overflow queues in
+        # _claim_backlog and feeds the next dispatches.
         # All stage timing is on the monotonic perf_counter clock — wall-
         # clock stamps let NTP slew corrupt stage stats AND the claim-
         # interval rate limiter.
         entries = []
-        if t_dq0 - self._last_claim >= self._claim_interval_s:
+        if self._claim_backlog:
+            while self._claim_backlog and len(entries) < self.batch_size:
+                entries.append(self._claim_backlog.popleft())
+        elif self._reclaim_asap.is_set() or \
+                t_dq0 - self._last_claim >= self._claim_interval_s:
+            self._reclaim_asap.clear()
             self._last_claim = t_dq0
-            entries = client.xclaim(self.stream, self.group, self.consumer,
-                                    self.claim_min_idle_ms, self.batch_size)
+            claimed = client.xclaim(self.stream, self.group, self.consumer,
+                                    self.claim_min_idle_ms,
+                                    self.RECLAIM_BATCH)
+            if claimed:
+                self._redeliver_counter.inc(len(claimed))
+                self._reclaim_counter.inc()
+                with self._state_lock:
+                    self.records_redelivered += len(claimed)
+                    self.lease_reclaims += 1
+                logger.warning("lease reclaim: %d orphaned entries "
+                               "re-delivered to %s", len(claimed),
+                               self.consumer)
+                entries = claimed[:self.batch_size]
+                self._claim_backlog.extend(claimed[self.batch_size:])
         if not entries:
             entries = client.xreadgroup(self.group, self.consumer,
                                         self.stream, self.batch_size,
                                         block_ms)
+        # the client may have transparently redialed inside xclaim/
+        # xreadgroup (BrokerClient retry): the peer could be a RESTARTED
+        # broker reusing entry ids from 1, so the dedupe ring must reset
+        # BEFORE it classifies this read's ids
+        cgen = getattr(client, "generation", 0)
+        if cgen != self._seen_client_gen:
+            self._seen_client_gen = cgen
+            self._conn_gen += 1
+            self._inflight_ids.clear()
+            self._done_ids.clear()
+            self._claim_backlog.clear()
+        # idempotence under redelivery: an id this consumer already has in
+        # flight (or has finished this connection) is dropped, so a
+        # double-delivered record can never double-count or double-write.
+        # Already-done ids get their (lost) ack replayed instead.
+        if entries:
+            fresh, stale_acks = [], []
+            for eid, payload in entries:
+                if eid in self._done_ids:
+                    stale_acks.append(
+                        ("XACK", self.stream, self.group, str(eid)))
+                elif eid not in self._inflight_ids:
+                    self._inflight_ids.add(eid)
+                    fresh.append((eid, payload))
+            if stale_acks:
+                client.pipeline(stale_acks)
+            entries = fresh
         if not entries:
             # an empty poll is the strongest idle signal there is — it
             # feeds the same streak accounting as an under-half-full batch
@@ -328,6 +434,7 @@ class ClusterServing:
             if err_cmds:
                 self._err_counter.inc(len(err_cmds))
             client.pipeline(err_cmds + ack_cmds)
+            self._mark_done(ack_cmds, self._conn_gen)
             return None
         cols = self.input_cols or sorted(rows[0].keys())
         batch = [np.stack([r[c] for r in rows]) for c in cols]
@@ -346,8 +453,24 @@ class ClusterServing:
         trace = (t_dq0, t_dq1, t0, t_pp1) \
             if self._tracer.should_sample() else None
         # x rides the ctx too so a backend-lost batch can be re-dispatched
-        # on the CPU fallback at retire time (_failover_redispatch)
-        return x, (uris, err_cmds, ack_cmds, n, trace, metas, x)
+        # on the CPU fallback at retire time (_failover_redispatch); the
+        # connection generation gates the dedupe bookkeeping in _finish
+        return x, (uris, err_cmds, ack_cmds, n, trace, metas, x,
+                   self._conn_gen)
+
+    def _mark_done(self, ack_cmds, gen: int):
+        """Move a flushed batch's entry ids from in-flight to the bounded
+        done ring (serve-thread only). ``gen`` guards against a batch that
+        straddled a broker reconnect poisoning the fresh ring — a
+        restarted broker reuses entry ids from 1."""
+        if gen != self._conn_gen:
+            return
+        for c in ack_cmds:
+            eid = int(c[3])
+            self._inflight_ids.discard(eid)
+            self._done_ids[eid] = None
+        while len(self._done_ids) > self.DEDUPE_WINDOW:
+            self._done_ids.popitem(last=False)
 
     def _queue_wait(self, meta, t_dq1: float):
         """Measure one record's broker queue wait from its client stamp.
@@ -563,6 +686,7 @@ class ClusterServing:
             if served is not None:
                 return served
         uris, err_cmds, ack_cmds, n, trace, metas = comp.ctx[:6]
+        gen = comp.ctx[7] if len(comp.ctx) > 7 else self._conn_gen
         if err_cmds:
             self._err_counter.inc(len(err_cmds))
         if comp.error is not None:
@@ -577,6 +701,7 @@ class ClusterServing:
                 err_cmds
                 + [("HSET", self.result_key, uri, err) for uri in uris]
                 + ack_cmds)
+            self._mark_done(ack_cmds, gen)
             self.timer.record("inference_error", comp.inflight_s)
             self._err_counter.inc(n)
             return 0
@@ -615,6 +740,7 @@ class ClusterServing:
             self._record_batch_trace(uris, trace, comp, t0, t_pp_end,
                                      metas)
         client.pipeline(cmds + ack_cmds)
+        self._mark_done(ack_cmds, gen)
         return n
 
     def _record_batch_trace(self, uris, trace, comp: Completed,
@@ -708,6 +834,13 @@ class ClusterServing:
                 if client is not None:
                     client.close()
                     client = None
+                # a restarted broker reuses entry ids from 1: the dedupe
+                # ring and claim backlog describe a dead connection
+                self._conn_gen += 1
+                self._seen_client_gen = 0   # fresh client starts at gen 0
+                self._inflight_ids.clear()
+                self._done_ids.clear()
+                self._claim_backlog.clear()
                 time.sleep(0.2)
             except Exception:
                 # the loop is the service — survive anything per-batch
@@ -774,20 +907,44 @@ class ClusterServing:
         if self._heartbeater is None and fleet.heartbeat_interval_s() > 0:
             self._started_wall = \
                 time.time()  # zoolint: disable=wallclock-hotpath
-            self._heartbeater = fleet.Heartbeater(
-                fleet.ReplicaRegistry(self.broker_host, self.broker_port),
-                self._replica_info)
+            registry = fleet.ReplicaRegistry(self.broker_host,
+                                             self.broker_port)
+            self._heartbeater = fleet.Heartbeater(registry,
+                                                  self._replica_info)
             self._heartbeater.start()
+            # watch the fleet for crashed peers: on orphaned pending
+            # entries the supervisor expedites this replica's next reclaim
+            # sweep instead of waiting out the rate limiter
+            self._replica_supervisor = fleet.ReplicaSupervisor(
+                registry, self.stream, self.group,
+                broker_host=self.broker_host, broker_port=self.broker_port,
+                own_replica_id=self.replica_id,
+                on_orphans=self._expedite_reclaim)
+            self._replica_supervisor.start()
         return self
 
+    def _expedite_reclaim(self, n_orphans: int):
+        """ReplicaSupervisor callback: a stale peer left ``n_orphans``
+        unacked entries — run the next reclaim sweep immediately (the
+        entries still wait out their lease inside the broker)."""
+        self._reclaim_asap.set()
+
     def stop(self):
+        """Graceful drain: stop reading → flush in-flight → ack →
+        deregister. The serve thread joins BEFORE the heartbeater
+        deregisters — deregistering first would mark this replica's
+        pending entries orphaned while the final drain is still about to
+        ack them, handing peers a double-processing window."""
         self._stop.set()
-        hb, self._heartbeater = self._heartbeater, None
-        if hb is not None:
-            hb.stop()
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        rsup, self._replica_supervisor = self._replica_supervisor, None
+        if rsup is not None:
+            rsup.stop()
+        hb, self._heartbeater = self._heartbeater, None
+        if hb is not None:
+            hb.stop()   # deregisters only now, after the final drain acked
         # the supervisor is a process singleton, but the engine is the
         # process's deployment unit — stop the probe loop with the serving
         with self._state_lock:
@@ -799,7 +956,9 @@ class ClusterServing:
         """Throughput + stage latencies (ref Flink numRecordsOutPerSecond +
         Timer stats)."""
         with self._state_lock:
-            out = {"records_out": self.records_out}
+            out = {"records_out": self.records_out,
+                   "records_redelivered": self.records_redelivered,
+                   "lease_reclaims": self.lease_reclaims}
         out.update(self.timer.summary())
         return out
 

@@ -198,12 +198,42 @@ class _Handler(BaseHTTPRequestHandler):
             out["status"] = "overloaded"
             out["reason"] = "backlog"
             code = 503
-        # fleet view: who else is serving, by heartbeat freshness
+        # fleet view: who else is serving, by heartbeat freshness, plus
+        # the multi-replica delivery state. Membership is read FRESH from
+        # the registry on every call — the supervisor's cached sweep can
+        # predate a just-joined replica by a full sweep interval, and a
+        # health endpoint must not under-report the fleet. The cached
+        # sweep only contributes the delivery state (per-consumer pending
+        # leases, orphaned entries), falling back to a direct broker read
+        # when this frontend runs engine-less.
         if out["broker"] == "up":
             try:
                 live, stale = fleet.ReplicaRegistry(
                     srv.broker_host, srv.broker_port).partition()
-                out["fleet"] = {"replicas": len(live), "stale": len(stale)}
+                out["fleet"] = {"replicas": len(live),
+                                "stale": len(stale)}
+                rsup = getattr(engine, "_replica_supervisor", None)
+                snap = rsup.snapshot() if rsup is not None else {}
+                if snap:
+                    out["fleet"].update(
+                        pending_per_replica=snap["pending_per_replica"],
+                        orphan_entries=snap["orphan_entries"],
+                        reclaim_sweeps=snap["sweeps"])
+                else:
+                    try:
+                        fc = BrokerClient(host=srv.broker_host,
+                                          port=srv.broker_port)
+                        try:
+                            out["fleet"]["pending_per_replica"] = \
+                                fc.xpending_detail(stream, group)
+                        finally:
+                            fc.close()
+                    except Exception:
+                        pass
+                if engine is not None:
+                    out["fleet"]["lease_reclaims"] = engine.lease_reclaims
+                    out["fleet"]["records_redelivered"] = \
+                        engine.records_redelivered
             except Exception:
                 out["fleet"] = {"replicas": 0, "stale": 0}
         # burn-rate shedding: the *measured* overload signal — p99/error

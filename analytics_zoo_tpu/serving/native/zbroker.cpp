@@ -16,6 +16,8 @@
 //   XCLAIM <stream> <group> <consumer> <min_idle_ms> <count>
 //                                               -> *<n> then n lines "<id> <b64>"
 //   XPENDING <stream> <group>                   -> :<n-pending>
+//   XPENDING <stream> <group> DETAIL            -> *<n> then n lines
+//                                                  "<consumer> <count>"
 //   HSET <key> <field> <b64>                    -> +OK
 //   HGET <key> <field>                          -> $<b64> | $-1
 //   HKEYS <key>                                 -> *<n> then n lines "<field>"
@@ -27,7 +29,11 @@
 // payloads are opaque b64 strings, so critical sections are pointer work);
 // blocking XREADGROUP waits on a condition_variable. Delivery semantics
 // mirror Redis streams: per-(stream,group) cursor of last-delivered id;
-// un-ACKed entries are tracked per group (XPENDING) for crash visibility.
+// un-ACKed entries are tracked per group with their owning consumer and
+// last-delivery time — a delivery LEASE: XCLAIM transfers entries whose
+// lease has been idle past min_idle_ms to another consumer (never back to
+// their current owner), and XPENDING DETAIL attributes the backlog per
+// consumer for crash visibility.
 //
 // Build: g++ -O2 -std=c++17 -pthread -o zbroker zbroker.cpp
 
@@ -58,11 +64,18 @@ struct Entry {
   std::string payload;
 };
 
+struct PendingEntry {
+  std::string consumer;  // current lease owner
+  long long ts = 0;      // last delivery (ms, steady clock) — the lease
+  long long deliveries = 0;  // total deliveries incl. XCLAIM redeliveries
+};
+
 struct Group {
   long long cursor = 0;                 // last delivered id
-  // delivered-not-acked: id -> last delivery time (ms since epoch), so
-  // XCLAIM can re-deliver entries whose consumer died (idle too long)
-  std::map<long long, long long> pending;
+  // delivered-not-acked: id -> lease record, so XCLAIM can re-deliver
+  // entries whose owning consumer died (lease idle too long) and
+  // XPENDING DETAIL can attribute backlog per consumer
+  std::map<long long, PendingEntry> pending;
 };
 
 long long NowMs() {
@@ -272,7 +285,7 @@ void HandleConn(int fd) {
       std::lock_guard<std::mutex> lk(g_mu);
       SendAll(fd, ":" + std::to_string(g_streams[p[1]].entries.size()) + "\n");
     } else if (cmd == "XREADGROUP" && p.size() >= 6) {
-      const std::string &group = p[1], &stream = p[3];
+      const std::string &group = p[1], &consumer = p[2], &stream = p[3];
       int count = atoi(p[4].c_str());
       int block_ms = atoi(p[5].c_str());
       std::vector<Entry> got;
@@ -286,7 +299,7 @@ void HandleConn(int fd) {
             if (e.id <= gr.cursor) continue;
             got.push_back(e);
             gr.cursor = e.id;
-            gr.pending[e.id] = now_ms;
+            gr.pending[e.id] = PendingEntry{consumer, now_ms, 1};
             if (static_cast<int>(got.size()) >= count) break;
           }
           return !got.empty();
@@ -328,9 +341,12 @@ void HandleConn(int fd) {
       SendAll(fd, ":" + std::to_string(n) + "\n");
     } else if (cmd == "XCLAIM" && p.size() >= 6) {
       // XCLAIM <stream> <group> <consumer> <min_idle_ms> <count>:
-      // re-deliver pending entries idle >= min_idle_ms (recovery of
+      // re-deliver pending entries whose lease expired — idle >=
+      // min_idle_ms AND owned by a DIFFERENT consumer (recovery of
       // entries whose consumer died before XACK — Redis XAUTOCLAIM
-      // analog). Claiming refreshes the idle clock.
+      // analog). Claiming transfers ownership, refreshes the lease
+      // clock and bumps the delivery count.
+      const std::string& claimer = p[3];
       long long min_idle = atoll(p[4].c_str());
       int count = atoi(p[5].c_str());
       std::vector<Entry> got;
@@ -346,11 +362,14 @@ void HandleConn(int fd) {
           for (const Entry& e : st.entries) index[e.id] = &e;
           for (auto& kv : gr.pending) {
             if (static_cast<int>(got.size()) >= count) break;
-            if (now_ms - kv.second < min_idle) continue;
+            if (kv.second.consumer == claimer) continue;
+            if (now_ms - kv.second.ts < min_idle) continue;
             auto it = index.find(kv.first);
             if (it != index.end()) {
               got.push_back(*it->second);
-              kv.second = now_ms;
+              kv.second.consumer = claimer;
+              kv.second.ts = now_ms;
+              kv.second.deliveries += 1;
             }
           }
         }
@@ -358,6 +377,19 @@ void HandleConn(int fd) {
       std::ostringstream os;
       os << "*" << got.size() << "\n";
       for (const Entry& e : got) os << e.id << " " << e.payload << "\n";
+      SendAll(fd, os.str());
+    } else if (cmd == "XPENDING" && p.size() >= 4) {
+      // XPENDING <stream> <group> DETAIL -> per-consumer pending counts
+      // ("<consumer> <count>" lines, sorted by consumer id)
+      std::map<std::string, long long> per;
+      {
+        std::lock_guard<std::mutex> lk(g_mu);
+        Group& gr = g_streams[p[1]].groups[p[2]];
+        for (auto& kv : gr.pending) per[kv.second.consumer] += 1;
+      }
+      std::ostringstream os;
+      os << "*" << per.size() << "\n";
+      for (auto& kv : per) os << kv.first << " " << kv.second << "\n";
       SendAll(fd, os.str());
     } else if (cmd == "XPENDING" && p.size() >= 3) {
       std::lock_guard<std::mutex> lk(g_mu);

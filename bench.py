@@ -558,6 +558,171 @@ def measure_serving_failover():
     }
 
 
+# multi-replica drill shapes: fixed tiny in both smoke and full mode —
+# these measure the DELIVERY layer (consumer-group fan-out, lease
+# redelivery), not model throughput, so a sleep-dominated duck model
+# keeps the numbers deterministic on any host: with predict sleep
+# dominating, stream drain time is (batches x sleep) / replicas
+MR_N, MR_BATCH, MR_SLEEP_MS = 96, 4, 25.0
+
+
+def _replica_snapshot_metric(http_port, family, timeout_s=2.0):
+    """Read one stream-labeled counter from a replica subprocess via its
+    frontend's mergeable snapshot endpoint; 0.0 if unreachable (a killed
+    replica answers nothing — that is the point)."""
+    import urllib.request
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{http_port}/metrics?format=snapshot",
+                timeout=timeout_s) as r:
+            snap = json.loads(r.read().decode("utf-8"))
+    except Exception:
+        return 0.0
+    fam = snap.get(family, {})
+    if not isinstance(fam, dict):
+        return float(fam or 0.0)
+    return float(fam.get("stream=serving_stream", 0.0))
+
+
+def measure_serving_multi_replica():
+    """Consumer-group fan-out scaling (ISSUE 9): N replica processes
+    share ONE broker stream through XREADGROUP, so adding a replica adds
+    throughput with no client-side sharding. One replica drains the
+    backlog, then a second joins the same group and they split it; with
+    predict sleep-dominated the 2-replica drain must approach 2x
+    (``serving_replica_scaling`` >= 1.5 is the gated floor on any
+    host — the delivery layer, not the model, is under test)."""
+    import numpy as np
+    from analytics_zoo_tpu.common import resilience
+    from analytics_zoo_tpu.serving import Broker, InputQueue, OutputQueue
+
+    rng = np.random.default_rng(13)
+    payloads = rng.standard_normal((MR_N, 6)).astype(np.float32)
+
+    def drain(port, tag):
+        in_q = InputQueue(port=port)
+        out_q = OutputQueue(port=port)
+        t0 = time.perf_counter()
+        uris = in_q.enqueue_batch(
+            (f"{tag}{i}", {"x": payloads[i]}) for i in range(MR_N))
+        res = out_q.query_many(uris, timeout=90.0)
+        dt = time.perf_counter() - t0
+        missing = [u for u, v in res.items() if v is None]
+        assert not missing, f"{len(missing)} records unanswered ({tag})"
+        return MR_N / dt
+
+    with Broker.launch() as broker:
+        rep_a = resilience.ServingReplicaProc(
+            broker.port, batch_size=MR_BATCH, predict_sleep_ms=MR_SLEEP_MS)
+        try:
+            # one warm record settles the lone replica's read loop, then
+            # the single-replica pass sets the scaling denominator
+            in_q = InputQueue(port=broker.port)
+            out_q = OutputQueue(port=broker.port)
+            in_q.enqueue("mrwarm", x=payloads[0])
+            assert out_q.query("mrwarm", timeout=60.0) is not None
+            rps_one = drain(broker.port, "one")
+            rep_b = resilience.ServingReplicaProc(
+                broker.port, batch_size=MR_BATCH,
+                predict_sleep_ms=MR_SLEEP_MS)
+            try:
+                rps_two = drain(broker.port, "two")
+            finally:
+                rep_b.stop()
+        finally:
+            rep_a.stop()
+    return {
+        "serving_single_replica_records_per_sec": round(rps_one, 1),
+        "serving_multi_replica_records_per_sec": round(rps_two, 1),
+        "serving_replica_scaling": round(rps_two / rps_one, 3),
+        "serving_replica_count": 2,
+    }
+
+
+def measure_replica_kill_failover():
+    """Replica-kill chaos drill (ISSUE 9 tentpole): SIGKILL one of two
+    replicas mid-stream under a deterministic fault plan (no drain, no
+    deregister); the survivor must reclaim the corpse's expired leases
+    via XCLAIM and answer EVERY record at the result hash — zero loss is
+    asserted, redelivery must be visible in the survivor's
+    ``zoo_serving_redelivered_total``. The gated lower-better headline
+    ``serving_replica_failover_seconds`` spans kill → first poll where
+    the survivor reports a redelivered entry. Tight lease/heartbeat
+    knobs ride ``env_extra`` so the drill converges in seconds."""
+    import numpy as np
+    from analytics_zoo_tpu.common import resilience
+    from analytics_zoo_tpu.serving import Broker, InputQueue, OutputQueue
+
+    # the victim's predict is wedged outright (long sleep): it takes its
+    # in-flight window within a few ms and never acks, so the whole
+    # orphaned window expires together and ONE reclaim sweep recovers it
+    # — deterministic on any host. The survivor stays sleep-dominated so
+    # the backlog outlives the kill by a wide margin (40 batches x 25ms
+    # ~= 1s of work).
+    n = 160
+    rng = np.random.default_rng(17)
+    payloads = rng.standard_normal((n, 6)).astype(np.float32)
+    env = {"ZOO_SERVING_LEASE_MS": "300", "ZOO_SERVING_RECLAIM_S": "0.25",
+           "ZOO_FLEET_HEARTBEAT_S": "0.25", "ZOO_FLEET_STALE_S": "1.0"}
+
+    with resilience.fault_drill("kill@replica:1", cpu_fallback=False), \
+            Broker.launch() as broker:
+        victim = resilience.ServingReplicaProc(
+            broker.port, batch_size=MR_BATCH,
+            predict_sleep_ms=60_000.0, env_extra=env)
+        survivor = resilience.ServingReplicaProc(
+            broker.port, batch_size=MR_BATCH,
+            predict_sleep_ms=MR_SLEEP_MS, env_extra=env)
+        try:
+            in_q = InputQueue(port=broker.port)
+            out_q = OutputQueue(port=broker.port)
+            uris = list(in_q.enqueue_batch(
+                (f"kf{i}", {"x": payloads[i]}) for i in range(n)))
+            res = {}
+            pending = list(uris)
+            t_kill = failover_s = None
+            deadline = time.monotonic() + 120.0
+            while pending and time.monotonic() < deadline:
+                # short poll rounds double as drill checkpoints: the
+                # plan's site-arrival counter ticks once per round, so
+                # ``kill@replica:1`` strikes ~0.25s in — the victim is
+                # mid-batch with a full in-flight window to orphan
+                got = out_q.query_many(pending, timeout=0.25)
+                for u, v in got.items():
+                    if v is not None:
+                        res[u] = v
+                pending = [u for u in pending if u not in res]
+                if t_kill is None:
+                    if resilience.maybe_kill_replica(victim):
+                        t_kill = time.perf_counter()
+                elif failover_s is None and _replica_snapshot_metric(
+                        survivor.http_port,
+                        "zoo_serving_redelivered_total") >= 1.0:
+                    failover_s = time.perf_counter() - t_kill
+            redelivered = _replica_snapshot_metric(
+                survivor.http_port, "zoo_serving_redelivered_total")
+            if t_kill is not None and failover_s is None and redelivered:
+                failover_s = time.perf_counter() - t_kill
+            reclaims = _replica_snapshot_metric(
+                survivor.http_port, "zoo_serving_lease_reclaims_total")
+            records_total = _replica_snapshot_metric(
+                survivor.http_port, "zoo_serving_records_total")
+        finally:
+            survivor.stop()
+            victim.stop()
+    assert not pending, f"{len(pending)} records lost after replica kill"
+    assert t_kill is not None, "fault plan armed but no replica was killed"
+    assert redelivered >= 1.0, "replica kill produced no redelivery"
+    assert failover_s is not None, "redelivery never observed post-kill"
+    return {
+        "serving_replica_failover_seconds": round(failover_s, 4),
+        "serving_replica_kill_records": n,
+        "serving_replica_kill_redelivered": int(redelivered),
+        "serving_replica_lease_reclaims": int(reclaims),
+        "serving_survivor_records_total": int(records_total),
+    }
+
+
 def measure_tcn():
     """Zouwu TCN (ref tcn.py:91): training steps/sec on rolling windows."""
     import numpy as np
@@ -1201,7 +1366,9 @@ def _smoke():
         "mode": "smoke",
         "device": jax.devices()[0].device_kind,
     }
-    rec = _assemble_record(out, (measure_serving, measure_serving_failover))
+    rec = _assemble_record(out, (measure_serving, measure_serving_failover,
+                                 measure_serving_multi_replica,
+                                 measure_replica_kill_failover))
     if fr is not None:
         # armed smoke leaves the artifact the CI lane asserts on
         fr.note("smoke complete")
@@ -1241,7 +1408,8 @@ def main():
     }
     _run_with_deadline(
         out, (measure_bert, measure_tcn, measure_serving,
-              measure_serving_failover, measure_flash_attention,
+              measure_serving_failover, measure_serving_multi_replica,
+              measure_replica_kill_failover, measure_flash_attention,
               measure_int8_predict, measure_resnet50_train,
               measure_widedeep_train),
         deadline_s=float(os.environ.get("BENCH_DEADLINE_S", 2700)))

@@ -13,4 +13,11 @@ def register_bogus(registry):
     g = registry.gauge("zoo_autotune_bogus_ms",
                        "not in docs")  # VIOLATION metric-undocumented
     knob = os.getenv("ZOO_AUTOTUNE_BOGUS")  # VIOLATION envvar-undocumented
-    return c, flag, g, knob
+    # a serving-delivery family the catalog does NOT list: the drift
+    # check must flag new zoo_serving_* names (the redelivery counters
+    # landed with the multi-replica contract; a typo'd sibling like this
+    # one must not slide through as "close enough")
+    r = registry.counter("zoo_serving_redelivered_bogus_total",
+                         "not in docs")  # VIOLATION metric-undocumented
+    lease = os.getenv("ZOO_SERVING_BOGUS_MS")  # VIOLATION envvar-undocumented
+    return c, flag, g, knob, r, lease

@@ -216,6 +216,11 @@ def test_smoke_mode_embeds_telemetry_snapshot(tiny_bench, monkeypatch,
         monkeypatch.setattr(bench, k, getattr(bench, k))
     monkeypatch.setattr(bench, "measure_ncf", fake_ncf)
     monkeypatch.setattr(bench, "measure_serving", fake_serving)
+    # the replica drills spawn subprocess fleets — covered by
+    # test_multi_replica.py and the chaos lane, stubbed out here
+    for heavy in ("measure_serving_failover", "measure_serving_multi_replica",
+                  "measure_replica_kill_failover"):
+        monkeypatch.setattr(bench, heavy, lambda: {})
     bench._smoke()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["mode"] == "smoke"

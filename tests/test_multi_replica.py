@@ -1,0 +1,388 @@
+"""Multi-replica serving delivery contract (ISSUE 9): consumer-group
+broker semantics (lease-based XCLAIM redelivery, per-consumer XPENDING),
+BrokerClient transparent reconnect retry, fleet orphan detection,
+graceful-drain/deregister ordering, engine idempotence under
+redelivery, and the 2-replica SIGKILL chaos drill (slow-marked — the
+``chaos`` lane in dev/run-tests.sh runs it)."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import fleet, resilience, telemetry
+from analytics_zoo_tpu.serving import (
+    Broker, ClusterServing, InputQueue, OutputQueue,
+)
+from analytics_zoo_tpu.serving.broker import BrokerClient, build_native_broker
+
+
+BACKENDS = ["python"] + (["native"] if build_native_broker() else [])
+
+STREAM, GROUP = "serving_stream", "serving"
+
+
+@pytest.fixture(params=BACKENDS)
+def broker(request):
+    b = Broker.launch(backend=request.param)
+    yield b
+    b.stop()
+
+
+def _counter(family, label=None):
+    """Current value of a registry counter from the global snapshot (0.0
+    when the family has never been touched)."""
+    fam = telemetry.snapshot().get(family, {})
+    if not isinstance(fam, dict):
+        return float(fam or 0.0)
+    if label is None:
+        # unlabeled counters snapshot as {"": v} or a bare number
+        return float(next(iter(fam.values()), 0.0))
+    return float(fam.get(label, 0.0))
+
+
+# ------------------------------------------------- broker lease semantics
+
+class TestLeaseSemantics:
+    def test_xclaim_never_steals_claimer_own_lease(self, broker):
+        c = broker.client()
+        for i in range(3):
+            c.xadd("s", f"cDA{i}=")
+        assert len(c.xreadgroup("g", "c0", "s", 10)) == 3
+        # idle 0 qualifies every entry, but c0 owns them: nothing moves
+        assert c.xclaim("s", "g", "c0", 0, 10) == []
+        assert c.xpending_detail("s", "g") == {"c0": 3}
+        # a DIFFERENT consumer takes all three; ownership transfers
+        got = c.xclaim("s", "g", "c1", 0, 10)
+        assert [e[0] for e in got] == [1, 2, 3]
+        assert c.xpending_detail("s", "g") == {"c1": 3}
+
+    def test_xclaim_on_acked_entries_is_noop(self, broker):
+        c = broker.client()
+        for i in range(2):
+            c.xadd("s", "YQ==")
+        got = c.xreadgroup("g", "c0", "s", 10)
+        for eid, _ in got:
+            assert c.xack("s", "g", eid) == 1
+        assert c.xpending("s", "g") == 0
+        assert c.xclaim("s", "g", "c1", 0, 10) == []
+        assert c.xpending_detail("s", "g") == {}
+
+    def test_lease_expiry_boundary(self, broker):
+        c = broker.client()
+        c.xadd("s", "YQ==")
+        c.xreadgroup("g", "c0", "s", 1)
+        # lease still fresh: a long min_idle refuses the claim
+        assert c.xclaim("s", "g", "c1", 60_000, 10) == []
+        time.sleep(0.25)
+        got = c.xclaim("s", "g", "c1", 200, 10)
+        assert [e[0] for e in got] == [1]
+        # claiming REFRESHED the lease clock: the original owner cannot
+        # immediately claim it back with the same idle threshold
+        assert c.xclaim("s", "g", "c0", 200, 10) == []
+        time.sleep(0.25)
+        assert [e[0] for e in c.xclaim("s", "g", "c0", 200, 10)] == [1]
+
+    def test_xpending_detail_per_consumer(self, broker):
+        c = broker.client()
+        for i in range(5):
+            c.xadd("s", "YQ==")
+        a = c.xreadgroup("g", "c0", "s", 3)
+        c.xreadgroup("g", "c1", "s", 2)
+        assert c.xpending_detail("s", "g") == {"c0": 3, "c1": 2}
+        assert c.xpending("s", "g") == 5
+        c.xack("s", "g", a[0][0])
+        assert c.xpending_detail("s", "g") == {"c0": 2, "c1": 2}
+
+    @pytest.mark.parametrize("backend", BACKENDS)
+    def test_hash_ttl_never_evicts_pending_delivery_entries(self, backend):
+        """The result-hash TTL reaps uncollected RESULTS only: stream
+        entries under an un-acked delivery must survive any TTL so a
+        crashed consumer's records stay claimable."""
+        b = Broker.launch(backend=backend, hash_ttl_ms=150)
+        try:
+            c = b.client()
+            for i in range(3):
+                c.xadd("s", f"cGF5{i}")
+            c.xreadgroup("g", "c0", "s", 10)
+            c.hset("h", "k", "dg==")
+            time.sleep(0.6)
+            c.hset("h", "poke", "dg==")       # trigger amortized eviction
+            assert c.hget("h", "k") is None    # TTL demonstrably live
+            assert c.xlen("s") == 3            # stream untouched
+            got = c.xclaim("s", "g", "c1", 0, 10)
+            assert [payload for _, payload in got] == \
+                ["cGF50", "cGF51", "cGF52"]
+            # only a full ack cycle releases the entries
+            for eid, _ in got:
+                c.xack("s", "g", eid)
+            assert c.xlen("s") == 0
+        finally:
+            b.stop()
+
+
+# ------------------------------------------------- client reconnect retry
+
+class TestClientReconnect:
+    def test_idempotent_reads_survive_broker_restart(self):
+        b1 = Broker.launch(backend="python")
+        port = b1.port
+        c = BrokerClient(port=port)
+        try:
+            assert c.ping()
+            c.xadd("s", "YQ==")
+            before = _counter("zoo_broker_reconnects_total")
+            b1.stop()
+            b2 = Broker.launch(backend="python", port=port)
+            try:
+                # XLEN rides the transparent reconnect+resend path; the
+                # restarted broker is empty, and the generation bump tells
+                # id-keyed callers their world was reset
+                assert c.xlen("s") == 0
+                assert c.generation == 1
+                assert _counter("zoo_broker_reconnects_total") == before + 1
+            finally:
+                b2.stop()
+        finally:
+            c.close()
+
+    def test_xadd_is_never_transparently_resent(self):
+        b1 = Broker.launch(backend="python")
+        port = b1.port
+        c = BrokerClient(port=port)
+        try:
+            assert c.ping()
+            b1.stop()
+            b2 = Broker.launch(backend="python", port=port)
+            try:
+                # a resend after an ambiguous failure could duplicate the
+                # record, so the error must surface to the caller
+                with pytest.raises((ConnectionError, OSError)):
+                    c.xadd("s", "YQ==")
+                fresh = BrokerClient(port=port)
+                try:
+                    assert fresh.xlen("s") == 0
+                finally:
+                    fresh.close()
+            finally:
+                b2.stop()
+        finally:
+            c.close()
+
+
+# ------------------------------------------------- fleet orphan detection
+
+def test_replica_supervisor_detects_and_reports_orphans(broker):
+    c = broker.client()
+    for i in range(4):
+        c.xadd(STREAM, "YQ==")
+    # "deadbeef" took four deliveries and then vanished — no heartbeat
+    c.xreadgroup(GROUP, "deadbeef", STREAM, 10)
+    reg = fleet.ReplicaRegistry("127.0.0.1", broker.port)
+    now = time.time()
+    reg.publish(fleet.ReplicaInfo(replica_id="live-1", started_at=now,
+                                  last_heartbeat=now))
+    fired = []
+    sup = fleet.ReplicaSupervisor(
+        reg, STREAM, group=GROUP, broker_port=broker.port,
+        own_replica_id="live-1", on_orphans=fired.append)
+    snap = sup.sweep()
+    assert snap["live"] == 1 and snap["replicas"] == ["live-1"]
+    assert snap["pending_per_replica"] == {"deadbeef": 4}
+    assert snap["orphan_entries"] == 4
+    assert fired == [4]
+    assert _counter("zoo_serving_orphan_entries",
+                    f"stream={STREAM}") == 4.0
+    # once a live consumer claims the leases, the next sweep is clean
+    assert len(c.xclaim(STREAM, GROUP, "live-1", 0, 10)) == 4
+    snap2 = sup.sweep()
+    assert snap2["orphan_entries"] == 0 and snap2["sweeps"] == 2
+    assert fired == [4]                      # callback fired only once
+    assert sup.snapshot() == snap2
+
+
+# ------------------------------------------- engine-level delivery contract
+
+class _Duck:
+    """Doubler whose first predict may stall — the 'slow replica' whose
+    lease expires mid-batch."""
+
+    def __init__(self, first_sleep_s=0.0):
+        self.first_sleep_s = first_sleep_s
+        self._calls = 0
+
+    def predict(self, x):
+        self._calls += 1
+        if self._calls == 1 and self.first_sleep_s:
+            time.sleep(self.first_sleep_s)
+        return np.asarray(x) * 2.0
+
+
+def test_slow_batch_redelivery_is_idempotent_and_single_sweep():
+    """Replica A takes one batch and stalls past its lease; replica B's
+    reclaim sweep must redeliver the WHOLE batch in exactly one sweep,
+    and A's late finish (duplicate result writes + double-acks) must be
+    harmless — every record answered, pending drained to zero."""
+    n = 4
+    redelivered0 = _counter("zoo_serving_redelivered_total",
+                            f"stream={STREAM}")
+    reclaims0 = _counter("zoo_serving_lease_reclaims_total",
+                         f"stream={STREAM}")
+    with Broker.launch(backend="python") as b:
+        in_q = InputQueue(port=b.port)
+        out_q = OutputQueue(port=b.port)
+        # backlog FIRST: A's initial read then takes the whole batch in
+        # one delivery, so its stalled lease covers all n records
+        uris = in_q.enqueue_batch(
+            (f"rd{i}", {"x": np.full(3, i, np.float32)})
+            for i in range(n))
+        eng_a = ClusterServing(_Duck(first_sleep_s=1.2), b.port,
+                               batch_size=n, max_batch_size=n,
+                               consumer="repA", claim_min_idle_ms=300,
+                               reclaim_interval_s=30.0)
+        eng_a.start()
+        try:
+            # wait until A holds the whole batch, THEN bring up B so the
+            # only way B gets work is through lease reclamation
+            c = b.client()
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if c.xpending_detail(STREAM, GROUP).get("repA") == n:
+                    break
+                time.sleep(0.02)
+            assert c.xpending_detail(STREAM, GROUP) == {"repA": n}
+            eng_b = ClusterServing(_Duck(), b.port, batch_size=n,
+                                   max_batch_size=n, consumer="repB",
+                                   claim_min_idle_ms=300,
+                                   reclaim_interval_s=0.1)
+            eng_b.start()
+            try:
+                res = out_q.query_many(uris, timeout=30.0)
+                assert all(v is not None for v in res.values())
+                for i in range(n):
+                    np.testing.assert_allclose(
+                        res[f"rd{i}"], np.full(3, 2.0 * i, np.float32))
+                # the batch was redelivered in ONE sweep
+                assert _counter("zoo_serving_redelivered_total",
+                                f"stream={STREAM}") == redelivered0 + n
+                assert _counter("zoo_serving_lease_reclaims_total",
+                                f"stream={STREAM}") == reclaims0 + 1
+                # A's late duplicate finish drains without residue
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline and \
+                        c.xpending(STREAM, GROUP):
+                    time.sleep(0.05)
+                assert c.xpending(STREAM, GROUP) == 0
+            finally:
+                eng_b.stop()
+        finally:
+            eng_a.stop()
+
+
+def test_graceful_stop_acks_all_deliveries_before_deregister():
+    """stop() ordering contract: the final drain flushes and acks every
+    in-flight delivery BEFORE the heartbeat record is removed, so a peer
+    supervisor can never classify drain work as orphaned."""
+    with Broker.launch(backend="python") as b:
+        eng = ClusterServing(_Duck(), b.port, batch_size=4,
+                             max_batch_size=4)
+        eng.start()
+        try:
+            in_q = InputQueue(port=b.port)
+            out_q = OutputQueue(port=b.port)
+            uris = in_q.enqueue_batch(
+                (f"gs{i}", {"x": np.full(3, i, np.float32)})
+                for i in range(12))
+            res = out_q.query_many(uris, timeout=30.0)
+            assert all(v is not None for v in res.values())
+        finally:
+            eng.stop()
+        c = b.client()
+        assert c.xpending(STREAM, GROUP) == 0
+        reg = fleet.ReplicaRegistry("127.0.0.1", b.port)
+        assert all(r.replica_id != eng.replica_id for r in reg.list())
+
+
+# --------------------------------------------------- SIGKILL chaos drill
+
+@pytest.mark.slow
+def test_two_replica_sigkill_chaos_drill():
+    """Acceptance (ISSUE 9): two subprocess replicas share one stream;
+    SIGKILL one mid-stream through the ``kill@replica`` fault seam. Zero
+    records lost, everything acked, the survivor's ``/healthz`` fleet
+    view drops to 1 live replica, redelivery lands in exactly one
+    lease-reclaim sweep. The victim's predict is wedged (long sleep) so
+    its whole in-flight window was delivered within a few ms — one sweep
+    reclaims it all, deterministically."""
+    n = 64
+    env = {"ZOO_SERVING_LEASE_MS": "300", "ZOO_SERVING_RECLAIM_S": "0.25",
+           "ZOO_FLEET_HEARTBEAT_S": "0.25", "ZOO_FLEET_STALE_S": "1.0"}
+
+    def snap_metric(port, family):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics?format=snapshot",
+                    timeout=2.0) as r:
+                snap = json.loads(r.read().decode())
+        except Exception:
+            return 0.0
+        fam = snap.get(family, {})
+        if not isinstance(fam, dict):
+            return float(fam or 0.0)
+        return float(fam.get(f"stream={STREAM}", 0.0))
+
+    rng = np.random.default_rng(5)
+    payloads = rng.standard_normal((n, 4)).astype(np.float32)
+    with resilience.fault_drill("kill@replica:1", cpu_fallback=False), \
+            Broker.launch(backend="python") as broker:
+        victim = resilience.ServingReplicaProc(
+            broker.port, batch_size=4, predict_sleep_ms=60_000.0,
+            env_extra=env)
+        survivor = resilience.ServingReplicaProc(
+            broker.port, batch_size=4, predict_sleep_ms=2.0,
+            env_extra=env)
+        try:
+            in_q = InputQueue(port=broker.port)
+            out_q = OutputQueue(port=broker.port)
+            uris = list(in_q.enqueue_batch(
+                (f"ch{i}", {"x": payloads[i]}) for i in range(n)))
+            # let the wedged victim fill its in-flight window, then the
+            # seam fires on the drill's first checkpoint
+            time.sleep(0.3)
+            assert resilience.maybe_kill_replica(victim)
+            assert not victim.alive
+            res = out_q.query_many(uris, timeout=60.0)
+            missing = [u for u, v in res.items() if v is None]
+            assert not missing, f"{len(missing)} records lost after kill"
+            # every delivery acked (late duplicate acks are no-ops)
+            c = broker.client()
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline and c.xpending(STREAM, GROUP):
+                time.sleep(0.1)
+            assert c.xpending(STREAM, GROUP) == 0
+            # redelivery is visible on the survivor, in exactly one sweep
+            assert snap_metric(survivor.http_port,
+                               "zoo_serving_redelivered_total") >= 1.0
+            assert snap_metric(survivor.http_port,
+                               "zoo_serving_lease_reclaims_total") == 1.0
+            # the fleet view converges to one live replica
+            deadline = time.monotonic() + 20.0
+            live = None
+            while time.monotonic() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{survivor.http_port}"
+                            "/healthz", timeout=2.0) as r:
+                        hz = json.loads(r.read().decode())
+                    live = hz.get("fleet", {}).get("replicas")
+                    if live == 1:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.25)
+            assert live == 1, f"fleet view never dropped to 1 live: {live}"
+        finally:
+            survivor.stop()
+            victim.stop()
