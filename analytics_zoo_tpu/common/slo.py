@@ -77,6 +77,15 @@ class SLO:
     metric: str
     threshold_s: Optional[float] = None        # latency only
     bad_metric: Optional[str] = None           # availability only
+    # restrict sampling to children whose labels match every (key, value)
+    # pair — e.g. (("priority", "interactive"),) watches one lane of
+    # zoo_serving_latency_seconds{stream,priority}. None sums all children
+    # (the pre-lane behavior).
+    labels: Optional[Tuple[Tuple[str, str], ...]] = None
+    # shed=False: the SLO's burn is published and drives lane admission
+    # control, but does NOT trip overloaded()/the /healthz 503 — a burning
+    # batch lane must throttle batch enqueues, not fail the whole replica
+    shed: bool = True
 
     def __post_init__(self):
         if self.kind not in ("latency", "availability"):
@@ -91,11 +100,17 @@ class SLO:
 
 def default_slos() -> List[SLO]:
     """The serving defaults: p99 end-to-end latency under
-    ``ZOO_SLO_P99_MS`` (default 1000 ms) and record availability at
-    ``ZOO_SLO_AVAILABILITY`` (default 0.999)."""
+    ``ZOO_SLO_P99_MS`` (default 1000 ms), record availability at
+    ``ZOO_SLO_AVAILABILITY`` (default 0.999), and one per-priority p99
+    latency SLO per lane. The per-lane SLOs are ``shed=False``: their
+    burn drives the engine's batch-lane admission control, not the
+    replica-wide 503. Per-lane thresholds: ``ZOO_SLO_P99_INTERACTIVE_MS``
+    and ``ZOO_SLO_P99_DEFAULT_MS`` default to the overall p99 budget;
+    ``ZOO_SLO_P99_BATCH_MS`` defaults to 5x it (batch work tolerates
+    queueing by design)."""
     p99_ms = float(os.environ.get("ZOO_SLO_P99_MS", "1000"))
     avail = float(os.environ.get("ZOO_SLO_AVAILABILITY", "0.999"))
-    return [
+    out = [
         SLO(name="serving_p99_latency", kind="latency", objective=0.99,
             metric="zoo_serving_latency_seconds",
             threshold_s=p99_ms / 1000.0),
@@ -103,17 +118,44 @@ def default_slos() -> List[SLO]:
             objective=avail, metric="zoo_serving_records_total",
             bad_metric="zoo_serving_record_errors_total"),
     ]
+    lane_env = {
+        "interactive": ("ZOO_SLO_P99_INTERACTIVE_MS", p99_ms),
+        "default": ("ZOO_SLO_P99_DEFAULT_MS", p99_ms),
+        "batch": ("ZOO_SLO_P99_BATCH_MS", 5.0 * p99_ms),
+    }
+    for lane, (env_name, fallback) in lane_env.items():
+        th_ms = float(os.environ.get(env_name, str(fallback)))
+        out.append(SLO(
+            name=f"serving_p99_latency_{lane}", kind="latency",
+            objective=0.99, metric="zoo_serving_latency_seconds",
+            threshold_s=th_ms / 1000.0,
+            labels=(("priority", lane),), shed=False))
+    return out
 
 
-def _entries(fam: Any) -> List[Dict[str, Any]]:
-    """Histogram entries of a snapshot family (labelled or collapsed)."""
+def _entries(fam: Any,
+             labels: Optional[Tuple[Tuple[str, str], ...]] = None
+             ) -> List[Dict[str, Any]]:
+    """Histogram entries of a snapshot family (labelled or collapsed).
+    With ``labels``, only children whose snapshot key carries every
+    (key, value) pair are kept — an unlabeled family cannot match a
+    label filter and yields nothing."""
     if fam is None:
         return []
     if isinstance(fam, dict) and "count" in fam and "le" in fam:
-        return [fam]
+        return [] if labels else [fam]
     if isinstance(fam, dict):
-        return [v for v in fam.values()
-                if isinstance(v, dict) and "count" in v and "le" in v]
+        out = []
+        for key, v in fam.items():
+            if not (isinstance(v, dict) and "count" in v and "le" in v):
+                continue
+            if labels:
+                names, values = telemetry._parse_label_key(key)
+                kv = dict(zip(names, values))
+                if any(kv.get(k) != want for k, want in labels):
+                    continue
+            out.append(v)
+        return out
     return []
 
 
@@ -134,7 +176,7 @@ def _sample_slo(slo: SLO, snap: Dict[str, Any]) -> Dict[str, Any]:
         le: List[float] = []
         counts: List[int] = []
         total = 0
-        for e in _entries(snap.get(slo.metric)):
+        for e in _entries(snap.get(slo.metric), slo.labels):
             if not le:
                 le = list(e["le"])
                 counts = [0] * len(e["bucket_counts"])
@@ -209,6 +251,11 @@ class SLOMonitor:
             os.environ.get("ZOO_SLO_TICK_S", "1.0")
             if tick_s is None else tick_s)
         self._lock = threading.Lock()
+        # only these SLOs may trip overloaded(): per-lane SLOs declare
+        # shed=False so a burning batch lane throttles its own admissions
+        # without 503-ing the replica
+        self._shed_names = frozenset(
+            s.name for s in self.slos if getattr(s, "shed", True))
         retain = int(max(self.windows) / max(self.tick_s, 1e-3)) + 8
         self._samples: "deque[Tuple[float, Dict[str, Dict]]]" = deque(
             maxlen=min(retain, 4096))
@@ -284,17 +331,29 @@ class SLOMonitor:
                     for name, per in self._burns.items()}
 
     def _overloaded_locked(self) -> bool:
-        for per_win in self._burns.values():
+        for name, per_win in self._burns.items():
+            if name not in self._shed_names:
+                continue
             if per_win and all(wb.burn > self.shed_burn
                                for wb in per_win.values()):
                 return True
         return False
 
     def overloaded(self) -> bool:
-        """Shed? True when, for some SLO, EVERY window burns past
-        ``shed_burn`` — the multi-window guard against flapping."""
+        """Shed? True when, for some shed-eligible SLO, EVERY window
+        burns past ``shed_burn`` — the multi-window guard against
+        flapping."""
         with self._lock:
             return self._overloaded_locked()
+
+    def burning(self, name: str) -> bool:
+        """Is the NAMED SLO past ``shed_burn`` on every window? The
+        per-lane admission-control trigger (works for shed=False SLOs —
+        that is their whole point); unknown names read False."""
+        with self._lock:
+            per_win = self._burns.get(name)
+            return bool(per_win) and all(wb.burn > self.shed_burn
+                                         for wb in per_win.values())
 
     def report(self) -> Dict[str, Any]:
         """The ``GET /slo`` payload."""
@@ -307,6 +366,8 @@ class SLOMonitor:
                     "objective": slo.objective,
                     "threshold_s": slo.threshold_s,
                     "metric": slo.metric,
+                    "labels": dict(slo.labels) if slo.labels else None,
+                    "shed": slo.shed,
                     "windows": {
                         w: {"burn": round(wb.burn, 6),
                             "bad_fraction": round(wb.bad_fraction, 6),

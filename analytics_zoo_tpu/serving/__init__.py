@@ -1,8 +1,11 @@
-from analytics_zoo_tpu.serving.broker import Broker, BrokerClient
+from analytics_zoo_tpu.serving.broker import Broker, BrokerClient, ShedError
 from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
 from analytics_zoo_tpu.serving.config import ServingConfig
 from analytics_zoo_tpu.serving.engine import ClusterServing, image_pipeline
 from analytics_zoo_tpu.serving.frontend import FrontEnd
+from analytics_zoo_tpu.serving.schema import (DeadlineExpiredError,
+                                              ServingError)
 
 __all__ = ["Broker", "BrokerClient", "InputQueue", "OutputQueue",
-           "ServingConfig", "ClusterServing", "FrontEnd", "image_pipeline"]
+           "ServingConfig", "ClusterServing", "FrontEnd", "image_pipeline",
+           "ShedError", "ServingError", "DeadlineExpiredError"]

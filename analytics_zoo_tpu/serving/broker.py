@@ -8,7 +8,10 @@ pure-Python broker with the identical wire protocol backs environments
 without a toolchain (and doubles as the protocol's executable spec).
 
 Protocol: newline-delimited text; payloads are opaque base64 (see
-zbroker.cpp header for the command set).
+zbroker.cpp header for the command set). Entries carry a *lane* tag
+(priority class) so the engine can dequeue interactive traffic ahead of
+batch work, and per-lane XSHED flags let admission control reject new
+enqueues at the broker instead of letting them rot in the queue.
 """
 
 from __future__ import annotations
@@ -25,6 +28,16 @@ from typing import Dict, List, Optional, Tuple
 
 _NATIVE_SRC = os.path.join(os.path.dirname(__file__), "native", "zbroker.cpp")
 _BUILD_DIR = os.path.join(os.path.dirname(__file__), "native", "build")
+
+# lane of entries enqueued without an explicit priority — mirrors
+# schema.DEFAULT_PRIORITY (broker must stay importable standalone)
+DEFAULT_LANE = "default"
+
+
+class ShedError(RuntimeError):
+    """XADD rejected because the target lane is shedding (admission
+    control). Typed so enqueueing clients fail fast instead of burning
+    their poll timeout waiting for a result that will never exist."""
 
 
 def build_native_broker(force: bool = False) -> Optional[str]:
@@ -69,7 +82,7 @@ class BrokerClient:
     # failure could duplicate a record or clobber a newer write.
     _IDEMPOTENT = frozenset({
         "PING", "XLEN", "XREADGROUP", "XCLAIM", "XPENDING", "XACK",
-        "HGET", "HKEYS",
+        "HGET", "HKEYS", "XSHED",  # XSHED writes an absolute flag value
     })
     RECONNECT_TRIES = 3
     RECONNECT_BACKOFF_S = 0.05
@@ -111,7 +124,12 @@ class BrokerClient:
         if kind == "*":
             return [self._readline() for _ in range(int(rest))]
         if kind == "-":
-            err = RuntimeError(f"broker error: {rest}")
+            # -SHED is a typed refusal (lane admission control), not a
+            # protocol failure — callers catch ShedError specifically
+            if rest.startswith("SHED"):
+                err: RuntimeError = ShedError(rest)
+            else:
+                err = RuntimeError(f"broker error: {rest}")
             if raise_on_error:
                 raise err
             return err
@@ -196,42 +214,82 @@ class BrokerClient:
     def ping(self) -> bool:
         return self._cmd("PING") == "PONG"
 
-    def xadd(self, stream: str, payload_b64: str) -> int:
-        return int(self._cmd("XADD", stream, payload_b64))
+    def xadd(self, stream: str, payload_b64: str,
+             lane: Optional[str] = None) -> int:
+        """Append to the stream, tagged with ``lane`` (priority class).
+        Raises ShedError when the lane's shed flag is set (XSHED)."""
+        if lane is None:
+            return int(self._cmd("XADD", stream, payload_b64))
+        return int(self._cmd("XADD", stream, payload_b64, lane))
 
-    def xlen(self, stream: str) -> int:
-        return self._cmd("XLEN", stream)
+    def xlen(self, stream: str, lane: Optional[str] = None) -> int:
+        if lane is None:
+            return self._cmd("XLEN", stream)
+        return self._cmd("XLEN", stream, lane)
 
     def xreadgroup(self, group: str, consumer: str, stream: str,
-                   count: int, block_ms: int = 0) -> List[Tuple[int, str]]:
+                   count: int, block_ms: int = 0,
+                   lanes: Optional[str] = None) -> List[tuple]:
+        """Read up to ``count`` new entries for the group. With ``lanes``
+        (comma-separated priority order, e.g. "interactive,default,batch")
+        delivery drains lanes in that order and each result is an
+        ``(id, lane, payload)`` 3-tuple; the legacy laneless form returns
+        ``(id, payload)`` and delivers all lanes in id order."""
         old = self.sock.gettimeout()
         if block_ms:
             self.sock.settimeout(max(old or 0, block_ms / 1000.0 + 10))
         try:
-            lines = self._cmd("XREADGROUP", group, consumer, stream,
-                              str(count), str(block_ms))
+            parts = ["XREADGROUP", group, consumer, stream,
+                     str(count), str(block_ms)]
+            if lanes:
+                parts.append(lanes)
+            lines = self._cmd(*parts)
         finally:
             self.sock.settimeout(old)
-        out = []
+        out: List[tuple] = []
         for ln in lines:
-            i, payload = ln.split(" ", 1)
-            out.append((int(i), payload))
+            if lanes:
+                i, lane, payload = ln.split(" ", 2)
+                out.append((int(i), lane, payload))
+            else:
+                i, payload = ln.split(" ", 1)
+                out.append((int(i), payload))
         return out
 
     def xclaim(self, stream: str, group: str, consumer: str,
-               min_idle_ms: int, count: int) -> List[Tuple[int, str]]:
+               min_idle_ms: int, count: int,
+               lanes: Optional[str] = None) -> List[tuple]:
         """Re-deliver pending entries idle >= min_idle_ms that belong to
         OTHER consumers, transferring ownership to ``consumer`` (dead-
         consumer recovery; Redis XAUTOCLAIM analog). A consumer's own
         in-flight entries are never handed back to it — idle time is a
-        lease, and you cannot steal your own lease."""
-        lines = self._cmd("XCLAIM", stream, group, consumer,
-                          str(min_idle_ms), str(count))
-        out = []
+        lease, and you cannot steal your own lease. With ``lanes`` the
+        claim drains lanes in the given order (a dead replica's
+        interactive entries come back before its batch backlog) and each
+        result is ``(id, lane, payload)``."""
+        parts = ["XCLAIM", stream, group, consumer,
+                 str(min_idle_ms), str(count)]
+        if lanes:
+            parts.append(lanes)
+        lines = self._cmd(*parts)
+        out: List[tuple] = []
         for ln in lines:
-            i, payload = ln.split(" ", 1)
-            out.append((int(i), payload))
+            if lanes:
+                i, lane, payload = ln.split(" ", 2)
+                out.append((int(i), lane, payload))
+            else:
+                i, payload = ln.split(" ", 1)
+                out.append((int(i), payload))
         return out
+
+    def xshed_set(self, stream: str, lane: str, shedding: bool) -> str:
+        """Set/clear the shed flag on one lane: while set, XADDs to that
+        lane are rejected with -SHED (absolute write — safe to repeat)."""
+        return self._cmd("XSHED", stream, lane, "1" if shedding else "0")
+
+    def xshed(self, stream: str) -> List[str]:
+        """Names of lanes currently shedding on this stream."""
+        return self._cmd("XSHED", stream)
 
     def xack(self, stream: str, group: str, entry_id: int) -> int:
         return self._cmd("XACK", stream, group, str(entry_id))
@@ -283,6 +341,9 @@ class _PyState:
         self.lock = threading.Lock()
         self.cv = threading.Condition(self.lock)
         self.streams: Dict[str, dict] = {}
+        # stream -> set of lane names whose XADDs are being rejected
+        # (admission control; set by the engine via XSHED)
+        self.shed: Dict[str, set] = {}
         self.hashes: Dict[str, Dict[str, str]] = {}
         # last-write ms per hash field — uncollected results expire so the
         # broker's memory stays bounded (native zbroker.cpp does the same;
@@ -357,14 +418,19 @@ class _PyState:
                 self.evict_expired(key)
 
     def stream(self, name):
+        # entries: (id, payload, lane) — one id space across lanes so
+        # lease/ack/GC semantics stay unified while delivery partitions
         return self.streams.setdefault(
             name, {"entries": [], "next_id": 1, "groups": {}})
 
     def group(self, st, name):
         # pending: entry id -> [owner consumer, last delivery ms, delivery
-        # count]. The owner+timestamp pair is the delivery lease XCLAIM
-        # arbitrates on; the count makes redelivery observable.
-        return st["groups"].setdefault(name, {"cursor": 0, "pending": {}})
+        # count, lane]. The owner+timestamp pair is the delivery lease
+        # XCLAIM arbitrates on; the count makes redelivery observable; the
+        # lane lets XCLAIM hand back high-priority entries first.
+        # cursor: lane -> last-delivered id (per-lane so draining one lane
+        # never marks another lane's entries as seen).
+        return st["groups"].setdefault(name, {"cursor": {}, "pending": {}})
 
 
 class _PyHandler(socketserver.StreamRequestHandler):
@@ -388,34 +454,56 @@ class _PyHandler(socketserver.StreamRequestHandler):
                                  daemon=True).start()
                 return
             elif cmd == "XADD" and len(p) >= 3:
+                lane = p[3] if len(p) >= 4 else DEFAULT_LANE
+                shed = False
                 with state.cv:
-                    st = state.stream(p[1])
-                    eid = st["next_id"]
-                    st["next_id"] += 1
-                    st["entries"].append((eid, p[2]))
-                    state.cv.notify_all()
-                w.write(f"+{eid}\n".encode())
+                    if lane in state.shed.get(p[1], ()):
+                        shed = True
+                    else:
+                        st = state.stream(p[1])
+                        eid = st["next_id"]
+                        st["next_id"] += 1
+                        st["entries"].append((eid, p[2], lane))
+                        state.cv.notify_all()
+                if shed:
+                    w.write(f"-SHED lane {lane} is shedding\n".encode())
+                else:
+                    w.write(f"+{eid}\n".encode())
             elif cmd == "XLEN" and len(p) >= 2:
                 with state.lock:
-                    n = len(state.stream(p[1])["entries"])
+                    entries = state.stream(p[1])["entries"]
+                    if len(p) >= 3:
+                        n = sum(1 for e in entries if e[2] == p[2])
+                    else:
+                        n = len(entries)
                 w.write(f":{n}\n".encode())
             elif cmd == "XREADGROUP" and len(p) >= 6:
                 group, consumer, stream = p[1], p[2], p[3]
                 count, block_ms = int(p[4]), int(p[5])
+                # optional lanes arg: comma-separated delivery order —
+                # all undelivered entries of lanes[0] go first, then
+                # lanes[1], ... The laneless form delivers every lane in
+                # id order (legacy parity).
+                lanes = p[6].split(",") if len(p) >= 7 and p[6] else None
 
                 def deliver():
                     st = state.stream(stream)
                     gr = state.group(st, group)
+                    cur = gr["cursor"]
                     got = []
                     now_ms = int(time.monotonic() * 1000)
-                    for eid, payload in st["entries"]:
-                        if eid <= gr["cursor"]:
-                            continue
-                        got.append((eid, payload))
-                        gr["cursor"] = eid
-                        gr["pending"][eid] = [consumer, now_ms, 1]
-                        if len(got) >= count:
-                            break
+                    for want in (lanes if lanes is not None else [None]):
+                        for eid, payload, elane in st["entries"]:
+                            if want is not None and elane != want:
+                                continue
+                            if eid <= cur.get(elane, 0):
+                                continue
+                            got.append((eid, elane, payload))
+                            cur[elane] = eid
+                            gr["pending"][eid] = [consumer, now_ms, 1,
+                                                  elane]
+                            if len(got) >= count:
+                                return got
                     return got
                 with state.cv:
                     got = deliver()
@@ -428,7 +516,12 @@ class _PyHandler(socketserver.StreamRequestHandler):
                             state.cv.wait(left)
                             got = deliver()
                 out = [f"*{len(got)}\n"]
-                out += [f"{eid} {payload}\n" for eid, payload in got]
+                if lanes is not None:
+                    out += [f"{eid} {elane} {payload}\n"
+                            for eid, elane, payload in got]
+                else:
+                    out += [f"{eid} {payload}\n"
+                            for eid, _, payload in got]
                 w.write("".join(out).encode())
             elif cmd == "XACK" and len(p) >= 4:
                 with state.lock:
@@ -437,17 +530,19 @@ class _PyHandler(socketserver.StreamRequestHandler):
                     n = 1 if gr["pending"].pop(int(p[3]), None) is not None \
                         else 0
                     # GC entries delivered+acked by every group (see
-                    # zbroker.cpp XACK)
+                    # zbroker.cpp XACK). Cursors are per-lane, so an
+                    # entry is collectible only when every group has
+                    # passed it ON ITS LANE and nobody holds it pending;
+                    # prefix-drop stops at the first keeper.
                     if st["groups"]:
-                        low = st["next_id"]
-                        for g in st["groups"].values():
-                            bound = g["cursor"]
-                            if g["pending"]:
-                                bound = min(bound, min(g["pending"]) - 1)
-                            low = min(low, bound)
                         drop = 0
                         entries = st["entries"]
-                        while drop < len(entries) and entries[drop][0] <= low:
+                        while drop < len(entries):
+                            eid, _, lane = entries[drop]
+                            if any(g["cursor"].get(lane, 0) < eid
+                                   or eid in g["pending"]
+                                   for g in st["groups"].values()):
+                                break
                             drop += 1
                         if drop:
                             st["entries"] = entries[drop:]
@@ -459,26 +554,44 @@ class _PyHandler(socketserver.StreamRequestHandler):
                 # recovery path for entries a dead consumer never acked —
                 # Redis XAUTOCLAIM analog). Claiming transfers ownership,
                 # refreshes the lease clock and bumps the delivery count.
+                # Optional trailing lanes arg: claim in that lane order
+                # (a dead replica's interactive leases are recovered
+                # before its batch backlog), replying with the lane field.
                 claimer = p[3]
                 min_idle, cnt = int(p[4]), int(p[5])
+                lanes = p[6].split(",") if len(p) >= 7 and p[6] else None
                 with state.lock:
                     st = state.stream(p[1])
                     gr = state.group(st, p[2])
                     now_ms = int(time.monotonic() * 1000)
-                    ids = sorted(
-                        eid for eid, (owner, ts, _) in gr["pending"].items()
-                        if owner != claimer and now_ms - ts >= min_idle
-                    )[:cnt]
-                    payloads = dict(st["entries"])
+                    eligible = sorted(
+                        eid for eid, rec in gr["pending"].items()
+                        if rec[0] != claimer and now_ms - rec[1] >= min_idle)
+                    payloads = {eid: payload
+                                for eid, payload, _ in st["entries"]}
                     got = []
-                    for eid in ids:
-                        if eid in payloads:
+                    for want in (lanes if lanes is not None else [None]):
+                        for eid in eligible:
+                            if len(got) >= cnt:
+                                break
                             rec = gr["pending"][eid]
-                            gr["pending"][eid] = [claimer, now_ms,
-                                                  rec[2] + 1]
-                            got.append((eid, payloads[eid]))
+                            if rec[0] == claimer:
+                                continue  # claimed earlier this sweep
+                            elane = rec[3]
+                            if want is not None and elane != want:
+                                continue
+                            if eid in payloads:
+                                gr["pending"][eid] = [claimer, now_ms,
+                                                      rec[2] + 1, elane]
+                                got.append((eid, elane, payloads[eid]))
+                        if len(got) >= cnt:
+                            break
                 out = [f"*{len(got)}\n"]
-                out += [f"{eid} {payload}\n" for eid, payload in got]
+                if lanes is not None:
+                    out += [f"{eid} {elane} {payload}\n"
+                            for eid, elane, payload in got]
+                else:
+                    out += [f"{eid} {payload}\n" for eid, _, payload in got]
                 w.write("".join(out).encode())
             elif cmd == "XPENDING" and len(p) >= 4:
                 # XPENDING <stream> <group> DETAIL: per-consumer breakdown
@@ -487,8 +600,8 @@ class _PyHandler(socketserver.StreamRequestHandler):
                 with state.lock:
                     gr = state.group(state.stream(p[1]), p[2])
                     per: Dict[str, int] = {}
-                    for owner, _, _ in gr["pending"].values():
-                        per[owner] = per.get(owner, 0) + 1
+                    for rec in gr["pending"].values():
+                        per[rec[0]] = per.get(rec[0], 0) + 1
                 out = [f"*{len(per)}\n"]
                 out += [f"{c} {n}\n" for c, n in sorted(per.items())]
                 w.write("".join(out).encode())
@@ -497,6 +610,22 @@ class _PyHandler(socketserver.StreamRequestHandler):
                     gr = state.group(state.stream(p[1]), p[2])
                     n = len(gr["pending"])
                 w.write(f":{n}\n".encode())
+            elif cmd == "XSHED" and len(p) >= 4:
+                # XSHED <stream> <lane> <0|1>: set/clear a lane's shed
+                # flag (admission control valve, written by the engine)
+                with state.lock:
+                    lanes_shed = state.shed.setdefault(p[1], set())
+                    if p[3] == "0":
+                        lanes_shed.discard(p[2])
+                    else:
+                        lanes_shed.add(p[2])
+                w.write(b"+OK\n")
+            elif cmd == "XSHED" and len(p) >= 2:
+                # XSHED <stream>: query — multi-line list of shedding lanes
+                with state.lock:
+                    names = sorted(state.shed.get(p[1], ()))
+                w.write(("".join([f"*{len(names)}\n"] +
+                                 [ln + "\n" for ln in names])).encode())
             elif cmd == "HSET" and len(p) >= 4:
                 with state.cv:
                     # bounded amortized cleanup (full scan would be O(live
@@ -536,6 +665,7 @@ class _PyHandler(socketserver.StreamRequestHandler):
             elif cmd == "DEL" and len(p) >= 2:
                 with state.lock:
                     state.streams.pop(p[1], None)
+                    state.shed.pop(p[1], None)
                     state.hashes.pop(p[1], None)
                     state.hash_times.pop(p[1], None)
                 w.write(b"+OK\n")

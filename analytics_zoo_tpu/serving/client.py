@@ -16,11 +16,14 @@ from typing import Dict, Optional
 import numpy as np
 
 from analytics_zoo_tpu.common import telemetry
-from analytics_zoo_tpu.serving.broker import BrokerClient
+from analytics_zoo_tpu.serving.broker import BrokerClient, ShedError
 from analytics_zoo_tpu.serving import schema
 
 INPUT_STREAM = "serving_stream"
 RESULT_HASH = "result"
+
+__all__ = ["InputQueue", "OutputQueue", "ShedError",
+           "INPUT_STREAM", "RESULT_HASH"]
 
 
 class InputQueue:
@@ -51,19 +54,36 @@ class InputQueue:
             return schema.ImageBytes(bytes(v))
         return np.asarray(v)
 
-    def _encode(self, uri: Optional[str], inputs: Dict
-                ) -> "tuple[str, str, Optional[tuple]]":
-        """(uri, payload, trace) — ``trace`` is ``(t_enc_pc, sampled)``
-        for natively-encoded records (the stamp the engine's queue-wait
-        accounting reads), None for Arrow records (the reference wire
-        format has no side channel)."""
+    def _shed_counter(self, priority: str):
+        """Client-observed shed rejections: an XADD the broker refused
+        never reaches the engine, so the client is the only process that
+        can count it (the zero-silent-drops ledger needs every terminal
+        outcome on a counter)."""
+        return telemetry.get_registry().counter(
+            "zoo_serving_shed_total",
+            "enqueues rejected by lane admission control",
+            ("stream", "priority")).labels(self.stream, priority)
+
+    def _encode(self, uri: Optional[str], inputs: Dict,
+                priority: Optional[str] = None,
+                deadline_ms: Optional[float] = None
+                ) -> "tuple[str, str, Optional[tuple], str]":
+        """(uri, payload, trace, lane) — ``trace`` is ``(t_enc_pc,
+        sampled)`` for natively-encoded records (the stamp the engine's
+        queue-wait accounting reads), None for Arrow records (the
+        reference wire format has no side channel, so Arrow records get
+        lane routing but no deadline). ``lane`` is the validated priority
+        the broker partitions delivery on."""
         if not inputs:
             raise ValueError("enqueue needs at least one named tensor")
+        lane = schema.validate_priority(priority)
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
         uri = schema.validate_uri(uri or uuid.uuid4().hex)
         coerced = {k: self._coerce(v) for k, v in inputs.items()}
         if self.arrow:
             return uri, schema.encode_record_arrow(
-                uri, coerced, self.cipher), None
+                uri, coerced, self.cipher), None, lane
         # dual-clock stamp: perf_counter is CLOCK_MONOTONIC on Linux
         # (comparable across processes on ONE host — the engine checks
         # plausibility before trusting it); t_wall is the cross-host
@@ -73,18 +93,38 @@ class InputQueue:
         trace = {"id": uri, "t_pc": t_pc,
                  "t_wall": time.time(),  # zoolint: disable=wallclock-hotpath
                  "s": int(sampled)}
+        if lane != schema.DEFAULT_PRIORITY:
+            trace["p"] = lane
+        if deadline_ms is not None:
+            trace["d"] = float(deadline_ms)
         payload = schema.encode_record(uri, coerced, self.cipher,
                                        trace=trace)
-        return uri, payload, (t_pc, sampled)
+        return uri, payload, (t_pc, sampled), lane
 
-    def enqueue(self, uri: Optional[str] = None, **inputs) -> str:
+    def enqueue(self, uri: Optional[str] = None,
+                priority: Optional[str] = None,
+                deadline_ms: Optional[float] = None, **inputs) -> str:
         """``enqueue("img1", x=ndarray)``; returns the uri (generated when
         not given). Multi-input models pass several named tensors.
         ``enqueue("img1", image=jpeg_bytes)`` sends the raw encoded image
         for engine-side decode + preprocessing (``enqueue_image`` for
-        file paths)."""
-        uri, payload, trace = self._encode(uri, inputs)
-        self._client.xadd(self.stream, payload)
+        file paths).
+
+        ``priority`` routes the record onto a broker lane
+        (``schema.PRIORITIES``; default "default") and ``deadline_ms``
+        bounds how stale a result is still useful — the engine stores an
+        explicit expired error once it lapses. The names ``priority`` and
+        ``deadline_ms`` are therefore reserved and cannot name input
+        tensors. Raises :class:`ShedError` immediately when admission
+        control is shedding the lane — a fast-fail instead of a poll
+        timeout."""
+        uri, payload, trace, lane = self._encode(uri, inputs, priority,
+                                                 deadline_ms)
+        try:
+            self._client.xadd(self.stream, payload, lane=lane)
+        except ShedError:
+            self._shed_counter(lane).inc()
+            raise
         if trace is not None and trace[1]:
             # encode + broker write, on the record's own trace id — the
             # timeline head GET /trace?uri= shows before queue_wait
@@ -109,19 +149,30 @@ class InputQueue:
                                                       schema.ImageBytes)
                                     else image})
 
-    def enqueue_batch(self, records) -> "list[str]":
+    def enqueue_batch(self, records, priority: Optional[str] = None,
+                      deadline_ms: Optional[float] = None) -> "list[str]":
         """Enqueue many records in pipelined socket writes — the high-
         throughput path (the reference client achieves the same with a
         redis-py pipeline of XADDs). ``records`` is an iterable of
         ``(uri, {name: tensor, ...})`` pairs; pass ``None`` as a uri to
-        have one generated. Returns the uris in order."""
+        have one generated. Returns the uris in order. ``priority`` /
+        ``deadline_ms`` apply to every record in the batch; a shedding
+        lane raises :class:`ShedError` (some earlier records of the batch
+        may have been accepted — uris are returned only on full
+        success)."""
         uris, cmds, traces = [], [], []
+        lane = schema.validate_priority(priority)
         for uri, inputs in records:
-            uri, payload, trace = self._encode(uri, inputs)
+            uri, payload, trace, _ = self._encode(uri, inputs, priority,
+                                                  deadline_ms)
             uris.append(uri)
             traces.append(trace)
-            cmds.append(("XADD", self.stream, payload))
-        self._client.pipeline(cmds)
+            cmds.append(("XADD", self.stream, payload, lane))
+        try:
+            self._client.pipeline(cmds)
+        except ShedError:
+            self._shed_counter(lane).inc()
+            raise
         t1 = time.perf_counter()
         for uri, trace in zip(uris, traces):
             if trace is not None and trace[1]:

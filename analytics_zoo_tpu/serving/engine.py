@@ -36,7 +36,7 @@ import numpy as np
 # StageTimer moved to the shared pipeline layer; re-exported here because
 # the engine is its historical home.
 from analytics_zoo_tpu.common import compile_ahead, fleet, resilience, \
-    telemetry
+    slo, telemetry
 from analytics_zoo_tpu.common.pipeline_io import (  # noqa: F401
     Completed,
     DevicePipeline,
@@ -47,6 +47,27 @@ from analytics_zoo_tpu.serving.broker import Broker, BrokerClient
 from analytics_zoo_tpu.serving.client import INPUT_STREAM, RESULT_HASH
 
 logger = logging.getLogger(__name__)
+
+
+def _parse_lane_map(raw: str, defaults: Dict[str, float]) -> Dict[str, float]:
+    """Per-lane float knob: ``"40"`` applies to every lane,
+    ``"interactive=5,batch=250"`` sets named lanes (unnamed lanes keep
+    their default). Malformed parts raise — a silently-ignored scheduling
+    knob is worse than a crash at construction."""
+    out = dict(defaults)
+    raw = (raw or "").strip()
+    if not raw:
+        return out
+    if "=" not in raw:
+        v = float(raw)
+        return {k: v for k in out}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        out[k.strip()] = float(v)
+    return out
 
 
 def ndarray_chain(pipe):
@@ -114,6 +135,19 @@ class ClusterServing:
     periodic reclaim sweep (env ``ZOO_SERVING_RECLAIM_S``) claims peers'
     expired leases so a crashed replica's entries are re-served with zero
     loss (docs/observability.md "Multi-replica deployment").
+
+    SLO-aware scheduling: records carry a priority lane
+    (``schema.PRIORITIES``) and an optional ``deadline_ms``. Reads are
+    lane-ordered by a weighted-deficit schedule
+    (``ZOO_SERVING_LANE_WEIGHTS``) with starvation protection; a
+    partially-filled batch bucket accumulates up to
+    ``ZOO_SERVING_MAX_WAIT_MS`` per lane before dispatching (continuous
+    batching; default 0 keeps the legacy dispatch-every-read behavior);
+    deadline-lapsed records get an explicit typed expired result; and an
+    admission-control tick (``ZOO_SERVING_ADMISSION_S``) sheds NEW
+    batch-lane enqueues at the broker while per-lane p99 burn says the
+    path is saturated (docs/observability.md "Priority lanes & admission
+    control").
     """
 
     #: consecutive full dequeues that count as "sustained backlog"
@@ -126,6 +160,13 @@ class ClusterServing:
     RECLAIM_BATCH = 256
     #: finished-entry-id ring size for the redelivery dedupe
     DEDUPE_WINDOW = 65536
+    #: safety margin subtracted from a record's deadline when computing
+    #: the partial-bucket dispatch trigger — dispatch BEFORE the deadline,
+    #: not at it
+    SLACK_MARGIN_S = 0.005
+    #: the lane admission control sheds when per-lane SLO burn says the
+    #: serving path is saturated; interactive/default always keep flowing
+    ADMISSION_LANE = "batch"
 
     def __init__(self, model, broker_port: int, batch_size: int = 8,
                  stream: str = INPUT_STREAM, result_key: str = RESULT_HASH,
@@ -179,6 +220,37 @@ class ClusterServing:
         self.postprocess = postprocess
         self.image_preprocess = image_preprocess
         self.block_ms = block_ms
+        # --- SLO-aware scheduling (priority lanes, continuous batching) —
+        # ZOO_SERVING_MAX_WAIT_MS: how long a partially-filled batch
+        # bucket may accumulate before it dispatches anyway, per lane
+        # ("40" for all lanes, "interactive=5,batch=250" per-lane; default
+        # 0 = dispatch every read immediately, the legacy behavior).
+        self.max_wait_ms = _parse_lane_map(
+            os.environ.get("ZOO_SERVING_MAX_WAIT_MS", ""),
+            {lane: 0.0 for lane in schema.PRIORITIES})
+        # ZOO_SERVING_LANE_WEIGHTS: weighted-deficit shares per lane —
+        # the lane with the lowest served-records/weight ratio reads
+        # first, so batch work always drains (starvation protection)
+        # while interactive gets the biggest share under contention
+        self.lane_weights = _parse_lane_map(
+            os.environ.get("ZOO_SERVING_LANE_WEIGHTS", ""),
+            {"interactive": 4.0, "default": 2.0, "batch": 1.0})
+        self._lane_credit: Dict[str, float] = {
+            lane: 0.0 for lane in schema.PRIORITIES}
+        self._lanes_priority = ",".join(schema.PRIORITIES)
+        # the assembly bucket: decoded records waiting to fill a batch —
+        # (entry_id, uri, inputs, queue_meta, lane, t_arrive, t_deadline)
+        self._asm: List[tuple] = []
+        # ZOO_SERVING_ADMISSION_S: cadence of the admission-control tick
+        # (SLO burn check + broker XSHED flip + lane depth gauges);
+        # 0 disables admission control entirely
+        raw = os.environ.get("ZOO_SERVING_ADMISSION_S", "").strip()
+        self._admission_interval_s = float(raw) if raw else 1.0
+        self._last_admission = 0.0
+        # mirrors for /healthz and tests (read cross-thread under lock)
+        self.admission_shedding = False
+        self._admission_dirty = False
+        self.records_expired = 0
         # the delivery lease: entries idle past this are claimable by any
         # OTHER consumer (at-least-once redelivery after a replica crash)
         if claim_min_idle_ms is None:
@@ -199,7 +271,8 @@ class ClusterServing:
         # one reclaim sweep claims every expired lease in a single XCLAIM
         # (up to RECLAIM_BATCH); beyond-batch entries queue here and feed
         # subsequent dispatches, so "sweeps fired" stays 1 per crash
-        self._claim_backlog: Deque[Tuple[int, str]] = collections.deque()
+        self._claim_backlog: Deque[Tuple[int, str, str]] = \
+            collections.deque()
         # entry-id dedupe ring: ids in flight or already finished by THIS
         # consumer are dropped on re-arrival, making result writes
         # idempotent under at-least-once redelivery. Serve-thread only.
@@ -241,10 +314,33 @@ class ClusterServing:
             "zoo_queue_wait_seconds",
             "Broker queue wait: client enqueue to engine dequeue",
             ("stream",)).labels(stream)
-        self._latency_hist = reg.histogram(
+        # per-PRIORITY end-to-end latency: the per-lane SLOs in
+        # common/slo.py filter on the priority label, and the admission
+        # tick sheds the batch lane off these very histograms
+        lat = reg.histogram(
             "zoo_serving_latency_seconds",
             "End-to-end record latency: client enqueue to result flush",
-            ("stream",)).labels(stream)
+            ("stream", "priority"))
+        self._latency_hist = {lane: lat.labels(stream, lane)
+                              for lane in schema.PRIORITIES}
+        # zero-silent-drops ledger, expired leg (shed is counted client-
+        # side in InputQueue — a refused XADD never reaches the engine)
+        exp = reg.counter(
+            "zoo_serving_expired_total",
+            "Records whose deadline_ms lapsed before inference; each got "
+            "an explicit expired result", ("stream", "priority"))
+        self._expired_counter = {lane: exp.labels(stream, lane)
+                                 for lane in schema.PRIORITIES}
+        depth = reg.gauge(
+            "zoo_serving_lane_depth",
+            "Broker queue depth per priority lane",
+            ("stream", "priority"))
+        self._lane_depth_gauge = {lane: depth.labels(stream, lane)
+                                  for lane in schema.PRIORITIES}
+        self._admission_gauge = reg.gauge(
+            "zoo_serving_admission_state",
+            "1 while admission control is shedding the batch lane",
+            ("stream", "priority")).labels(stream, self.ADMISSION_LANE)
         # at-least-once delivery observability: redeliveries received via
         # XCLAIM and the reclaim sweeps that produced them
         self._redeliver_counter = reg.counter(
@@ -295,11 +391,68 @@ class ClusterServing:
             out[k] = v
         return out
 
+    # --------------------------------------------------- lane scheduling
+    def _lane_order(self) -> str:
+        """Comma-joined lane preference for the next read — weighted-
+        deficit scheduling. Each lane accrues one credit per record it got
+        served; the lane with the lowest credit/weight ratio reads first.
+        Under sustained contention lanes converge on their weight shares
+        (default 4:2:1), and a lane that has been skipped drifts to the
+        lowest ratio and MUST read next — batch work always drains."""
+        ratios = {lane: self._lane_credit.get(lane, 0.0)
+                  / max(self.lane_weights.get(lane, 1.0), 1e-9)
+                  for lane in schema.PRIORITIES}
+        base = min(ratios.values())
+        if base > 0:
+            # renormalize so the minimum ratio is 0 — credits stay bounded
+            # over long runs without changing the relative order
+            for lane in self._lane_credit:
+                self._lane_credit[lane] = max(
+                    0.0, self._lane_credit[lane] - base
+                    * max(self.lane_weights.get(lane, 1.0), 1e-9))
+        order = sorted(schema.PRIORITIES,
+                       key=lambda l: (ratios[l],
+                                      schema.PRIORITIES.index(l)))
+        return ",".join(order)
+
+    def _asm_trigger(self) -> float:
+        """perf_counter time at which the assembly bucket must dispatch
+        even partially filled: the oldest member's lane max-wait cap,
+        tightened by any member whose deadline slack is about to run
+        out. With the default max_wait of 0 this is the arrival time
+        itself — every read dispatches immediately (legacy behavior)."""
+        t = float("inf")
+        for _eid, _uri, _inputs, _m, lane, t_arr, t_deadline in self._asm:
+            t = min(t, t_arr + self.max_wait_ms.get(lane, 0.0) / 1000.0)
+            if t_deadline is not None:
+                t = min(t, max(t_arr, t_deadline - self.SLACK_MARGIN_S))
+        return t
+
+    def _expire_record(self, uri: str, lane: str, cmds: list):
+        """A record's ``deadline_ms`` lapsed before inference: store an
+        explicit typed expired result — never a silent drop; the client's
+        poll raises DeadlineExpiredError instead of timing out — and
+        count it per lane, disjoint from the error counter."""
+        cmds.append(("HSET", self.result_key, uri, schema.encode_error(
+            "deadline_ms expired before the engine served the record",
+            self.cipher, code="expired")))
+        self._expired_counter.get(
+            lane, self._expired_counter[schema.DEFAULT_PRIORITY]).inc()
+        with self._state_lock:
+            self.records_expired += 1
+
     # --------------------------------------------------------------- loop
     def _produce(self, client: BrokerClient, block_ms: int):
         """Host stage: dequeue + decode + preprocess + stack/pad ONE batch.
         Returns ``(x, ctx)`` ready for dispatch, or None when nothing
-        servable arrived (per-record errors are flushed here)."""
+        servable arrived (per-record errors are flushed here).
+
+        Continuous batching: decoded records accumulate in the assembly
+        bucket ``_asm``; the bucket dispatches when it fills, when the
+        oldest member has waited out its lane's ``ZOO_SERVING_MAX_WAIT_MS``
+        (default 0 — every read dispatches immediately), or when any
+        member's deadline slack runs out (``_asm_trigger``). Reads and
+        reclaims are lane-ordered by the weighted-deficit schedule."""
         t_dq0 = time.perf_counter()
         # recover entries a dead/crashed consumer never acked (ref: the
         # Redis-streams recovery path the reference LACKS an analog of —
@@ -313,16 +466,20 @@ class ClusterServing:
         # clock stamps let NTP slew corrupt stage stats AND the claim-
         # interval rate limiter.
         entries = []
+        room = max(0, self.batch_size - len(self._asm))
         if self._claim_backlog:
-            while self._claim_backlog and len(entries) < self.batch_size:
+            while self._claim_backlog and len(entries) < room:
                 entries.append(self._claim_backlog.popleft())
         elif self._reclaim_asap.is_set() or \
                 t_dq0 - self._last_claim >= self._claim_interval_s:
             self._reclaim_asap.clear()
             self._last_claim = t_dq0
+            # lane-ordered reclaim: a dead peer's INTERACTIVE pending
+            # entries re-deliver before its batch-lane entries
             claimed = client.xclaim(self.stream, self.group, self.consumer,
                                     self.claim_min_idle_ms,
-                                    self.RECLAIM_BATCH)
+                                    self.RECLAIM_BATCH,
+                                    lanes=self._lanes_priority)
             if claimed:
                 self._redeliver_counter.inc(len(claimed))
                 self._reclaim_counter.inc()
@@ -332,12 +489,18 @@ class ClusterServing:
                 logger.warning("lease reclaim: %d orphaned entries "
                                "re-delivered to %s", len(claimed),
                                self.consumer)
-                entries = claimed[:self.batch_size]
-                self._claim_backlog.extend(claimed[self.batch_size:])
-        if not entries:
+                entries = claimed[:room]
+                self._claim_backlog.extend(claimed[room:])
+        if not entries and room > 0:
+            eff_block = block_ms
+            if self._asm:
+                # an armed bucket bounds the blocking read: never sleep
+                # past the dispatch trigger of records already waiting
+                left_ms = (self._asm_trigger() - t_dq0) * 1000.0
+                eff_block = int(min(block_ms, max(0.0, left_ms)))
             entries = client.xreadgroup(self.group, self.consumer,
-                                        self.stream, self.batch_size,
-                                        block_ms)
+                                        self.stream, room, eff_block,
+                                        lanes=self._lane_order())
         # the client may have transparently redialed inside xclaim/
         # xreadgroup (BrokerClient retry): the peer could be a RESTARTED
         # broker reusing entry ids from 1, so the dedupe ring must reset
@@ -349,42 +512,41 @@ class ClusterServing:
             self._inflight_ids.clear()
             self._done_ids.clear()
             self._claim_backlog.clear()
+            # the bucket's entry ids describe the dead connection too; its
+            # records re-deliver via their lease like any unacked entry
+            self._asm.clear()
         # idempotence under redelivery: an id this consumer already has in
         # flight (or has finished this connection) is dropped, so a
         # double-delivered record can never double-count or double-write.
         # Already-done ids get their (lost) ack replayed instead.
         if entries:
             fresh, stale_acks = [], []
-            for eid, payload in entries:
+            for eid, lane, payload in entries:
                 if eid in self._done_ids:
                     stale_acks.append(
                         ("XACK", self.stream, self.group, str(eid)))
                 elif eid not in self._inflight_ids:
                     self._inflight_ids.add(eid)
-                    fresh.append((eid, payload))
+                    fresh.append((eid, lane, payload))
             if stale_acks:
                 client.pipeline(stale_acks)
             entries = fresh
-        if not entries:
-            # an empty poll is the strongest idle signal there is — it
-            # feeds the same streak accounting as an under-half-full batch
-            self._grow_batch_on_backlog(0)
-            return None
+        read_n = len(entries)
         t_dq1 = time.perf_counter()
-        self.timer.record("dequeue", t_dq1 - t_dq0)
-        self._grow_batch_on_backlog(len(entries))
+        if read_n:
+            self.timer.record("dequeue", t_dq1 - t_dq0)
 
         t0 = time.perf_counter()
-        # per-record error HSETs accumulate here and ride the same
-        # pipelined flush as the batch results — per-record round-trips
-        # dominated host time at large batch sizes. Every exit path below
-        # flushes err_cmds plus one XACK per dequeued entry (undecodable
-        # records included: their ack IS the final flush).
-        err_cmds: list = []
-        ack_cmds = [("XACK", self.stream, self.group, str(eid))
-                    for eid, _ in entries]
-        uris, rows, metas = [], [], []
-        for eid, payload in entries:
+        # intake: decode each fresh entry. Records that terminate HERE
+        # (undecodable / image-decode failure / deadline already lapsed)
+        # flush their result+ack NOW instead of riding the bucket; the
+        # rest join the assembly bucket and bump their lane's deficit
+        # credit. Pipelined flush — per-record round-trips dominated host
+        # time at large batch sizes.
+        term_cmds: list = []
+        term_acks: list = []
+        for eid, lane, payload in entries:
+            ack = ("XACK", self.stream, self.group, str(eid))
             # one bad record (corrupt b64, wrong cipher, bad uri) must not
             # take the batch or the serve loop down: store an error result
             # for it and continue
@@ -394,20 +556,67 @@ class ClusterServing:
                 schema.validate_uri(uri)
             except Exception as e:
                 logger.warning("dropping undecodable record %s: %s", eid, e)
+                term_acks.append(ack)
                 continue
             try:
                 inputs = self._decode_images(inputs)
             except Exception as e:
                 # the uri is known: the client gets a real error result
                 # (ref stores per-record errors the same way)
-                err_cmds.append((
+                term_cmds.append((
                     "HSET", self.result_key, uri,
                     schema.encode_error(
                         f"image decode failed: {e}", self.cipher)))
+                self._err_counter.inc()
+                term_acks.append(ack)
+                continue
+            m = self._queue_wait(meta, t_dq1)
+            t_deadline = None
+            d = meta.get("d") if isinstance(meta, dict) else None
+            if isinstance(d, (int, float)) and d > 0 and m is not None:
+                # deadline is relative to the client's enqueue stamp,
+                # already mapped onto this clock by _queue_wait
+                t_deadline = m[0] + d / 1000.0
+            if t_deadline is not None and t_dq1 >= t_deadline:
+                self._expire_record(uri, lane, term_cmds)
+                term_acks.append(ack)
+                continue
+            self._lane_credit[lane] = \
+                self._lane_credit.get(lane, 0.0) + 1.0
+            self._asm.append((eid, uri, inputs, m, lane, t_dq1,
+                              t_deadline))
+        if term_acks or term_cmds:
+            client.pipeline(term_cmds + term_acks)
+            self._mark_done(term_acks, self._conn_gen)
+
+        # dispatch decision: full bucket, or the max-wait/deadline trigger
+        # of the waiting members has passed
+        now = time.perf_counter()
+        if not self._asm:
+            if read_n == 0:
+                # an empty poll with an empty bucket is the strongest idle
+                # signal there is — it feeds the same streak accounting as
+                # an under-half-full batch
+                self._grow_batch_on_backlog(0)
+            return None
+        if len(self._asm) < self.batch_size and now < self._asm_trigger():
+            return None                          # keep accumulating
+        take = self._asm[:self.batch_size]
+        self._asm = self._asm[self.batch_size:]
+        self._grow_batch_on_backlog(len(take))
+
+        err_cmds: list = []
+        ack_cmds = []
+        uris, rows, metas = [], [], []
+        for eid, uri, inputs, m, lane, _t_arr, t_deadline in take:
+            ack_cmds.append(("XACK", self.stream, self.group, str(eid)))
+            if t_deadline is not None and now >= t_deadline:
+                # expired while waiting in the bucket
+                self._expire_record(uri, lane, err_cmds)
                 continue
             uris.append(uri)
             rows.append(inputs)
-            metas.append(self._queue_wait(meta, t_dq1))
+            metas.append((m, lane))
         if rows:
             # batch by the MAJORITY shape signature — a single malformed
             # leading record must not reject the whole batch
@@ -429,10 +638,9 @@ class ClusterServing:
                             f"tensor shapes {dict(best)} expected, got "
                             f"{ {k: np.shape(v) for k, v in r.items()} }",
                             self.cipher)))
+                    self._err_counter.inc()
             uris, rows, metas = kept_uris, kept, kept_metas
         if not rows:
-            if err_cmds:
-                self._err_counter.inc(len(err_cmds))
             client.pipeline(err_cmds + ack_cmds)
             self._mark_done(ack_cmds, self._conn_gen)
             return None
@@ -687,8 +895,9 @@ class ClusterServing:
                 return served
         uris, err_cmds, ack_cmds, n, trace, metas = comp.ctx[:6]
         gen = comp.ctx[7] if len(comp.ctx) > 7 else self._conn_gen
-        if err_cmds:
-            self._err_counter.inc(len(err_cmds))
+        # err_cmds are already counted where they were created (_produce):
+        # expired results ride the same flush but belong to the expired
+        # counter, never the error counter
         if comp.error is not None:
             # model incompatibility: every record gets an error result and
             # the entries are acked — losing them silently would hang the
@@ -732,10 +941,13 @@ class ClusterServing:
             self.records_out += n
         self._rec_counter.inc(n)
         # end-to-end latency per stamped record: client enqueue (mapped
-        # onto this clock by _queue_wait) → results about to flush
-        for m in metas:
+        # onto this clock by _queue_wait) → results about to flush, on
+        # the record's own priority series
+        for m, lane in metas:
             if m is not None:
-                self._latency_hist.observe(max(0.0, t_pp_end - m[0]))
+                self._latency_hist.get(
+                    lane, self._latency_hist[schema.DEFAULT_PRIORITY]
+                ).observe(max(0.0, t_pp_end - m[0]))
         if trace is not None:
             self._record_batch_trace(uris, trace, comp, t0, t_pp_end,
                                      metas)
@@ -757,7 +969,8 @@ class ClusterServing:
         like ``client_enqueue``, because both cross the process boundary."""
         t_dq0, t_dq1, t_pp0, t_pp1 = trace
         tr = self._tracer
-        for uri, m in zip(uris, list(metas) or [None] * len(uris)):
+        for uri, ml in zip(uris, list(metas) or [None] * len(uris)):
+            m = ml[0] if ml else None
             if m is not None:
                 tr.record(uri, "queue_wait", m[0], t_dq1)
             tr.record(uri, "dequeue", t_dq0, t_dq1, parent="serve")
@@ -774,6 +987,7 @@ class ClusterServing:
         """One loop turn: produce a batch and stage its dispatch; retire
         batches the window pushed out (or everything, when the stream
         idles — a lone request must not wait for the window to fill)."""
+        self._admission_tick(client)
         if pipe is None:                         # direct-call compatibility
             pipe = self._make_pipe()
             done = []
@@ -793,6 +1007,46 @@ class ClusterServing:
         else:
             done = pipe.drain()
         return sum(self._finish(client, c) for c in done)
+
+    # ------------------------------------------------- admission control
+    def _admission_tick(self, client: BrokerClient):
+        """Periodic (``ZOO_SERVING_ADMISSION_S``) control step on the
+        serve thread: when any per-lane p99 burn is past the shed
+        threshold (the per-priority SLOs in common/slo.py — ``shed=False``
+        there, so they drive admission, never the /healthz 503), flip the
+        broker's batch-lane XSHED flag so NEW batch enqueues fast-fail at
+        XADD while interactive keeps flowing; un-flip once the burn
+        clears. The per-lane queue-depth gauges refresh on the same
+        cadence."""
+        if self._admission_interval_s <= 0:
+            return
+        now = time.perf_counter()
+        if now - self._last_admission < self._admission_interval_s:
+            return
+        self._last_admission = now
+        mon = slo.get_monitor()
+        try:
+            mon.tick_if_stale()
+        except Exception:
+            logger.debug("slo sample failed", exc_info=True)
+        want = any(mon.burning(f"serving_p99_latency_{lane}")
+                   for lane in schema.PRIORITIES)
+        with self._state_lock:
+            flip = want != self.admission_shedding or self._admission_dirty
+        if flip:
+            # dirty forces a re-assert after a reconnect: a RESTARTED
+            # broker lost its shed flags
+            client.xshed_set(self.stream, self.ADMISSION_LANE, want)
+            with self._state_lock:
+                self.admission_shedding = want
+                self._admission_dirty = False
+            self._admission_gauge.set(1.0 if want else 0.0)
+            logger.warning("admission control: %s lane %s",
+                           self.ADMISSION_LANE,
+                           "SHEDDING" if want else "accepting")
+        for lane in schema.PRIORITIES:
+            self._lane_depth_gauge[lane].set(
+                client.xlen(self.stream, lane))
 
     def _make_pipe(self) -> DevicePipeline:
         return DevicePipeline(self._dispatch,
@@ -841,6 +1095,11 @@ class ClusterServing:
                 self._inflight_ids.clear()
                 self._done_ids.clear()
                 self._claim_backlog.clear()
+                self._asm.clear()
+                with self._state_lock:
+                    # re-assert the shed flag on the next admission tick —
+                    # a restarted broker came up accepting everything
+                    self._admission_dirty = True
                 time.sleep(0.2)
             except Exception:
                 # the loop is the service — survive anything per-batch
@@ -958,7 +1217,9 @@ class ClusterServing:
         with self._state_lock:
             out = {"records_out": self.records_out,
                    "records_redelivered": self.records_redelivered,
-                   "lease_reclaims": self.lease_reclaims}
+                   "lease_reclaims": self.lease_reclaims,
+                   "records_expired": self.records_expired,
+                   "admission_shedding": self.admission_shedding}
         out.update(self.timer.summary())
         return out
 

@@ -5,7 +5,11 @@ Replaces the reference's akka-http frontend
 enqueues to Redis and awaits the result). Endpoints:
 
 - ``POST /predict``  body = JSON ``{"inputs": {name: {dtype, shape, data}}}``
-  (schema.py tensor encoding) → ``{"uri", "result": tensor}``
+  (schema.py tensor encoding) → ``{"uri", "result": tensor}``. Optional
+  ``"priority"`` (one of schema.PRIORITIES) routes the record onto a
+  broker lane and ``"deadline_ms"`` bounds result staleness: a shed lane
+  answers 429 immediately (``code: "shed"``), an expired deadline answers
+  504 with ``code: "expired"`` instead of the generic poll timeout.
 - ``GET  /metrics``  → engine metrics JSON by default; Prometheus text
   exposition (format 0.0.4) when the request asks for it — ``Accept:``
   containing ``text/plain`` or ``openmetrics``, or ``?format=prometheus``.
@@ -20,7 +24,8 @@ enqueues to Redis and awaits the result). Endpoints:
   peer scrape counts ``zoo_fleet_scrape_errors_total{replica}`` and
   degrades the response to partial instead of failing it.
 - ``GET  /healthz``  → readiness JSON: broker reachability, input queue
-  depth, consumer-group backlog, fleet replica counts, SLO burn rates.
+  depth (total and per priority lane), consumer-group backlog, lane
+  admission state, fleet replica counts, SLO burn rates.
   503 when the broker is unreachable, when the queue depth exceeds
   ``max_backlog``, or when the SLO monitor (common/slo.py) sheds —
   every window's burn rate past ``ZOO_SLO_SHED_BURN`` — so load
@@ -45,7 +50,7 @@ from typing import Optional
 from analytics_zoo_tpu.common import fleet, profiling, resilience, slo, \
     telemetry
 from analytics_zoo_tpu.serving import schema
-from analytics_zoo_tpu.serving.broker import BrokerClient
+from analytics_zoo_tpu.serving.broker import BrokerClient, ShedError
 from analytics_zoo_tpu.serving.client import (INPUT_STREAM, InputQueue,
                                               OutputQueue)
 
@@ -169,6 +174,22 @@ class _Handler(BaseHTTPRequestHandler):
                          "replicas": meta, "metrics": merged},
                    path="/metrics")
 
+    @staticmethod
+    def _lane_state(client: BrokerClient, stream: str, engine) -> dict:
+        """Per-lane scheduling state shared by /healthz and /slo: queue
+        depth per priority lane, the broker's shed flags, and the
+        engine's admission-control mirrors."""
+        out = {"lanes": {lane: client.xlen(stream, lane)
+                         for lane in schema.PRIORITIES},
+               "shed_lanes": client.xshed(stream)}
+        if engine is not None:
+            out["admission"] = {
+                "shedding": bool(getattr(engine, "admission_shedding",
+                                         False)),
+                "records_expired": int(getattr(engine, "records_expired",
+                                               0))}
+        return out
+
     def _healthz(self):
         srv = self.server  # type: ignore[assignment]
         engine = srv.engine
@@ -183,6 +204,7 @@ class _Handler(BaseHTTPRequestHandler):
             client = BrokerClient(host=srv.broker_host,
                                   port=srv.broker_port)
             out["queue_depth"] = client.xlen(stream)
+            out.update(self._lane_state(client, stream, engine))
             try:
                 out["backlog"] = client.xpending(stream, group)
             except Exception:
@@ -292,7 +314,22 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/slo":
             mon = slo.get_monitor()
             mon.tick_if_stale()
-            self._json(200, mon.report(), path="/slo")
+            rep = mon.report()
+            # live lane state alongside the burn report — one endpoint
+            # answers "is admission control shedding and why"
+            srv = self.server  # type: ignore[assignment]
+            stream = srv.engine.stream if srv.engine else INPUT_STREAM
+            try:
+                client = BrokerClient(host=srv.broker_host,
+                                      port=srv.broker_port)
+                try:
+                    rep.update(self._lane_state(client, stream,
+                                                srv.engine))
+                finally:
+                    client.close()
+            except (ConnectionError, OSError):
+                pass        # the burn report stands on its own
+            self._json(200, rep, path="/slo")
         else:
             self._json(200, {"status": "ok"}, path=path)
 
@@ -314,8 +351,18 @@ class _Handler(BaseHTTPRequestHandler):
             in_q = InputQueue(host=srv.broker_host,
                               port=srv.broker_port, cipher=srv.cipher)
             t_enq0 = time.perf_counter()
-            uri = in_q.enqueue(payload.get("uri"), **inputs)
+            uri = in_q.enqueue(payload.get("uri"),
+                               priority=payload.get("priority"),
+                               deadline_ms=payload.get("deadline_ms"),
+                               **inputs)
             t_enq1 = time.perf_counter()
+        except ShedError as e:
+            # admission control refused the lane at the broker: tell the
+            # caller to back off NOW instead of letting it poll into a
+            # timeout (429 = retry later, unlike the terminal 4xx family)
+            self._json(429, {"error": f"lane shedding: {e}",
+                             "code": "shed"})
+            return
         except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
             self._json(400, {"error": f"bad request: {e}"})
             return
@@ -328,6 +375,12 @@ class _Handler(BaseHTTPRequestHandler):
             t_wait0 = time.perf_counter()
             result = out_q.query(uri, timeout=srv.timeout_s, delete=True)
             t_wait1 = time.perf_counter()
+        except schema.DeadlineExpiredError as e:
+            # distinct from the generic poll timeout below: the ENGINE
+            # declared the deadline lapsed and stored a typed result
+            self._json(504, {"uri": uri, "error": str(e),
+                             "code": "expired"})
+            return
         except schema.ServingError as e:
             self._json(422, {"uri": uri, "error": str(e)})
             return

@@ -8,16 +8,35 @@
 // speaking a line protocol with the subset of semantics serving needs:
 //
 //   PING                                        -> +PONG
-//   XADD <stream> <b64>                         -> +<id>
-//   XLEN <stream>                               -> :<n>
-//   XREADGROUP <group> <consumer> <stream> <count> <block_ms>
-//                                               -> *<n> then n lines "<id> <b64>"
+//   XADD <stream> <b64> [lane]                  -> +<id> | -SHED ... when
+//                                                  the lane's shed flag is
+//                                                  set (lane defaults to
+//                                                  "default")
+//   XLEN <stream> [lane]                        -> :<n> (lane-filtered
+//                                                  when lane given)
+//   XREADGROUP <group> <consumer> <stream> <count> <block_ms> [lanes]
+//                                               -> *<n> then n lines
+//                                                  "<id> <b64>", or
+//                                                  "<id> <lane> <b64>"
+//                                                  when lanes (comma-
+//                                                  separated priority
+//                                                  order) is given —
+//                                                  delivery drains lanes
+//                                                  in that order
 //   XACK <stream> <group> <id>                  -> :<n-acked>
-//   XCLAIM <stream> <group> <consumer> <min_idle_ms> <count>
-//                                               -> *<n> then n lines "<id> <b64>"
+//   XCLAIM <stream> <group> <consumer> <min_idle_ms> <count> [lanes]
+//                                               -> *<n> then n lines
+//                                                  "<id> <b64>" (laneless)
+//                                                  or "<id> <lane> <b64>",
+//                                                  claiming in lane order
 //   XPENDING <stream> <group>                   -> :<n-pending>
 //   XPENDING <stream> <group> DETAIL            -> *<n> then n lines
 //                                                  "<consumer> <count>"
+//   XSHED <stream> <lane> <0|1>                 -> +OK (set/clear the
+//                                                  lane's admission shed
+//                                                  flag)
+//   XSHED <stream>                              -> *<n> then n lines
+//                                                  "<lane>" (shedding)
 //   HSET <key> <field> <b64>                    -> +OK
 //   HGET <key> <field>                          -> $<b64> | $-1
 //   HKEYS <key>                                 -> *<n> then n lines "<field>"
@@ -28,12 +47,14 @@
 // Concurrency: one thread per connection; one global mutex over state (the
 // payloads are opaque b64 strings, so critical sections are pointer work);
 // blocking XREADGROUP waits on a condition_variable. Delivery semantics
-// mirror Redis streams: per-(stream,group) cursor of last-delivered id;
-// un-ACKed entries are tracked per group with their owning consumer and
-// last-delivery time — a delivery LEASE: XCLAIM transfers entries whose
-// lease has been idle past min_idle_ms to another consumer (never back to
-// their current owner), and XPENDING DETAIL attributes the backlog per
-// consumer for crash visibility.
+// mirror Redis streams: per-(stream,group,lane) cursor of last-delivered
+// id (one id space across lanes, so ack/lease/GC semantics stay unified
+// while delivery partitions by priority); un-ACKed entries are tracked per
+// group with their owning consumer and last-delivery time — a delivery
+// LEASE: XCLAIM transfers entries whose lease has been idle past
+// min_idle_ms to another consumer (never back to their current owner),
+// and XPENDING DETAIL attributes the backlog per consumer for crash
+// visibility.
 //
 // Build: g++ -O2 -std=c++17 -pthread -o zbroker zbroker.cpp
 
@@ -62,16 +83,20 @@ namespace {
 struct Entry {
   long long id;
   std::string payload;
+  std::string lane;  // priority class; "default" when XADD gave none
 };
 
 struct PendingEntry {
   std::string consumer;  // current lease owner
   long long ts = 0;      // last delivery (ms, steady clock) — the lease
   long long deliveries = 0;  // total deliveries incl. XCLAIM redeliveries
+  std::string lane;          // so XCLAIM can recover by priority
 };
 
 struct Group {
-  long long cursor = 0;                 // last delivered id
+  // last delivered id PER LANE: draining one lane must not mark another
+  // lane's (lower-id) entries as already seen
+  std::map<std::string, long long> cursor;
   // delivered-not-acked: id -> lease record, so XCLAIM can re-deliver
   // entries whose owning consumer died (lease idle too long) and
   // XPENDING DETAIL can attribute backlog per consumer
@@ -95,6 +120,8 @@ struct Stream {
 std::mutex g_mu;
 std::condition_variable g_cv;
 std::map<std::string, Stream> g_streams;
+// stream -> lanes whose XADDs are rejected (admission control, see XSHED)
+std::map<std::string, std::set<std::string>> g_shed;
 std::map<std::string, std::map<std::string, std::string>> g_hashes;
 // last-write time per hash field: the result hash would otherwise grow
 // forever if a client never collects (TTL eviction bounds broker memory;
@@ -248,6 +275,19 @@ std::vector<std::string> Split(const std::string& s, size_t max_parts) {
   return out;
 }
 
+// "a,b,c" -> {"a","b","c"} (the lanes argument of XREADGROUP/XCLAIM)
+std::vector<std::string> SplitComma(const std::string& s) {
+  std::vector<std::string> out;
+  size_t i = 0;
+  while (i <= s.size()) {
+    size_t j = s.find(',', i);
+    if (j == std::string::npos) j = s.size();
+    if (j > i) out.push_back(s.substr(i, j - i));
+    i = j + 1;
+  }
+  return out;
+}
+
 void HandleConn(int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
@@ -272,22 +312,46 @@ void HandleConn(int fd) {
       if (g_srv_fd >= 0) shutdown(g_srv_fd, SHUT_RDWR);  // unblock accept()
       break;
     } else if (cmd == "XADD" && p.size() >= 3) {
-      long long id;
+      const std::string lane = p.size() >= 4 ? p[3] : "default";
+      long long id = 0;
+      bool shed = false;
       {
         std::lock_guard<std::mutex> lk(g_mu);
-        Stream& st = g_streams[p[1]];
-        id = st.next_id++;
-        st.entries.push_back({id, p[2]});
+        auto sh = g_shed.find(p[1]);
+        if (sh != g_shed.end() && sh->second.count(lane)) {
+          shed = true;
+        } else {
+          Stream& st = g_streams[p[1]];
+          id = st.next_id++;
+          st.entries.push_back({id, p[2], lane});
+        }
       }
-      g_cv.notify_all();
-      SendAll(fd, "+" + std::to_string(id) + "\n");
+      if (shed) {
+        SendAll(fd, "-SHED lane " + lane + " is shedding\n");
+      } else {
+        g_cv.notify_all();
+        SendAll(fd, "+" + std::to_string(id) + "\n");
+      }
     } else if (cmd == "XLEN" && p.size() >= 2) {
       std::lock_guard<std::mutex> lk(g_mu);
-      SendAll(fd, ":" + std::to_string(g_streams[p[1]].entries.size()) + "\n");
+      size_t n = 0;
+      if (p.size() >= 3 && !p[2].empty()) {
+        for (const Entry& e : g_streams[p[1]].entries)
+          if (e.lane == p[2]) ++n;
+      } else {
+        n = g_streams[p[1]].entries.size();
+      }
+      SendAll(fd, ":" + std::to_string(n) + "\n");
     } else if (cmd == "XREADGROUP" && p.size() >= 6) {
       const std::string &group = p[1], &consumer = p[2], &stream = p[3];
       int count = atoi(p[4].c_str());
       int block_ms = atoi(p[5].c_str());
+      // optional lanes arg: comma-separated delivery order — lanes[0]
+      // drains first. Empty/missing = legacy laneless delivery in id
+      // order, replies without the lane field.
+      const bool laned = p.size() >= 7 && !p[6].empty();
+      std::vector<std::string> lanes =
+          laned ? SplitComma(p[6]) : std::vector<std::string>{""};
       std::vector<Entry> got;
       {
         std::unique_lock<std::mutex> lk(g_mu);
@@ -295,12 +359,16 @@ void HandleConn(int fd) {
           Stream& st = g_streams[stream];
           Group& gr = st.groups[group];
           long long now_ms = NowMs();
-          for (const Entry& e : st.entries) {
-            if (e.id <= gr.cursor) continue;
-            got.push_back(e);
-            gr.cursor = e.id;
-            gr.pending[e.id] = PendingEntry{consumer, now_ms, 1};
-            if (static_cast<int>(got.size()) >= count) break;
+          for (const std::string& want : lanes) {
+            for (const Entry& e : st.entries) {
+              if (laned && e.lane != want) continue;
+              auto c = gr.cursor.find(e.lane);
+              if (c != gr.cursor.end() && e.id <= c->second) continue;
+              got.push_back(e);
+              gr.cursor[e.lane] = e.id;
+              gr.pending[e.id] = PendingEntry{consumer, now_ms, 1, e.lane};
+              if (static_cast<int>(got.size()) >= count) return true;
+            }
           }
           return !got.empty();
         };
@@ -312,7 +380,10 @@ void HandleConn(int fd) {
       }
       std::ostringstream os;
       os << "*" << got.size() << "\n";
-      for (const Entry& e : got) os << e.id << " " << e.payload << "\n";
+      for (const Entry& e : got) {
+        if (laned) os << e.id << " " << e.lane << " " << e.payload << "\n";
+        else os << e.id << " " << e.payload << "\n";
+      }
       SendAll(fd, os.str());
     } else if (cmd == "XACK" && p.size() >= 4) {
       int n = 0;
@@ -322,33 +393,46 @@ void HandleConn(int fd) {
         Group& gr = st.groups[p[2]];
         n = static_cast<int>(gr.pending.erase(atoll(p[3].c_str())));
         // GC: drop entries delivered to every group and acked everywhere
-        // (Redis needs explicit XTRIM; serving never re-reads old ids)
+        // (Redis needs explicit XTRIM; serving never re-reads old ids).
+        // Cursors are per-lane: an entry is collectible only when every
+        // group has passed it ON ITS LANE and nobody holds it pending;
+        // the prefix drop stops at the first keeper.
         if (!st.groups.empty()) {
-          long long low = st.next_id;
-          for (auto& kv : st.groups) {
-            long long bound = kv.second.cursor;
-            if (!kv.second.pending.empty())
-              bound = std::min(bound, kv.second.pending.begin()->first - 1);
-            low = std::min(low, bound);
-          }
           size_t drop = 0;
-          while (drop < st.entries.size() && st.entries[drop].id <= low)
+          while (drop < st.entries.size()) {
+            const Entry& e = st.entries[drop];
+            bool consumed = true;
+            for (auto& kv : st.groups) {
+              auto c = kv.second.cursor.find(e.lane);
+              long long cur = c == kv.second.cursor.end() ? 0 : c->second;
+              if (cur < e.id || kv.second.pending.count(e.id)) {
+                consumed = false;
+                break;
+              }
+            }
+            if (!consumed) break;
             ++drop;
+          }
           if (drop > 0)
             st.entries.erase(st.entries.begin(), st.entries.begin() + drop);
         }
       }
       SendAll(fd, ":" + std::to_string(n) + "\n");
     } else if (cmd == "XCLAIM" && p.size() >= 6) {
-      // XCLAIM <stream> <group> <consumer> <min_idle_ms> <count>:
+      // XCLAIM <stream> <group> <consumer> <min_idle_ms> <count> [lanes]:
       // re-deliver pending entries whose lease expired — idle >=
       // min_idle_ms AND owned by a DIFFERENT consumer (recovery of
       // entries whose consumer died before XACK — Redis XAUTOCLAIM
       // analog). Claiming transfers ownership, refreshes the lease
-      // clock and bumps the delivery count.
+      // clock and bumps the delivery count. With lanes the claim drains
+      // lanes in the given order (a dead replica's interactive leases
+      // come back before its batch backlog) and replies carry the lane.
       const std::string& claimer = p[3];
       long long min_idle = atoll(p[4].c_str());
       int count = atoi(p[5].c_str());
+      const bool laned = p.size() >= 7 && !p[6].empty();
+      std::vector<std::string> lanes =
+          laned ? SplitComma(p[6]) : std::vector<std::string>{""};
       std::vector<Entry> got;
       {
         std::lock_guard<std::mutex> lk(g_mu);
@@ -360,23 +444,50 @@ void HandleConn(int fd) {
           // pending id (the engine polls XCLAIM; backlog must stay cheap)
           std::map<long long, const Entry*> index;
           for (const Entry& e : st.entries) index[e.id] = &e;
-          for (auto& kv : gr.pending) {
+          for (const std::string& want : lanes) {
             if (static_cast<int>(got.size()) >= count) break;
-            if (kv.second.consumer == claimer) continue;
-            if (now_ms - kv.second.ts < min_idle) continue;
-            auto it = index.find(kv.first);
-            if (it != index.end()) {
-              got.push_back(*it->second);
-              kv.second.consumer = claimer;
-              kv.second.ts = now_ms;
-              kv.second.deliveries += 1;
+            for (auto& kv : gr.pending) {
+              if (static_cast<int>(got.size()) >= count) break;
+              if (kv.second.consumer == claimer) continue;
+              if (laned && kv.second.lane != want) continue;
+              if (now_ms - kv.second.ts < min_idle) continue;
+              auto it = index.find(kv.first);
+              if (it != index.end()) {
+                got.push_back(*it->second);
+                kv.second.consumer = claimer;
+                kv.second.ts = now_ms;
+                kv.second.deliveries += 1;
+              }
             }
           }
         }
       }
       std::ostringstream os;
       os << "*" << got.size() << "\n";
-      for (const Entry& e : got) os << e.id << " " << e.payload << "\n";
+      for (const Entry& e : got) {
+        if (laned) os << e.id << " " << e.lane << " " << e.payload << "\n";
+        else os << e.id << " " << e.payload << "\n";
+      }
+      SendAll(fd, os.str());
+    } else if (cmd == "XSHED" && p.size() >= 4) {
+      // XSHED <stream> <lane> <0|1>: set/clear the lane's admission shed
+      // flag (absolute write — the engine repeats it safely)
+      {
+        std::lock_guard<std::mutex> lk(g_mu);
+        if (p[3] == "0") g_shed[p[1]].erase(p[2]);
+        else g_shed[p[1]].insert(p[2]);
+      }
+      SendAll(fd, "+OK\n");
+    } else if (cmd == "XSHED" && p.size() >= 2) {
+      std::ostringstream os;
+      {
+        std::lock_guard<std::mutex> lk(g_mu);
+        auto sh = g_shed.find(p[1]);
+        size_t n = sh == g_shed.end() ? 0 : sh->second.size();
+        os << "*" << n << "\n";
+        if (sh != g_shed.end())
+          for (const std::string& lane : sh->second) os << lane << "\n";
+      }
       SendAll(fd, os.str());
     } else if (cmd == "XPENDING" && p.size() >= 4) {
       // XPENDING <stream> <group> DETAIL -> per-consumer pending counts
@@ -461,6 +572,7 @@ void HandleConn(int fd) {
       {
         std::lock_guard<std::mutex> lk(g_mu);
         g_streams.erase(p[1]);
+        g_shed.erase(p[1]);
         g_hashes.erase(p[1]);
         g_hash_times.erase(p[1]);
         g_hash_fifo.erase(p[1]);

@@ -34,6 +34,27 @@ class ServingError(RuntimeError):
     """An error result stored in place of a prediction."""
 
 
+class DeadlineExpiredError(ServingError):
+    """The record's ``deadline_ms`` elapsed before the engine could serve
+    it — the engine stored an explicit expired result (never a silent
+    drop), and decoding that result raises this."""
+
+
+# Priority lanes, highest first. The lane name doubles as the broker-side
+# lane tag and the ``priority`` label on serving metrics.
+PRIORITIES = ("interactive", "default", "batch")
+DEFAULT_PRIORITY = "default"
+
+
+def validate_priority(priority: Optional[str]) -> str:
+    if priority is None:
+        return DEFAULT_PRIORITY
+    if priority not in PRIORITIES:
+        raise ValueError(
+            f"bad priority {priority!r}: one of {PRIORITIES}")
+    return priority
+
+
 class ImageBytes:
     """Raw encoded image (JPEG/PNG) riding a record — decoded and run
     through the engine-side preprocessing chain, exactly the reference's
@@ -74,11 +95,13 @@ def encode_record(uri: str, inputs: Dict[str, np.ndarray],
                   trace: Optional[Dict[str, Any]] = None) -> str:
     """``trace`` is the optional end-to-end tracing stamp the client
     attaches (``{"id", "t_pc", "t_wall", "s"}`` — enqueue time on both
-    the monotonic and wall clocks plus the sampling flag); the engine
-    turns it into the measured ``queue_wait`` span and the
-    ``zoo_queue_wait_seconds`` / ``zoo_serving_latency_seconds``
-    histograms. Decoders that ignore it (``decode_record``) are
-    unaffected — the field is additive."""
+    the monotonic and wall clocks plus the sampling flag, plus the
+    scheduling fields ``"p"``/``"d"``: the record's priority lane and its
+    relative ``deadline_ms``); the engine turns it into the measured
+    ``queue_wait`` span and the ``zoo_queue_wait_seconds`` /
+    ``zoo_serving_latency_seconds`` histograms, and uses the deadline to
+    expire records whose slack ran out. Decoders that ignore it
+    (``decode_record``) are unaffected — the field is additive."""
     obj: Dict[str, Any] = {
         "uri": uri,
         "inputs": {k: encode_tensor(v if isinstance(v, ImageBytes)
@@ -235,8 +258,15 @@ def encode_result(arr: np.ndarray, cipher: Cipher = None) -> str:
     return base64.b64encode(body).decode()
 
 
-def encode_error(message: str, cipher: Cipher = None) -> str:
-    body = json.dumps({"error": str(message)[:2000]}).encode()
+def encode_error(message: str, cipher: Cipher = None,
+                 code: Optional[str] = None) -> str:
+    """``code`` types the error for the decoding client (additive field):
+    ``"expired"`` marks a deadline-expired record and decodes into
+    :class:`DeadlineExpiredError` instead of plain :class:`ServingError`."""
+    obj: Dict[str, Any] = {"error": str(message)[:2000]}
+    if code:
+        obj["code"] = code
+    body = json.dumps(obj).encode()
     if cipher is not None:
         body = cipher[0](body)
     return base64.b64encode(body).decode()
@@ -248,5 +278,7 @@ def decode_result(payload_b64: str, cipher: Cipher = None) -> np.ndarray:
         body = cipher[1](body)
     obj = json.loads(body)
     if "error" in obj:
+        if obj.get("code") == "expired":
+            raise DeadlineExpiredError(obj["error"])
         raise ServingError(obj["error"])
     return decode_tensor(obj)

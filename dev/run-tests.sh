@@ -9,6 +9,7 @@
 #   dev/run-tests.sh smoke        # fast pre-push subset (<5 min, 1 core)
 #   Lanes: smoke core data keras models zouwu automl serving interop
 #          examples telemetry fleet resilience zoolint kernels chaos
+#          scheduling
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -203,6 +204,48 @@ assert rec.get("serving_replica_lease_reclaims", 0) == 1, \
 print(f"chaos OK: scaling={scaling} failover={fo}s "
       f"redelivered={rec['serving_replica_kill_redelivered']} "
       f"sweeps={rec['serving_replica_lease_reclaims']}")
+PY
+            ;;
+  # SLO-aware continuous batching (ISSUE 10): priority lanes on both
+  # broker backends, weighted-deficit scheduling, deadline expiry,
+  # admission control, the lane/lease SIGKILL drill (slow-marked, runs
+  # here) — then a mixed-traffic bench smoke gating interactive p99
+  # under a batch-lane flood. The seeded zoolint fixture must flag an
+  # undeclared per-lane metric: a quiet drift check on the scheduling
+  # metrics means the linter regressed, not that the tree is clean.
+  scheduling) run tests/test_priority.py
+            echo "== zoolint: drift must flag undeclared lane metrics/knobs"
+            drift="$(python -m analytics_zoo_tpu.analysis --no-baseline \
+                       tests/fixtures/zoolint 2>&1 || true)"
+            if ! grep -q "zoo_serving_lane_depth_bogus" <<<"$drift"; then
+              echo "catalog drift missed the seeded per-lane metric" >&2
+              exit 1
+            fi
+            if ! grep -q "ZOO_SERVING_MAX_WAIT_BOGUS_MS" <<<"$drift"; then
+              echo "catalog drift missed the seeded scheduling env var" >&2
+              exit 1
+            fi
+            echo "== bench --smoke scheduling (batch-lane flood drill)"
+            outdir="$(mktemp -d)"
+            ZOO_FLIGHT_RECORDER_DIR="$outdir" \
+              JAX_PLATFORMS=cpu python bench.py --smoke scheduling \
+              > "$outdir/smoke.json"
+            python - "$outdir" <<'PY'
+import json, sys
+rec = json.load(open(sys.argv[1] + "/smoke.json"))
+assert rec["mode"] == "smoke", rec.keys()
+# interactive p99 stayed within budget while the batch lane was flooded
+# (zero loss + zero expiries are asserted inside the measure)
+p99 = rec.get("serving_p99_interactive_ms", -1)
+budget = rec.get("serving_interactive_budget_ms", 0)
+assert 0 <= p99 <= budget, \
+    f"interactive p99 {p99}ms blew the {budget}ms budget under flood"
+rps = rec.get("serving_priority_records_per_sec", 0)
+assert rps > 0, "mixed-traffic drill recorded no throughput"
+assert rec.get("serving_priority_flood_records", 0) > 0, \
+    "drill ran without a batch-lane flood"
+print(f"scheduling OK: interactive p99={p99}ms (budget {budget}ms) "
+      f"mixed throughput={rps} rec/s")
 PY
             ;;
   release)  bash "$(dirname "$0")/release.sh" ;;

@@ -20,4 +20,12 @@ def register_bogus(registry):
     r = registry.counter("zoo_serving_redelivered_bogus_total",
                          "not in docs")  # VIOLATION metric-undocumented
     lease = os.getenv("ZOO_SERVING_BOGUS_MS")  # VIOLATION envvar-undocumented
-    return c, flag, g, knob, r, lease
+    # a per-lane scheduling family the catalog does NOT list: the drift
+    # check must flag new lane/admission metrics (the priority-lane
+    # counters landed with the SLO-aware scheduler; an undeclared
+    # sibling must fire, not coast on the zoo_serving_lane_* prefix)
+    d = registry.gauge("zoo_serving_lane_depth_bogus",
+                       "not in docs")  # VIOLATION metric-undocumented
+    wait = os.getenv(
+        "ZOO_SERVING_MAX_WAIT_BOGUS_MS")  # VIOLATION envvar-undocumented
+    return c, flag, g, knob, r, lease, d, wait

@@ -161,7 +161,7 @@ class TestSLOMonitor:
         telemetry.reset_for_tests()
         reg = telemetry.get_registry()
         return (reg.histogram("zoo_serving_latency_seconds", "d",
-                              ("stream",)).labels("s"),
+                              ("stream", "priority")).labels("s", "default"),
                 reg.counter("zoo_serving_records_total", "d",
                             ("stream",)).labels("s"),
                 reg.counter("zoo_serving_record_errors_total", "d",
@@ -342,7 +342,8 @@ def test_two_replica_federation_smoke():
                 "stream=serving_stream"] == n_records
             # histograms merged too: fleet-wide latency distribution
             # carries every record and its bucket boundaries
-            lat = m["zoo_serving_latency_seconds"]["stream=serving_stream"]
+            lat = m["zoo_serving_latency_seconds"][
+                "stream=serving_stream,priority=default"]
             assert lat["count"] == n_records
             assert sum(lat["bucket_counts"]) == n_records
             assert lat["le"] == list(telemetry.DEFAULT_BUCKETS)
@@ -425,7 +426,7 @@ def test_healthz_sheds_on_slo_burn_not_backlog():
 
             h = telemetry.get_registry().histogram(
                 "zoo_serving_latency_seconds", "d",
-                ("stream",)).labels("serving_stream")
+                ("stream", "priority")).labels("serving_stream", "default")
             for _ in range(50):
                 h.observe(9.0)          # every record blows the 1s p99
             time.sleep(0.05)            # tick_if_stale refires on read
