@@ -173,8 +173,8 @@ def register(rule_cls):
 
 def all_rules() -> Dict[str, Rule]:
     from analytics_zoo_tpu.analysis import (  # noqa: F401
-        rules_catalog, rules_compile, rules_concurrency, rules_hotpath,
-        rules_jit,
+        rules_catalog, rules_compile, rules_concurrency, rules_dataplane,
+        rules_hotpath, rules_jit,
     )
     return dict(_RULES)
 

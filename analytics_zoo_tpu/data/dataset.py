@@ -15,6 +15,7 @@ tf_dataset.py:117 batch_per_thread semantics).
 from __future__ import annotations
 
 import math
+import os
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 import numpy as np
@@ -297,23 +298,39 @@ class StreamingShardedDataset(ShardedDataset):
     NATIVE_n residency window the instant training starts), this streams:
     shards are gathered window-by-window from the store, each window is
     shuffled and cut into fixed-shape batches, leftover rows carry into the
-    next window so every batch stays full, and the next window loads on a
-    background thread while the current one feeds the device (on top of the
-    native store's own shard prefetch). Peak host residency ≈ one window +
-    one carry, never the whole dataset (tracked in ``peak_window_rows``).
+    next window so every batch stays full, and up to ``prefetch_depth``
+    windows load on the shared data pool while the current one feeds the
+    device (on top of the native store's own shard prefetch) — window
+    assembly (spill reads + pandas→numpy conversion) overlaps device steps.
+    Peak host residency ≈ one window + one carry (+ ``prefetch_depth``
+    pending windows), never the whole dataset (tracked in
+    ``peak_window_rows``).
     """
 
     def __init__(self, shards: XShards, feature_cols=None, label_cols=None,
-                 window_shards: Optional[int] = None):
+                 window_shards: Optional[int] = None,
+                 prefetch_depth: Optional[int] = None):
+        import pandas as pd
         self._xshards = shards
         self._fc, self._lc = feature_cols, label_cols
         # one sequential pass for per-shard row counts (the store's
-        # prefetcher makes this a streaming scan, not a materialization)
+        # prefetcher makes this a streaming scan, not a materialization;
+        # DataFrame / orca-dict shards report their length without any
+        # column conversion)
         self._lens = []
         for s in shards._iter_shards():
-            x, _ = _shards_to_xy([s], feature_cols, label_cols)
-            self._lens.append(_tree_len(x))
+            if isinstance(s, pd.DataFrame):
+                self._lens.append(len(s))
+            elif isinstance(s, dict) and "x" in s:
+                self._lens.append(_tree_len(s["x"]))
+            else:
+                x, _ = _shards_to_xy([s], feature_cols, label_cols)
+                self._lens.append(_tree_len(x))
         self.n = sum(self._lens)
+        if prefetch_depth is None:
+            raw = os.environ.get("ZOO_DATA_PREFETCH", "").strip()
+            prefetch_depth = int(raw) if raw.isdigit() else 1
+        self.prefetch_depth = max(1, int(prefetch_depth))
         self.x = None  # rows never materialize on this object
         self.y = None
         if window_shards is None:
@@ -322,6 +339,11 @@ class StreamingShardedDataset(ShardedDataset):
             window_shards = max(1, math.ceil(shards.num_partitions() / denom))
         self.window_shards = int(window_shards)
         self.peak_window_rows = 0
+
+    def prefetch(self, depth: int) -> "StreamingShardedDataset":
+        """Set how many windows load ahead of the device (fluent)."""
+        self.prefetch_depth = max(1, int(depth))
+        return self
 
     # materialize only for the explicit whole-dataset transforms
     def _materialize(self) -> ShardedDataset:
@@ -342,8 +364,12 @@ class StreamingShardedDataset(ShardedDataset):
                      drop_remainder: bool = True,
                      process_fraction: Optional[float] = None
                      ) -> Iterator[Tuple[Any, Any, Optional[np.ndarray]]]:
+        import time
+        from collections import deque
+
         import jax
-        from concurrent.futures import ThreadPoolExecutor
+
+        from analytics_zoo_tpu.data import shard as shard_lib
 
         per_host = self._per_host(batch_size, process_fraction)
         if per_host > self.n and drop_remainder:
@@ -359,40 +385,60 @@ class StreamingShardedDataset(ShardedDataset):
                    for i in range(0, n_shards, self.window_shards)]
         store = self._xshards._store
 
+        hist, _ = shard_lib._data_metrics()
+
         def load_window(ids):
+            t0 = time.perf_counter()
             data = [store.get(int(i)) for i in ids]
-            return _shards_to_xy(data, self._fc, self._lc)
+            out = _shards_to_xy(data, self._fc, self._lc)
+            hist.labels("stream_window").observe(time.perf_counter() - t0)
+            return out
 
         def concat(a, b):
             return jax.tree_util.tree_map(
                 lambda u, v: np.concatenate([u, v]), a, b)
 
+        # window assembly runs on the shared data pool, up to prefetch_depth
+        # windows ahead of the device (layer-3 overlap, docs/data_plane.md)
+        depth = self.prefetch_depth
+        from analytics_zoo_tpu.common import telemetry
+        telemetry.get_registry().gauge(
+            "zoo_data_prefetch_depth",
+            "streaming-feed windows loading ahead of the device").set(depth)
+        pool = shard_lib.get_data_pool()
+        pending: deque = deque()
+        nxt = 0
+
+        def top_up():
+            nonlocal nxt
+            while nxt < len(windows) and len(pending) < depth:
+                pending.append(pool.submit(load_window, windows[nxt]))
+                nxt += 1
+
+        top_up()
         carry_x = carry_y = None
-        with ThreadPoolExecutor(max_workers=1) as pool:
-            pending = pool.submit(load_window, windows[0])
-            for wi in range(len(windows)):
-                x, y = pending.result()
-                if wi + 1 < len(windows):
-                    pending = pool.submit(load_window, windows[wi + 1])
-                if carry_x is not None:
-                    x = concat(carry_x, x)
-                    y = concat(carry_y, y) if y is not None else None
-                rows = _tree_len(x)
-                self.peak_window_rows = max(self.peak_window_rows, rows)
-                order = rng.permutation(rows) if shuffle else np.arange(rows)
-                full = rows // per_host
-                for b in range(full):
-                    idx = order[b * per_host:(b + 1) * per_host]
-                    yield (_tree_take(x, idx),
-                           _tree_take(y, idx) if y is not None else None,
-                           None)
-                rem = rows - full * per_host
-                if rem:
-                    idx = order[full * per_host:]
-                    carry_x = _tree_take(x, idx)
-                    carry_y = _tree_take(y, idx) if y is not None else None
-                else:
-                    carry_x = carry_y = None
+        for wi in range(len(windows)):
+            x, y = pending.popleft().result()
+            top_up()
+            if carry_x is not None:
+                x = concat(carry_x, x)
+                y = concat(carry_y, y) if y is not None else None
+            rows = _tree_len(x)
+            self.peak_window_rows = max(self.peak_window_rows, rows)
+            order = rng.permutation(rows) if shuffle else np.arange(rows)
+            full = rows // per_host
+            for b in range(full):
+                idx = order[b * per_host:(b + 1) * per_host]
+                yield (_tree_take(x, idx),
+                       _tree_take(y, idx) if y is not None else None,
+                       None)
+            rem = rows - full * per_host
+            if rem:
+                idx = order[full * per_host:]
+                carry_x = _tree_take(x, idx)
+                carry_y = _tree_take(y, idx) if y is not None else None
+            else:
+                carry_x = carry_y = None
         if carry_x is not None and not drop_remainder:
             rem = _tree_len(carry_x)
             pad = np.concatenate([np.arange(rem),

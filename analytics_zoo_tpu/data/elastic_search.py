@@ -119,7 +119,9 @@ class EsTable:
                 if isinstance(v, dict):
                     keys.update(v.keys())
             for k in sorted(keys):
-                out[f"{col}.{k}"] = df[col].map(
+                # dict-typed JSON cells: object traversal, not numeric rows —
+                # there is no vectorized form of nested-doc flattening
+                out[f"{col}.{k}"] = df[col].map(  # zoolint: disable=rowwise-map-in-data-plane
                     lambda v, kk=k: v.get(kk) if isinstance(v, dict)
                     else None)
         return pd.DataFrame(out)

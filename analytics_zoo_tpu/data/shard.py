@@ -13,6 +13,14 @@ Memory tiers (ref FeatureSet DRAM/PMEM/DISK_n, zoo/.../feature/FeatureSet.scala:
 pickles and keeps only 1/n resident, streaming the rest on demand — set via
 ``OrcaContext.train_data_store``.
 
+Shard transforms run on a shared thread pool (``ZOO_DATA_WORKERS``, threads
+because numpy/pandas release the GIL on the hot kernels): ordered results,
+per-shard exception propagation (``ShardTransformError.shard_index``), and a
+bounded in-flight window so ``DISK_n`` tiers never fully materialize — the
+result store consumes transformed shards as they stream out of the pool.
+``map_reduce_shard`` is the map-side-combine seam the Table aggregations use
+instead of a full ``to_pandas()`` gather (docs/data_plane.md).
+
 API parity (same method names as the reference): ``partition``,
 ``transform_shard``, ``collect``, ``num_partitions``, ``repartition``,
 ``partition_by``, ``unique``, ``split``, ``zip``, ``__len__``,
@@ -21,11 +29,16 @@ API parity (same method names as the reference): ``partition``,
 
 from __future__ import annotations
 
+import collections
+import functools
 import glob
 import os
 import pickle
 import tempfile
-from typing import Any, Callable, List, Optional
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, Iterable, List, Optional
 
 import numpy as np
 
@@ -36,6 +49,119 @@ def _is_dataframe(x):
         return isinstance(x, pd.DataFrame)
     except ImportError:  # pragma: no cover
         return False
+
+
+# --------------------------------------------------------------- data pool
+
+DEFAULT_DATA_WORKERS = min(8, os.cpu_count() or 1)
+
+_POOL_LOCK = threading.Lock()
+_POOL: Optional[ThreadPoolExecutor] = None
+_POOL_SIZE = 0
+
+#: per-op stats of the most recent parallel run — tests assert the in-flight
+#: window stayed bounded under DISK/NATIVE tiers
+LAST_RUN_STATS: Dict[str, Dict[str, Any]] = {}
+
+
+def data_workers() -> int:
+    """Worker count for shard transforms. ``ZOO_DATA_WORKERS`` <= 1 means
+    serial in-thread execution (the parity baseline)."""
+    raw = os.environ.get("ZOO_DATA_WORKERS", "").strip()
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+    return DEFAULT_DATA_WORKERS
+
+
+def get_data_pool() -> ThreadPoolExecutor:
+    """The shared executor for shard transforms and streaming prefetch.
+    Always has >= 1 thread even when ``ZOO_DATA_WORKERS=0`` so prefetch can
+    still overlap the device; resized lazily when the knob changes."""
+    global _POOL, _POOL_SIZE
+    n = max(1, data_workers())
+    with _POOL_LOCK:
+        if _POOL is None or _POOL_SIZE != n:
+            if _POOL is not None:
+                _POOL.shutdown(wait=False)
+            _POOL = ThreadPoolExecutor(max_workers=n,
+                                       thread_name_prefix="zoo-data")
+            _POOL_SIZE = n
+        return _POOL
+
+
+class ShardTransformError(RuntimeError):
+    """A shard function failed; carries the failing shard index so tiered
+    runs (where shard content never hits the traceback) stay debuggable."""
+
+    def __init__(self, shard_index: int, op: str, cause: BaseException):
+        super().__init__(
+            f"shard {shard_index} failed in {op}: {type(cause).__name__}: "
+            f"{cause}")
+        self.shard_index = shard_index
+        self.op = op
+
+
+def _data_metrics():
+    from analytics_zoo_tpu.common import telemetry
+    reg = telemetry.get_registry()
+    return (reg.histogram("zoo_data_transform_seconds",
+                          "wall seconds per data-plane op", ("op",)),
+            reg.gauge("zoo_data_workers_busy",
+                      "in-flight shard tasks in the data pool"))
+
+
+def _map_shards(fn: Callable[[Any], Any], n: int,
+                get: Callable[[int], Any], op: str):
+    """Ordered map of ``fn`` over ``get(0..n-1)``: parallel on the data pool
+    when ``ZOO_DATA_WORKERS`` > 1, serial otherwise. Yields results in shard
+    order with a bounded in-flight window (workers + small headroom) so a
+    downstream spill store consumes them incrementally — full ``DISK_n``
+    pipelines never hold more than the window resident. Shard exceptions
+    surface as :class:`ShardTransformError` with the failing index."""
+    hist, busy = _data_metrics()
+    t0 = time.perf_counter()
+    workers = data_workers()
+    stats = {"op": op, "shards": n, "workers": max(1, workers),
+             "in_flight_peak": 0}
+    LAST_RUN_STATS[op] = stats
+    try:
+        if workers <= 1 or n <= 1:
+            for i in range(n):
+                stats["in_flight_peak"] = max(stats["in_flight_peak"], 1)
+                try:
+                    yield fn(get(i))
+                except ShardTransformError:
+                    raise
+                except Exception as e:
+                    raise ShardTransformError(i, op, e) from e
+            return
+        pool = get_data_pool()
+        window = workers + 2
+        pending: collections.deque = collections.deque()
+        nxt = 0
+        while nxt < n or pending:
+            while nxt < n and len(pending) < window:
+                # shards are fetched on the submitting thread (stores need
+                # no locking) and transformed on the pool
+                pending.append((nxt, pool.submit(fn, get(nxt))))
+                nxt += 1
+                stats["in_flight_peak"] = max(stats["in_flight_peak"],
+                                              len(pending))
+                busy.set(len(pending))
+            i, fut = pending.popleft()
+            try:
+                yield fut.result()
+            except ShardTransformError:
+                raise
+            except Exception as e:
+                raise ShardTransformError(i, op, e) from e
+            busy.set(len(pending))
+    finally:
+        busy.set(0)
+        hist.labels(op).observe(time.perf_counter() - t0)
 
 
 class XShards:
@@ -107,28 +233,33 @@ class XShards:
         return HostXShards(shards)
 
 
-def _make_store(shards: List[Any], tier: str):
+def _make_store(shards: Iterable[Any], tier: str):
     """Pick the storage backend for a tier. ``NATIVE_n`` = the C++ arena
     (LRU DRAM window over spill files + prefetch thread,
     data/native/zstore.cpp); falls back to the python ``DISK_n`` spill when
-    no toolchain is available."""
+    no toolchain is available. ``shards`` may be a generator: the python
+    spill store consumes it incrementally (bounded residency); the native
+    arena needs the materialized list."""
     if tier.startswith("NATIVE_"):
+        shards = list(shards)
         try:
             from analytics_zoo_tpu.data.native_store import NativeShardStore
             return NativeShardStore(
-                list(shards),
+                shards,
                 keep_fraction_denom=max(1, int(tier.split("_", 1)[1])))
         except (RuntimeError, ValueError, OSError):
             # OSError covers NativeShardStore's IOError on spill failure —
             # degrade to the python spill instead of crashing
             tier = "DISK_" + tier.split("_", 1)[1]
-    return _ShardStore(list(shards), tier)
+    return _ShardStore(shards, tier)
 
 
 class _ShardStore:
-    """Shard storage backend: DRAM list, or disk spill keeping 1/n resident."""
+    """Shard storage backend: DRAM list, or disk spill keeping 1/n resident.
+    Consumes its input iterable one shard at a time so pool-transformed
+    shards spill as they arrive instead of materializing first."""
 
-    def __init__(self, shards: List[Any], tier: str = "DRAM"):
+    def __init__(self, shards: Iterable[Any], tier: str = "DRAM"):
         self.tier = tier
         if tier == "DRAM":
             self._mem = list(shards)
@@ -137,14 +268,13 @@ class _ShardStore:
             keep = max(1, int(tier.split("_", 1)[1]))
             self._dir = tempfile.mkdtemp(prefix="zoo_tpu_shards_")
             self._paths = []
-            self._mem = [None] * len(shards)
+            self._mem = []
             for i, s in enumerate(shards):
                 p = os.path.join(self._dir, f"shard-{i:05d}.pkl")
                 with open(p, "wb") as fh:
                     pickle.dump(s, fh, protocol=pickle.HIGHEST_PROTOCOL)
                 self._paths.append(p)
-                if i % keep == 0:  # keep 1/keep resident
-                    self._mem[i] = s
+                self._mem.append(s if i % keep == 0 else None)
 
     def __len__(self):
         return len(self._mem)
@@ -163,22 +293,40 @@ class _ShardStore:
 class HostXShards(XShards):
     """Shards resident in this host process (ref SparkXShards, shard.py:129)."""
 
-    def __init__(self, shards: List[Any], transient: bool = False,
+    def __init__(self, shards: Iterable[Any], transient: bool = False,
                  tier: Optional[str] = None):
         if tier is None:
             from analytics_zoo_tpu.common.context import OrcaContext
             tier = OrcaContext.train_data_store
-        self._store = _make_store(list(shards),
+        self._store = _make_store(shards,
                                   tier if not transient else "DRAM")
         self.tier = self._store.tier
 
     # -- core --
-    def transform_shard(self, func: Callable, *args) -> "HostXShards":
-        return HostXShards([func(s, *args) for s in self._iter_shards()])
+    def transform_shard(self, func: Callable, *args,
+                        op: str = "transform_shard") -> "HostXShards":
+        fn = (lambda s: func(s, *args)) if args else func
+        return HostXShards(
+            _map_shards(fn, self.num_partitions(), self._store.get, op))
+
+    def map_reduce_shard(self, map_fn: Callable, reduce_fn: Callable,
+                         op: str = "map_reduce") -> Any:
+        """Map-side combine: ``map_fn`` runs per shard on the data pool,
+        ``reduce_fn`` folds the per-shard partials in shard order. The seam
+        Table aggregations use instead of gathering via ``to_pandas()``."""
+        it = _map_shards(map_fn, self.num_partitions(), self._store.get, op)
+        return functools.reduce(reduce_fn, it)
 
     def _iter_shards(self):
         for i in range(len(self._store)):
             yield self._store.get(i)
+
+    def first(self):
+        """Shard 0 only — never touches (or re-reads spill files of) the
+        other shards; the seam for ``Table.schema``/``col_names``."""
+        if not len(self._store):
+            raise IndexError("first() on empty XShards")
+        return self._store.get(0)
 
     def collect(self) -> List[Any]:
         return self._store.all()
@@ -195,45 +343,94 @@ class HostXShards(XShards):
     # -- restructuring --
     def repartition(self, num_partitions: int) -> "HostXShards":
         """Type-aware merge/split (ref shard.py:219-293: np-dict rows merged
-        elementwise, DataFrames concatenated)."""
-        shards = self.collect()
-        if not shards:
+        elementwise, DataFrames concatenated). Planned as global row ranges
+        and assembled per output shard on the data pool, so only the input
+        shards overlapping one output range are resident at a time."""
+        n_in = self.num_partitions()
+        if n_in == 0:
             return self
-        first = shards[0]
+        first = self.first()
+        get = self._store.get
+
         if _is_dataframe(first):
             import pandas as pd
-            big = pd.concat(shards, ignore_index=False)
-            idx = np.array_split(np.arange(len(big)), num_partitions)
-            return HostXShards([big.iloc[i] for i in idx])
-        if isinstance(first, dict) and all(
+            rows = lambda s: len(s)
+            sl = lambda s, a, b: s.iloc[a:b]
+            combine = lambda ps: pd.concat(ps, ignore_index=False) \
+                if len(ps) != 1 else ps[0]
+        elif isinstance(first, dict) and all(
                 isinstance(v, np.ndarray) for v in first.values()):
             keys = list(first.keys())
-            merged = {k: np.concatenate([s[k] for s in shards]) for k in keys}
-            total = len(merged[keys[0]])
-            idx = np.array_split(np.arange(total), num_partitions)
-            return HostXShards([{k: merged[k][i] for k in keys} for i in idx])
-        if isinstance(first, np.ndarray):
-            merged = np.concatenate(shards)
-            return HostXShards(np.array_split(merged, num_partitions))
-        # generic: treat each shard as a list of records
-        records = []
-        for s in shards:
-            records.extend(s if isinstance(s, (list, tuple)) else [s])
-        idx = np.array_split(np.arange(len(records)), num_partitions)
-        return HostXShards([[records[j] for j in i] for i in idx])
+            rows = lambda s: len(s[keys[0]]) if keys else 0
+            sl = lambda s, a, b: {k: s[k][a:b] for k in keys}
+            combine = lambda ps: {
+                k: np.concatenate([p[k] for p in ps]) for k in keys}
+        elif isinstance(first, np.ndarray):
+            rows = lambda s: len(s)
+            sl = lambda s, a, b: s[a:b]
+            combine = lambda ps: np.concatenate(ps) if len(ps) != 1 else ps[0]
+        else:
+            # generic: treat each shard as a list of records
+            as_records = lambda s: list(s) if isinstance(s, (list, tuple)) \
+                else [s]
+            rows = lambda s: len(as_records(s))
+            sl = lambda s, a, b: as_records(s)[a:b]
+            combine = lambda ps: [r for p in ps for r in p]
+
+        lengths = [rows(get(i)) for i in range(n_in)]
+        total = sum(lengths)
+        # np.array_split boundary semantics: first (total % m) outputs get
+        # one extra row
+        m = num_partitions
+        sizes = [total // m + (1 if j < total % m else 0) for j in range(m)]
+        offsets = np.cumsum([0] + lengths)
+        plans = []
+        lo = 0
+        for size in sizes:
+            hi = lo + size
+            plan = []
+            for si in range(n_in):
+                a = max(lo, offsets[si])
+                b = min(hi, offsets[si + 1])
+                if a < b:
+                    plan.append((si, int(a - offsets[si]),
+                                 int(b - offsets[si])))
+            plans.append(plan)
+            lo = hi
+
+        def build(plan):
+            ps = [sl(get(si), a, b) for (si, a, b) in plan]
+            return combine(ps) if ps else combine([sl(get(0), 0, 0)])
+
+        return HostXShards(
+            _map_shards(build, m, lambda j: plans[j], "repartition"))
 
     def partition_by(self, cols, num_partitions: Optional[int] = None) -> "HostXShards":
-        """Hash-partition DataFrame shards by column(s) (ref shard.py:295-339)."""
+        """Hash-partition DataFrame shards by column(s) (ref shard.py:295-339).
+        Map-side split per shard on the data pool, then per-bucket concat —
+        the row-wise hash is position-independent, so the result matches the
+        old global-concat path row for row."""
         import pandas as pd
-        shards = self.collect()
-        assert shards and _is_dataframe(shards[0]), \
+        n_in = self.num_partitions()
+        assert n_in and _is_dataframe(self.first()), \
             "partition_by requires pandas DataFrame shards"
         if isinstance(cols, str):
             cols = [cols]
-        n = num_partitions or self.num_partitions()
-        big = pd.concat(shards, ignore_index=False)
-        codes = pd.util.hash_pandas_object(big[cols], index=False).to_numpy() % n
-        return HostXShards([big[codes == i] for i in range(n)])
+        n = num_partitions or n_in
+
+        def split_one(s):
+            codes = pd.util.hash_pandas_object(
+                s[cols], index=False).to_numpy() % n
+            return [s[codes == i] for i in range(n)]
+
+        buckets: List[List[Any]] = [[] for _ in range(n)]
+        for parts in _map_shards(split_one, n_in, self._store.get,
+                                 "partition_by"):
+            for i, p in enumerate(parts):
+                buckets[i].append(p)
+        return HostXShards(
+            pd.concat(b, ignore_index=False) if len(b) != 1 else b[0]
+            for b in buckets)
 
     def unique(self) -> np.ndarray:
         """Distinct elements over series/array shards (ref shard.py:341-358)."""
@@ -254,15 +451,22 @@ class HostXShards(XShards):
 
     def zip(self, other: "HostXShards") -> "HostXShards":
         """Pairwise zip; requires equal partition counts and lengths
-        (ref shard.py:389-411)."""
+        (ref shard.py:389-411). The result is transient: the pairs are views
+        of shards the parent stores already own — re-spilling them under a
+        disk tier would double the spill footprint."""
         assert isinstance(other, HostXShards)
         assert self.num_partitions() == other.num_partitions(), \
             "XShards.zip: partition counts differ"
-        a, b = self.collect(), other.collect()
-        for x, y in zip(a, b):
-            if hasattr(x, "__len__") and hasattr(y, "__len__"):
-                assert len(x) == len(y), "XShards.zip: shard lengths differ"
-        return HostXShards(list(zip(a, b)))
+
+        def pairs():
+            for i in range(self.num_partitions()):
+                x, y = self._store.get(i), other._store.get(i)
+                if hasattr(x, "__len__") and hasattr(y, "__len__"):
+                    assert len(x) == len(y), \
+                        "XShards.zip: shard lengths differ"
+                yield (x, y)
+
+        return HostXShards(pairs(), transient=True)
 
     # -- misc --
     def __len__(self):
@@ -285,7 +489,7 @@ class HostXShards(XShards):
             if isinstance(data, dict) or _is_dataframe(data):
                 return data[key]
             raise KeyError(f"cannot index shard of type {type(data)}")
-        return HostXShards([get_data(s) for s in self._iter_shards()],
+        return HostXShards((get_data(s) for s in self._iter_shards()),
                            transient=True)
 
     def save_pickle(self, path: str, batchSize: int = 10) -> "HostXShards":

@@ -647,6 +647,13 @@ class JaxEstimator:
 
         import jax
 
+        if getattr(ds, "x", None) is None:
+            # streaming datasets hold no whole-dataset tensors to derive
+            # avals from (x is None; tree_map would silently produce None
+            # avals and warm a step that crashes on them) — the hot loop's
+            # plain jit path handles the first window instead
+            logger.debug("step precompile skipped: streaming dataset")
+            return None
         compile_ahead.configure_persistent_cache()
         bs = int(batch_size)
 

@@ -1063,6 +1063,111 @@ def measure_widedeep_train():
             "widedeep_train_step_ms": round(dt * 1e3, 2)}
 
 
+# Friesian recsys data-plane pipeline shapes (shrunk by the smoke path):
+# raw interactions with string codes → index fit + encode → hist-seq →
+# negative sampling → crossed cols → pad/mask → streaming feed → NCF fit
+RECSYS_ROWS = 40_000
+RECSYS_SHARDS = 8
+RECSYS_USERS = 600
+RECSYS_ITEMS = 300
+RECSYS_SEQ = 8
+RECSYS_BATCH = 1024
+RECSYS_EPOCHS = 1
+
+
+def _recsys_raw_df():
+    import numpy as np
+    import pandas as pd
+    rng = np.random.default_rng(11)
+    u = rng.integers(0, RECSYS_USERS, RECSYS_ROWS)
+    i = rng.integers(0, RECSYS_ITEMS, RECSYS_ROWS)
+    return pd.DataFrame({
+        "user_code": np.char.add("u", u.astype(str)),
+        "item_code": np.char.add("i", i.astype(str)),
+        "time": rng.integers(0, 100_000, RECSYS_ROWS),
+    })
+
+
+def _recsys_transforms(df):
+    """The Friesian transform chain, returning the feed-ready table."""
+    from analytics_zoo_tpu.friesian.feature import FeatureTable
+    t = FeatureTable.from_pandas(df, RECSYS_SHARDS)
+    indices = t.gen_string_idx(["user_code", "item_code"])
+    t = t.encode_string(["user_code", "item_code"], indices)
+    t = t.rename({"user_code": "user", "item_code": "item"})
+    t = t.add_hist_seq("user", ["item"], sort_col="time",
+                       min_len=1, max_len=RECSYS_SEQ)
+    t = t.add_negative_samples(item_size=RECSYS_ITEMS, item_col="item",
+                               neg_num=1)
+    t = t.cross_columns([["user", "item"]], [100])
+    t = t.mask_pad(padding_cols=["item_hist_seq"],
+                   mask_cols=["item_hist_seq"], seq_len=RECSYS_SEQ)
+    t = t.add_length("item_hist_seq")
+    return t.merge_cols(["user", "item"], "features")
+
+
+def measure_recsys_pipeline() -> dict:
+    """End-to-end Friesian pipeline samples/s, DATA TIME INCLUDED —
+    the ISSUE 12 gate for the parallel vectorized data plane.
+
+    The transform chain runs once under the legacy row-wise serial mode
+    (``ZOO_DATA_VECTORIZE=0 ZOO_DATA_WORKERS=0``) and once under the
+    vectorized pooled default; ``friesian_transform_speedup`` is
+    legacy-time / chosen-time where the *faster* mode feeds the pipeline
+    (never-slower dispatch: >= 1.0 by construction, so the higher-better
+    gate flags any round where the fast path stops winning).
+    ``recsys_pipeline_samples_per_sec`` counts the full wall — chosen
+    transforms + streaming windows + NCF fit with the fused
+    embedding-bag lookups."""
+    from analytics_zoo_tpu import init_orca_context
+    from analytics_zoo_tpu.learn.optimizers import Adam
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+
+    init_orca_context(cluster_mode="local")
+    df = _recsys_raw_df()
+    legacy_env = {"ZOO_DATA_VECTORIZE": "0", "ZOO_DATA_WORKERS": "0"}
+    saved = {k: os.environ.get(k) for k in legacy_env}
+    os.environ.update(legacy_env)
+    try:
+        t0 = time.perf_counter()
+        table_legacy = _recsys_transforms(df)
+        t_legacy = time.perf_counter() - t0
+    finally:
+        for k, v in saved.items():
+            os.environ.pop(k, None) if v is None else \
+                os.environ.__setitem__(k, v)
+    t0 = time.perf_counter()
+    table_fast = _recsys_transforms(df)
+    t_fast = time.perf_counter() - t0
+
+    use_fast = t_fast <= t_legacy
+    t_chosen = t_fast if use_fast else t_legacy
+    table = table_fast if use_fast else table_legacy
+
+    ds = table.to_streaming_dataset(["features"], "label",
+                                    prefetch_depth=2)
+    ncf = NeuralCF(user_count=RECSYS_USERS, item_count=RECSYS_ITEMS,
+                   class_num=2, user_embed=16, item_embed=16,
+                   hidden_layers=(32, 16), include_mf=True, mf_embed=16)
+    ncf.compile(optimizer=Adam(1e-3),
+                loss="sparse_categorical_crossentropy")
+    est = ncf.model._ensure_estimator(for_training=True)
+    t0 = time.perf_counter()
+    est.fit(ds, epochs=RECSYS_EPOCHS, batch_size=RECSYS_BATCH)
+    dt_fit = time.perf_counter() - t0
+    samples = ds.n * RECSYS_EPOCHS
+    return {
+        "recsys_pipeline_samples_per_sec":
+            round(samples / (t_chosen + dt_fit), 1),
+        "friesian_transform_speedup": round(t_legacy / t_chosen, 3),
+        "recsys_transform_mode":
+            "vectorized-parallel" if use_fast else "legacy-serial",
+        "recsys_transform_seconds": round(t_chosen, 3),
+        "recsys_transform_legacy_seconds": round(t_legacy, 3),
+        "recsys_pipeline_rows": int(ds.n),
+    }
+
+
 def _cpu_fallback_line(wedge_note: str, timeout_s: float = 2400.0):
     """The wedged backend init holds jax's global backend lock, so no
     fallback is possible IN-PROCESS — but a fresh subprocess with
@@ -1404,7 +1509,7 @@ def _cpu_emit():
         pass
     print(json.dumps(_assemble_record(
         out, (measure_tcn, measure_serving, measure_serving_failover,
-              measure_serving_priority))))
+              measure_serving_priority, measure_recsys_pipeline))))
 
 
 def _device_watchdog(timeout_s: float = 180.0):
@@ -1445,11 +1550,16 @@ def _smoke():
     global N_ROWS, BATCH, WARMUP_STEPS, MEASURE_STEPS, STEPS_PER_LOOP
     global SERVE_N, SERVE_BATCH, SERVE_HIDDEN, SERVE_WINDOW, SERVE_REPS
     global PRIO_FLOOD, PRIO_INT
+    global RECSYS_ROWS, RECSYS_SHARDS, RECSYS_USERS, RECSYS_ITEMS
+    global RECSYS_BATCH
     N_ROWS, BATCH = 2048, 256
     WARMUP_STEPS, MEASURE_STEPS, STEPS_PER_LOOP = 2, 4, 2
     SERVE_N, SERVE_BATCH, SERVE_HIDDEN = 64, 8, 32
     SERVE_WINDOW, SERVE_REPS = 2, 1
     PRIO_FLOOD, PRIO_INT = 96, 12
+    RECSYS_ROWS, RECSYS_SHARDS = 1500, 4
+    RECSYS_USERS, RECSYS_ITEMS = 60, 40
+    RECSYS_BATCH = 128
     out = {
         "metric": "ncf_train_samples_per_sec",
         "value": 0.0, "unit": "samples/s", "vs_baseline": 0.0,
@@ -1459,7 +1569,8 @@ def _smoke():
     rec = _assemble_record(out, (measure_serving, measure_serving_failover,
                                  measure_serving_multi_replica,
                                  measure_replica_kill_failover,
-                                 measure_serving_priority))
+                                 measure_serving_priority,
+                                 measure_recsys_pipeline))
     if fr is not None:
         # armed smoke leaves the artifact the CI lane asserts on
         fr.note("smoke complete")
@@ -1503,7 +1614,7 @@ def main():
               measure_replica_kill_failover, measure_serving_priority,
               measure_flash_attention,
               measure_int8_predict, measure_resnet50_train,
-              measure_widedeep_train),
+              measure_widedeep_train, measure_recsys_pipeline),
         deadline_s=float(os.environ.get("BENCH_DEADLINE_S", 2700)))
 
 

@@ -47,10 +47,30 @@ case "$lane" in
   core)     run tests/test_context.py tests/test_estimator.py \
                 tests/test_estimator_edge.py tests/test_estimator_factories.py \
                 tests/test_attention.py tests/test_pipeline.py tests/test_moe.py ;;
+  # data plane (ISSUE 12): pooled shard executor, vectorized Friesian
+  # kernels with bitwise legacy parity, tiered bounded-residency
+  # pipeline, streaming prefetch — then a tiny recsys pipeline measure
+  # gating the never-slower transform dispatch (docs/data_plane.md)
   data)     run tests/test_data.py tests/test_native_store.py \
                 tests/test_feature.py tests/test_friesian.py \
+                tests/test_friesian_parity.py tests/test_data_plane.py \
                 tests/test_image3d_parquet.py tests/test_elastic_search.py \
-                tests/test_tfrecord.py ;;
+                tests/test_tfrecord.py
+            echo "== recsys pipeline smoke (never-slower transform dispatch)"
+            JAX_PLATFORMS=cpu python - <<'PY'
+import bench
+bench.RECSYS_ROWS, bench.RECSYS_SHARDS = 1500, 4
+bench.RECSYS_USERS, bench.RECSYS_ITEMS = 60, 40
+bench.RECSYS_BATCH = 128
+out = bench.measure_recsys_pipeline()
+assert out["recsys_pipeline_samples_per_sec"] > 0, out
+assert out["friesian_transform_speedup"] >= 1.0, out
+print(f"recsys OK: {out['recsys_pipeline_samples_per_sec']} samples/s "
+      f"(data included), transform speedup "
+      f"{out['friesian_transform_speedup']}x "
+      f"[{out['recsys_transform_mode']}]")
+PY
+            ;;
   keras)    run tests/test_keras.py tests/test_keras_layers_golden.py \
                 tests/test_keras2_multihost.py tests/test_nnframes_autograd.py ;;
   models)   run tests/test_model_zoo.py tests/test_recommendation.py \

@@ -210,16 +210,20 @@ def test_smoke_mode_embeds_telemetry_snapshot(tiny_bench, monkeypatch,
         telemetry.get_tracer().record("bench-uri", "serve", 0.0, 0.01)
         return {"serving_records_per_sec": 5.0}
 
-    # SERVE_* restored by monkeypatch even though _smoke assigns globals
+    # SERVE_*/RECSYS_* restored by monkeypatch even though _smoke assigns
+    # globals
     for k in ("SERVE_N", "SERVE_BATCH", "SERVE_HIDDEN", "SERVE_WINDOW",
-              "SERVE_REPS"):
+              "SERVE_REPS", "RECSYS_ROWS", "RECSYS_SHARDS", "RECSYS_USERS",
+              "RECSYS_ITEMS", "RECSYS_BATCH"):
         monkeypatch.setattr(bench, k, getattr(bench, k))
     monkeypatch.setattr(bench, "measure_ncf", fake_ncf)
     monkeypatch.setattr(bench, "measure_serving", fake_serving)
     # the replica drills spawn subprocess fleets — covered by
-    # test_multi_replica.py and the chaos lane, stubbed out here
+    # test_multi_replica.py and the chaos lane, stubbed out here; the
+    # recsys pipeline measure has its own focused test below
     for heavy in ("measure_serving_failover", "measure_serving_multi_replica",
-                  "measure_replica_kill_failover"):
+                  "measure_replica_kill_failover",
+                  "measure_recsys_pipeline"):
         monkeypatch.setattr(bench, heavy, lambda: {})
     bench._smoke()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
@@ -282,6 +286,26 @@ def test_measure_widedeep_train(tiny_bench, orca_ctx, monkeypatch):
         embed_in=(5, 7), embed_out=(3, 4), n_continuous=2))
     out = bench.measure_widedeep_train()
     assert out["widedeep_train_samples_per_sec"] > 0
+
+
+def test_measure_recsys_pipeline(tiny_bench, orca_ctx, monkeypatch):
+    """ISSUE 12 gate: full Friesian data plane → streaming feed → NCF fit,
+    data time included, with the never-slower transform dispatch."""
+    bench = tiny_bench
+    monkeypatch.setattr(bench, "RECSYS_ROWS", 1200)
+    monkeypatch.setattr(bench, "RECSYS_SHARDS", 4)
+    monkeypatch.setattr(bench, "RECSYS_USERS", 50)
+    monkeypatch.setattr(bench, "RECSYS_ITEMS", 40)
+    monkeypatch.setattr(bench, "RECSYS_BATCH", 128)
+    out = bench.measure_recsys_pipeline()
+    assert out["recsys_pipeline_samples_per_sec"] > 0
+    assert out["recsys_pipeline_rows"] > 0
+    # never-slower dispatch: the higher-better *_speedup gate metric can
+    # never sit below par — the pipeline runs whichever mode measured
+    # faster
+    assert out["friesian_transform_speedup"] >= 1.0
+    assert out["recsys_transform_mode"] in ("vectorized-parallel",
+                                            "legacy-serial")
 
 
 def test_run_with_deadline_early_cpu_fallback_when_sanity_stalls(

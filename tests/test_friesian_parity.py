@@ -1,0 +1,294 @@
+"""Bitwise parity: vectorized/parallel data plane vs the legacy path.
+
+Every fast body in friesian/feature/table.py is gated on
+``ZOO_DATA_VECTORIZE`` and claims to reproduce the legacy row-wise output
+*bitwise* (values and dtypes). These tests run each transform twice — once
+under ``ZOO_DATA_VECTORIZE=0 ZOO_DATA_WORKERS=0`` (legacy kernels, serial
+executor) and once under the fast/parallel default — and compare cell for
+cell, including the documented edge cases: the empty-history-in-a-
+nested-column flat-pad quirk, seq_len truncation of nested lists, int64
+mask dtype stability, and ``_shard_seed`` RNG reproducibility across
+executor modes.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu.friesian.feature import FeatureTable
+
+LEGACY = {"ZOO_DATA_VECTORIZE": "0", "ZOO_DATA_WORKERS": "0"}
+FAST = {"ZOO_DATA_VECTORIZE": "1", "ZOO_DATA_WORKERS": "4"}
+
+
+def _under(env, fn):
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        return fn()
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _both(build):
+    """Run ``build`` under the legacy and the fast env; return both."""
+    return _under(LEGACY, build), _under(FAST, build)
+
+
+def assert_cells_equal(a: pd.DataFrame, b: pd.DataFrame):
+    """Cell-wise bitwise comparison tolerant of list-vs-ndarray packaging
+    (fast pad/mask emit ndarray rows, legacy emits lists — by design)."""
+    assert list(a.columns) == list(b.columns)
+    assert len(a) == len(b)
+    for c in a.columns:
+        for i, (x, y) in enumerate(zip(a[c].tolist(), b[c].tolist())):
+            xa, ya = np.asarray(x), np.asarray(y)
+            assert xa.shape == ya.shape, (c, i, x, y)
+            assert xa.dtype == ya.dtype, (c, i, xa.dtype, ya.dtype)
+            assert np.array_equal(xa, ya), (c, i, x, y)
+
+
+def hist_df():
+    return pd.DataFrame({
+        "user": [1, 1, 2, 2, 3, 3],
+        "flat": [[1, 2], [3], [], [4, 5, 6, 7, 8], [9], []],
+        "nested": [[[1, 2], [3, 4]], [[5, 6]], [],
+                   [[7, 8], [9, 10], [11, 12]], [[13, 14]], []],
+    })
+
+
+# ------------------------------------------------------------- pad / mask
+
+def test_pad_parity_flat_and_nested():
+    def build():
+        t = FeatureTable.from_pandas(hist_df(), 3)
+        return t.pad(["flat", "nested"], seq_len=4).to_pandas()
+    legacy, fast = _both(build)
+    assert_cells_equal(legacy, fast)
+    # the quirk: an empty cell in the *nested* column pads flat, not
+    # (seq_len, inner) — both paths must keep it
+    for df in (legacy, fast):
+        empty = df[df["user"] == 2].iloc[0]["nested"]
+        assert np.asarray(empty).shape == (4,)
+        assert np.asarray(empty).tolist() == [0, 0, 0, 0]
+
+
+def test_pad_parity_truncates_nested_lists():
+    def build():
+        t = FeatureTable.from_pandas(hist_df(), 2)
+        return t.pad(["flat", "nested"], seq_len=2).to_pandas()
+    legacy, fast = _both(build)
+    assert_cells_equal(legacy, fast)
+    long_nested = fast[fast["user"] == 2].iloc[1]["nested"]
+    assert np.asarray(long_nested).shape == (2, 2)
+    assert np.asarray(long_nested).tolist() == [[7, 8], [9, 10]]
+    long_flat = fast[fast["user"] == 2].iloc[1]["flat"]
+    assert np.asarray(long_flat).tolist() == [4, 5]
+
+
+def test_pad_ragged_inner_falls_back_rowwise():
+    # ragged inner widths can't rectangular-fill; both paths must agree
+    df = pd.DataFrame({"h": [[[1, 2], [3]], [[4]], []]})
+
+    def build():
+        return FeatureTable.from_pandas(df, 1).pad("h", 3).to_pandas()
+    legacy, fast = _both(build)
+    for x, y in zip(legacy["h"], fast["h"]):
+        assert [list(map(int, np.atleast_1d(r))) if hasattr(r, "__len__")
+                else r for r in x] == \
+               [list(map(int, np.atleast_1d(r))) if hasattr(r, "__len__")
+                else r for r in y]
+
+
+def test_mask_parity_and_int64_dtype():
+    def build():
+        t = FeatureTable.from_pandas(hist_df(), 3)
+        return t.mask(["flat", "nested"], seq_len=3).to_pandas()
+    legacy, fast = _both(build)
+    assert_cells_equal(legacy, fast)
+    for df in (legacy, fast):
+        for cell in df["flat_mask"]:
+            assert np.asarray(cell).dtype == np.int64
+    assert np.asarray(fast["flat_mask"].iloc[3]).tolist() == [1, 1, 1]
+    assert np.asarray(fast["flat_mask"].iloc[2]).tolist() == [0, 0, 0]
+
+
+def test_mask_pad_and_add_length_parity():
+    def build():
+        t = FeatureTable.from_pandas(hist_df(), 2)
+        t = t.mask_pad(padding_cols=["flat"], mask_cols=["flat"], seq_len=4)
+        return t.add_length("nested").to_pandas()
+    legacy, fast = _both(build)
+    assert_cells_equal(legacy, fast)
+    assert fast["nested_length"].tolist() == [2, 1, 0, 3, 1, 0]
+    assert fast["nested_length"].dtype == np.int64
+
+
+# ---------------------------------------------------------- add_feature
+
+def test_add_feature_parity_scalar_list_mixed():
+    df = pd.DataFrame({"item": [1, 2, 3],
+                       "hist": [[1, 2], [2, 9], []]})
+    lk = pd.DataFrame({"item": [1, 2, 3], "cat": [7, 8, 9]})
+
+    def build():
+        t = FeatureTable.from_pandas(df, 2)
+        lookup = FeatureTable.from_pandas(lk, 1)
+        return t.add_feature(["item", "hist"], lookup,
+                             default_value=0).to_pandas()
+    legacy, fast = _both(build)
+    assert_cells_equal(legacy, fast)
+    assert fast["item_feature"].tolist() == [7, 8, 9]
+    # unseen key 9 -> default 0; empty history -> empty feature list
+    assert fast["hist_feature"].tolist() == [[7, 8], [8, 0], []]
+
+
+def test_add_feature_duplicate_keys_last_wins():
+    df = pd.DataFrame({"item": [1, 1, 2]})
+    lk = pd.DataFrame({"item": [1, 2, 1], "cat": [7, 8, 70]})
+
+    def build():
+        t = FeatureTable.from_pandas(df, 1)
+        lookup = FeatureTable.from_pandas(lk, 1)
+        return t.add_feature(["item"], lookup, default_value=-1).to_pandas()
+    legacy, fast = _both(build)
+    assert_cells_equal(legacy, fast)
+    assert fast["item_feature"].tolist() == [70, 70, 8]
+
+
+# ----------------------------------------------- aggregations (map-reduce)
+
+def cat_df():
+    return pd.DataFrame({
+        "user": np.arange(12),
+        "price": [1.0, np.nan, 3.0, 4.0, 5.0, np.nan,
+                  2.0, 8.0, 1.5, 0.5, 7.0, 6.0],
+        "cat": ["a", "b", "a", "c", "a", None, "b", "c", "d", "b", "a", "d"],
+    })
+
+
+def test_gen_string_idx_parity_including_ties():
+    def build():
+        t = FeatureTable.from_pandas(cat_df(), 3)
+        [idx] = t.gen_string_idx("cat")
+        return idx.to_dict()
+    legacy, fast = _both(build)
+    # "b" (3) vs "c"/"d" (2 each): exact id assignment must match, ties
+    # broken by first appearance in both paths
+    assert legacy == fast
+    assert fast["a"] == 1
+
+    def build_limited():
+        t = FeatureTable.from_pandas(cat_df(), 3)
+        [idx] = t.gen_string_idx("cat", freq_limit=3)
+        return idx.to_dict()
+    legacy, fast = _both(build_limited)
+    assert legacy == fast == {"a": 1, "b": 2}
+
+
+def test_normalize_median_distinct_size_parity():
+    def build():
+        t = FeatureTable.from_pandas(cat_df(), 3)
+        normed = t.fill_median("price").normalize(["price"]).to_pandas()
+        med = t.median("price").to_pandas()
+        dup = FeatureTable.from_pandas(
+            pd.concat([cat_df(), cat_df()], ignore_index=True), 4)
+        return (normed["price"].to_numpy(), med["median"].iloc[0],
+                dup.distinct().size(), t.size())
+    legacy, fast = _both(build)
+    np.testing.assert_array_equal(legacy[0], fast[0])
+    assert legacy[1] == fast[1]
+    assert legacy[2] == fast[2] == 12
+    assert legacy[3] == fast[3] == 12
+
+
+def test_add_hist_seq_parity():
+    df = pd.DataFrame({
+        "user": [1, 1, 1, 2, 2, 3, 3, 3, 3],
+        "item": [10, 11, 12, 10, 13, 11, 14, 15, 16],
+        "time": [1, 2, 3, 1, 2, 1, 2, 3, 4],
+    })
+
+    def canon(out):
+        out = out.sort_values(["user", "time"]).reset_index(drop=True)
+        out["item_hist_seq"] = out["item_hist_seq"].map(list)
+        return out
+
+    def build():
+        t = FeatureTable.from_pandas(df, 3)
+        return canon(t.add_hist_seq("user", ["item"], sort_col="time",
+                                    min_len=1, max_len=2).to_pandas())
+    legacy, fast = _both(build)
+    # the fast path reshuffles by user, so compare canonicalized content
+    assert_cells_equal(legacy, fast)
+    assert fast[fast["user"] == 3]["item_hist_seq"].tolist() == \
+        [[11], [11, 14], [14, 15]]
+
+
+# ------------------------------------------------------------ arrays + rng
+
+def test_to_sharded_arrays_parity():
+    df = pd.DataFrame({"user": np.arange(8), "item": np.arange(8) * 2,
+                       "label": [0, 1] * 4})
+
+    def build():
+        t = FeatureTable.from_pandas(df, 3)
+        return t.to_sharded_arrays(["user", "item"], "label").collect()
+    legacy, fast = _both(build)
+    assert len(legacy) == len(fast)
+    for a, b in zip(legacy, fast):
+        assert len(a["x"]) == len(b["x"]) == 2
+        for xa, xb in zip(a["x"], b["x"]):
+            assert xa.dtype == xb.dtype
+            np.testing.assert_array_equal(xa, xb)
+        assert a["y"].dtype == b["y"].dtype
+        np.testing.assert_array_equal(a["y"], b["y"])
+
+
+def test_negative_sampling_reproducible_across_executors():
+    df = pd.DataFrame({"user": np.arange(20) % 5,
+                       "item": (np.arange(20) * 3) % 50 + 1})
+
+    def build():
+        t = FeatureTable.from_pandas(df, 4)
+        return t.add_negative_samples(item_size=50, neg_num=2).to_pandas()
+    legacy, fast = _both(build)
+    # _shard_seed depends only on shard content: serial-legacy, parallel,
+    # and a parallel rerun must all draw identical negatives in order
+    fast2 = _under(FAST, build)
+    pd.testing.assert_frame_equal(legacy, fast)
+    pd.testing.assert_frame_equal(fast, fast2)
+
+
+def test_add_neg_hist_seq_reproducible_across_executors():
+    df = pd.DataFrame({
+        "user": [1, 1, 1, 2, 2],
+        "item": [10, 11, 12, 10, 13],
+        "time": [1, 2, 3, 1, 2],
+    })
+
+    def build():
+        t = FeatureTable.from_pandas(df, 2)
+        out = t.add_hist_seq("user", ["item"], min_len=1, max_len=4)
+        out = out.add_neg_hist_seq(30, "item_hist_seq", neg_num=2)
+        d = out.to_pandas().sort_values(["user", "time"]
+                                        ).reset_index(drop=True)
+        d["item_hist_seq"] = d["item_hist_seq"].map(list)
+        d["neg_item_hist_seq"] = d["neg_item_hist_seq"].map(
+            lambda nn: [list(n) for n in nn])
+        return d
+    legacy, fast = _both(build)
+    fast2 = _under(FAST, build)
+    assert fast.equals(fast2)
+    # neg draws are seeded from shard content; the reshuffling fast
+    # add_hist_seq regroups rows into different shards, so only shape
+    # invariants (not draws) are comparable across modes
+    for d in (legacy, fast):
+        assert all(len(nn) == 2 for nn in d["neg_item_hist_seq"])
+        assert all(len(nn[0]) == len(h) for nn, h in
+                   zip(d["neg_item_hist_seq"], d["item_hist_seq"]))

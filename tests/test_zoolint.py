@@ -36,6 +36,7 @@ def test_rule_registry_complete():
         "jit-compile-in-serve-loop",
         "engine-unlocked-write", "lock-order",
         "metric-undocumented", "metric-undeclared", "envvar-undocumented",
+        "rowwise-map-in-data-plane",
     }
     for rid, rule in rules.items():
         assert rule.id == rid
@@ -330,6 +331,68 @@ def test_lock_order_inversion():
     assert _scan(src_consistent, "mod.py") == []
 
 
+# --------------------------------------------------- rowwise in data plane
+
+def test_rowwise_map_flagged_in_data_plane():
+    src = """
+    def pad(d, seq_len):
+        d["h"] = d["h"].map(lambda h: list(h)[:seq_len])
+        return d
+    """
+    (f,) = _scan(src, "analytics_zoo_tpu/data/mod.py")
+    assert f.rule == "rowwise-map-in-data-plane"
+    assert f.line == 3
+    # friesian/ is the other data-plane tree
+    (f,) = _scan(src, "analytics_zoo_tpu/friesian/feature/mod.py")
+    assert f.rule == "rowwise-map-in-data-plane"
+
+
+def test_rowwise_nested_def_and_apply_axis1_flagged():
+    src = """
+    def xform(d):
+        def pad_one(h):
+            return list(h) + [0]
+        d["h"] = d["h"].map(pad_one)
+        d["t"] = d.apply(lambda r: sum(r.values), axis=1)
+        d["u"] = d.apply(lambda r: sum(r.values), axis="columns")
+        return d
+    """
+    fs = _scan(src, "analytics_zoo_tpu/data/mod.py")
+    assert [f.rule for f in fs] == ["rowwise-map-in-data-plane"] * 3
+
+
+def test_rowwise_dict_param_and_axis0_not_flagged():
+    src = """
+    def xform(d, func, mapping):
+        d["e"] = d["e"].map(mapping)       # param: udf seam, caller's call
+        d["f"] = d["f"].map({1: 2})        # dict map: vectorized lookup
+        d["g"] = d["g"].map(len)           # builtin, not a nested def
+        d["s"] = d.apply(sum)              # column-wise apply
+        return d
+    """
+    assert _scan(src, "analytics_zoo_tpu/data/mod.py") == []
+
+
+def test_rowwise_silent_outside_data_plane():
+    src = """
+    def pad(d, seq_len):
+        d["h"] = d["h"].map(lambda h: list(h)[:seq_len])
+        return d
+    """
+    assert _scan(src, "analytics_zoo_tpu/zouwu/mod.py") == []
+    assert _scan(src, "analytics_zoo_tpu/serving/mod.py") == []
+
+
+def test_rowwise_inline_suppression():
+    src = """
+    def pad(d, seq_len):
+        d["h"] = d["h"].map(  # zoolint: disable=rowwise-map-in-data-plane
+            lambda h: list(h))
+        return d
+    """
+    assert _scan(src, "analytics_zoo_tpu/data/mod.py") == []
+
+
 # ---------------------------------------------------------- suppressions
 
 def test_line_suppression_bare_and_named():
@@ -461,6 +524,7 @@ def test_seeded_fixture_trips_every_family():
         "jit-compile-in-serve-loop",
         "engine-unlocked-write", "lock-order",
         "metric-undocumented", "envvar-undocumented",
+        "rowwise-map-in-data-plane",
     }
     # and the suppressed half of the fixture stays quiet
     sup = [f for f in findings
