@@ -1,16 +1,21 @@
 """zoolint — AST-based, JAX-aware static analysis for this codebase's
-real failure modes (ISSUE 4 tentpole). Rule catalog: docs/zoolint.md.
+real failure modes. Rule catalog: docs/zoolint.md; thread-ownership map:
+docs/concurrency.md (regenerate with ``--ownership-report``).
 
-Four rule families:
+Five rule families:
 
 - **hot-path sync** (`wallclock-hotpath`, `hotpath-host-sync`) — wall-
   clock timing and implicit host↔device syncs in the serve/dispatch/train
   inner loops under serving/, common/, learn/;
 - **recompile hazard** (`jit-in-loop`, `jit-call-inline`,
   `jit-static-unhashable`) — jit constructions that silently recompile;
-- **concurrency** (`engine-unlocked-write`, `lock-order`) — unlocked
-  cross-thread attribute writes in Thread-spawning classes, ABBA lock
-  inversions;
+- **concurrency, per-file** (`engine-unlocked-write`, `lock-order`) —
+  unlocked cross-thread attribute writes in Thread-spawning classes,
+  same-file ABBA lock inversions;
+- **concurrency, whole-program** (`cross-thread-unlocked-state`,
+  `lock-order-inversion`, `blocking-under-lock`, `thread-leak`) — a
+  project-wide call graph with thread-root inference and runs-on
+  propagation catches races, inversions, and leaks that span modules;
 - **catalog drift** (`metric-undocumented`, `metric-undeclared`,
   `envvar-undocumented`) — code vs docs/observability.md agreement.
 
@@ -21,11 +26,12 @@ finding in place with ``# zoolint: disable=RULE`` (or grandfather it in
 
 from analytics_zoo_tpu.analysis.core import (  # noqa: F401
     Finding, Rule, all_rules, analyze_paths, analyze_source,
-    find_repo_root,
+    build_model_for_paths, build_project, find_repo_root,
 )
 from analytics_zoo_tpu.analysis.rules_catalog import (  # noqa: F401
     catalog_drift,
 )
 
 __all__ = ["Finding", "Rule", "all_rules", "analyze_paths",
-           "analyze_source", "catalog_drift", "find_repo_root"]
+           "analyze_source", "build_model_for_paths", "build_project",
+           "catalog_drift", "find_repo_root"]

@@ -3,11 +3,15 @@
 A finding the team decides to live with (with a one-line justification)
 goes in ``dev/zoolint-baseline.json`` instead of an inline suppression —
 the source line stays clean and the debt is inventoried in one reviewable
-place. Fingerprints hash the rule id, the repo-relative path and the
-*normalized source-line text* (plus an occurrence index for duplicates) —
-NOT the line number — so edits elsewhere in a file never invalidate the
-baseline, while any edit to the offending line itself retires the entry
-(the finding resurfaces and must be re-justified or fixed).
+place. Version-2 fingerprints hash the rule id, the repo-relative path
+and the *normalized statement text* (continuation lines joined, comments
+stripped, whitespace collapsed, plus an occurrence index for duplicates)
+— NOT the line number and NOT the raw wrapping — so edits elsewhere in a
+file, and even re-wrapping the offending statement across lines, never
+invalidate the baseline, while any semantic edit to the statement itself
+retires the entry (the finding resurfaces and must be re-justified or
+fixed). Version-1 files (single raw-line fingerprints) are upgraded in
+place with ``--migrate-baseline``.
 """
 
 from __future__ import annotations
@@ -19,32 +23,124 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 from analytics_zoo_tpu.analysis.core import Finding
 
-BASELINE_VERSION = 1
+BASELINE_VERSION = 2
 #: default location, relative to the repo root
 DEFAULT_BASELINE = os.path.join("dev", "zoolint-baseline.json")
 
-
-def _line_text(root: Optional[str], finding: Finding) -> str:
+def _read_lines(root: Optional[str], finding: Finding,
+                cache: Dict[str, List[str]]) -> List[str]:
     path = finding.path
     if root is not None and not os.path.isabs(path):
         path = os.path.join(root, path)
+    cached = cache.get(path)
+    if cached is not None:
+        return cached
     try:
         with open(path, "r", encoding="utf-8", errors="replace") as fh:
             lines = fh.read().splitlines()
+    except OSError:
+        lines = []
+    cache[path] = lines
+    return lines
+
+
+def _line_text(root: Optional[str], finding: Finding,
+               cache: Dict[str, List[str]]) -> str:
+    """Version-1 fingerprint text: the raw stripped source line."""
+    lines = _read_lines(root, finding, cache)
+    try:
         return lines[finding.line - 1].strip()
-    except (OSError, IndexError):
+    except IndexError:
         return ""
 
 
-def fingerprints(findings: Iterable[Finding],
-                 root: Optional[str]) -> List[Tuple[Finding, str]]:
-    """Stable fingerprint per finding. Identical (rule, path, line-text)
+def _strip_comment(line: str) -> str:
+    """Drop a trailing ``#`` comment, respecting string literals (a naive
+    quote-state scan — good enough for fingerprint normalization; an
+    f-string with a quoted ``#`` inside a format spec is vanishingly rare
+    on a *flagged* line, and mis-stripping only widens the fingerprint)."""
+    quote = ""
+    i = 0
+    while i < len(line):
+        c = line[i]
+        if quote:
+            if c == "\\":
+                i += 2
+                continue
+            if line.startswith(quote, i):
+                i += len(quote)
+                quote = ""
+                continue
+        elif c in "\"'":
+            quote = line[i:i + 3] if line.startswith(c * 3, i) else c
+            i += len(quote)
+            continue
+        elif c == "#":
+            return line[:i]
+        i += 1
+    return line
+
+
+def _stmt_text(root: Optional[str], finding: Finding,
+               cache: Dict[str, List[str]]) -> str:
+    """Version-2 fingerprint text: the whole logical statement starting
+    at the finding's line — physical lines joined while brackets stay
+    open or a backslash continuation is pending — with comments stripped
+    and whitespace runs collapsed. Re-wrapping the statement over more or
+    fewer lines produces the same text."""
+    lines = _read_lines(root, finding, cache)
+    i = finding.line - 1
+    if i < 0 or i >= len(lines):
+        return ""
+    parts: List[str] = []
+    depth = 0
+    for j in range(i, min(i + 40, len(lines))):
+        line = _strip_comment(lines[j])
+        cont = line.rstrip().endswith("\\")
+        if cont:
+            line = line.rstrip()[:-1]
+        parts.append(line.strip())
+        # bracket depth outside string literals (same naive scan)
+        quote = ""
+        k = 0
+        while k < len(line):
+            c = line[k]
+            if quote:
+                if c == "\\":
+                    k += 2
+                    continue
+                if line.startswith(quote, k):
+                    k += len(quote)
+                    quote = ""
+                    continue
+            elif c in "\"'":
+                quote = line[k:k + 3] if line.startswith(c * 3, k) else c
+                k += len(quote)
+                continue
+            elif c in "([{":
+                depth += 1
+            elif c in ")]}":
+                depth = max(0, depth - 1)
+            k += 1
+        if depth == 0 and not cont:
+            break
+    return " ".join(" ".join(parts).split())
+
+
+def fingerprints(findings: Iterable[Finding], root: Optional[str],
+                 version: int = BASELINE_VERSION
+                 ) -> List[Tuple[Finding, str]]:
+    """Stable fingerprint per finding. Identical (rule, path, text)
     triples get an occurrence counter so N copies of the same offending
-    line need N baseline entries — deleting one resurfaces one."""
+    statement need N baseline entries — deleting one resurfaces one."""
+    text_fn = _line_text if version == 1 else _stmt_text
+    # file cache scoped to this call: callers may edit sources between
+    # fingerprint passes (the round-trip tests do)
+    cache: Dict[str, List[str]] = {}
     counts: Dict[str, int] = {}
     out = []
     for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
-        base = f"{f.rule}\x00{f.path}\x00{_line_text(root, f)}"
+        base = f"{f.rule}\x00{f.path}\x00{text_fn(root, f, cache)}"
         n = counts.get(base, 0)
         counts[base] = n + 1
         digest = hashlib.sha256(
@@ -59,9 +155,15 @@ def load(path: str) -> Dict[str, dict]:
         return {}
     with open(path, "r", encoding="utf-8") as fh:
         data = json.load(fh)
-    if data.get("version") != BASELINE_VERSION:
+    version = data.get("version")
+    if version == 1:
         raise ValueError(
-            f"baseline {path}: unsupported version {data.get('version')!r}")
+            f"baseline {path} uses the version-1 (raw line) fingerprint "
+            f"format — run `python -m analytics_zoo_tpu.analysis "
+            f"--migrate-baseline` once to rewrite it")
+    if version != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path}: unsupported version {version!r}")
     return {e["fingerprint"]: e for e in data.get("entries", ())}
 
 
@@ -92,11 +194,52 @@ def save(path: str, findings: Iterable[Finding], root: Optional[str],
     return len(entries)
 
 
+def migrate(path: str, findings: List[Finding],
+            root: Optional[str]) -> Optional[Tuple[int, List[dict]]]:
+    """One-shot version-1 → version-2 rewrite of the baseline at
+    ``path``. Each current finding is fingerprinted under BOTH schemes;
+    a v1 entry matched by its old fingerprint is rewritten with the new
+    one (justification, message, and line refreshed). Returns
+    ``(migrated_count, dropped_entries)`` — dropped entries matched no
+    current finding (already stale) and are removed — or ``None`` when
+    nothing was rewritten (missing file or already version 2)."""
+    if not os.path.isfile(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    version = data.get("version")
+    if version == BASELINE_VERSION:
+        return None
+    if version != 1:
+        raise ValueError(
+            f"baseline {path}: cannot migrate version {version!r}")
+    old = {e["fingerprint"]: e for e in data.get("entries", ())}
+    entries = []
+    matched = set()
+    pairs = zip(fingerprints(findings, root, version=1),
+                fingerprints(findings, root, version=2))
+    for (f, fp1), (_f, fp2) in pairs:
+        e = old.get(fp1)
+        if e is None:
+            continue
+        matched.add(fp1)
+        entries.append({"fingerprint": fp2, "rule": f.rule, "path": f.path,
+                        "line": f.line, "message": f.message,
+                        "justification": e.get("justification",
+                                               "TODO: justify or fix")})
+    dropped = [e for fp, e in old.items() if fp not in matched]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": BASELINE_VERSION, "entries": entries},
+                  fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    return len(entries), dropped
+
+
 def apply(findings: List[Finding], baseline: Dict[str, dict],
           root: Optional[str]) -> Tuple[List[Finding], List[dict]]:
     """(surviving findings, stale baseline entries). A stale entry's
-    offending line was fixed or edited — it should be deleted from the
-    baseline file (reported as a warning, never a failure)."""
+    offending statement was fixed or edited — it should be deleted from
+    the baseline file (reported as a warning, never a failure)."""
     matched = set()
     out = []
     for f, fp in fingerprints(findings, root):

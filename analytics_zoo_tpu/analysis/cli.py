@@ -1,9 +1,10 @@
 """``python -m analytics_zoo_tpu.analysis`` — the zoolint CLI.
 
 Exit codes: 0 clean (modulo baseline + inline suppressions), 1 findings,
-2 usage/internal error. ``dev/run-tests.sh zoolint`` (and the ``all`` /
-``smoke`` lanes) require exit 0 on the shipped tree and non-zero on
-tests/fixtures/zoolint's seeded violations.
+2 usage error, 3 internal crash (so CI can tell "the tree has findings"
+from "the linter itself broke"). ``dev/run-tests.sh zoolint`` (and the
+``all`` / ``smoke`` lanes) require exit 0 on the shipped tree and
+non-zero on tests/fixtures/zoolint's seeded violations.
 """
 
 from __future__ import annotations
@@ -11,12 +12,14 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import traceback
 from typing import List, Optional
 
 from analytics_zoo_tpu.analysis import baseline as baseline_lib
 from analytics_zoo_tpu.analysis import report
 from analytics_zoo_tpu.analysis.core import (
-    all_rules, analyze_paths, find_repo_root, iter_python_files, relpath,
+    all_rules, analyze_paths, build_model_for_paths, find_repo_root,
+    iter_python_files, relpath,
 )
 
 
@@ -24,16 +27,21 @@ def _parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m analytics_zoo_tpu.analysis",
         description="zoolint: AST-based JAX-aware static analysis "
-                    "(hot-path syncs, recompile hazards, concurrency, "
-                    "catalog drift)")
+                    "(hot-path syncs, recompile hazards, whole-program "
+                    "concurrency, catalog drift)")
     p.add_argument("paths", nargs="*", default=["analytics_zoo_tpu"],
                    help="files/directories to scan "
                         "(default: analytics_zoo_tpu)")
-    p.add_argument("--format", choices=("human", "json"), default="human")
+    p.add_argument("--format", choices=("human", "json", "github"),
+                   default="human",
+                   help="human (default), json (stable schema), or "
+                        "github (workflow-annotation lines)")
     p.add_argument("--rules", metavar="ID[,ID...]",
                    help="run only these rule ids")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
+    p.add_argument("--jobs", type=int, default=0, metavar="N",
+                   help="parse files with N threads (0 = auto)")
     p.add_argument("--baseline", metavar="PATH",
                    help="baseline file (default: <repo>/dev/"
                         "zoolint-baseline.json when it exists)")
@@ -42,16 +50,40 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--write-baseline", action="store_true",
                    help="write the current findings to the baseline "
                         "(preserving surviving justifications) and exit 0")
+    p.add_argument("--migrate-baseline", action="store_true",
+                   help="one-shot rewrite of a version-1 baseline to the "
+                        "line-drift-stable version-2 fingerprints")
+    p.add_argument("--ownership-report", metavar="PATH",
+                   help="write the whole-program thread-ownership map "
+                        "(markdown at PATH, JSON next to it) and exit 0")
     return p
+
+
+def _jobs(args) -> int:
+    if args.jobs > 0:
+        return args.jobs
+    return min(8, os.cpu_count() or 1)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = _parser().parse_args(argv)
+    try:
+        return _run(args)
+    except SystemExit:
+        raise
+    except BaseException:
+        traceback.print_exc()
+        print("zoolint: internal error (exit 3) — this is a linter bug, "
+              "not a finding", file=sys.stderr)
+        return 3
+
+
+def _run(args) -> int:
     rules = all_rules()
     if args.list_rules:
         for rid in sorted(rules):
             r = rules[rid]
-            print(f"{rid:24s} [{r.scope:7s}] {r.description}")
+            print(f"{rid:28s} [{r.scope:7s}] {r.description}")
         return 0
     if args.rules:
         wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
@@ -66,13 +98,40 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"no such path: {p}", file=sys.stderr)
             return 2
     root = find_repo_root(args.paths[0])
-    findings = analyze_paths(args.paths, rules=rules, root=root)
+
+    if args.ownership_report:
+        model = build_model_for_paths(args.paths, root=root,
+                                      jobs=_jobs(args))
+        from analytics_zoo_tpu.analysis import ownership
+        md, js = ownership.write_report(model, args.ownership_report)
+        print(f"ownership report written: {md} + {js} "
+              f"({len(model.roots)} roots)")
+        return 0
+
+    findings = analyze_paths(args.paths, rules=rules, root=root,
+                             jobs=_jobs(args))
 
     baseline_path = args.baseline
     if baseline_path is None and root is not None:
         cand = os.path.join(root, baseline_lib.DEFAULT_BASELINE)
         if os.path.isfile(cand) or args.write_baseline:
             baseline_path = cand
+    if args.migrate_baseline:
+        if baseline_path is None:
+            print("--migrate-baseline needs --baseline or a repo root",
+                  file=sys.stderr)
+            return 2
+        migrated = baseline_lib.migrate(baseline_path, findings, root)
+        if migrated is None:
+            print(f"baseline already version "
+                  f"{baseline_lib.BASELINE_VERSION}: {baseline_path}")
+        else:
+            n, dropped = migrated
+            print(f"baseline migrated: {baseline_path} ({n} entries)")
+            for e in dropped:
+                print(f"  dropped stale entry {e['fingerprint']} "
+                      f"({e['rule']} at {e['path']})")
+        return 0
     if args.write_baseline:
         if baseline_path is None:
             print("--write-baseline needs --baseline or a repo root",
@@ -99,6 +158,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.format == "json":
         print(report.json_report(findings, stale, root))
+    elif args.format == "github":
+        print(report.github_report(findings, stale))
     else:
         print(report.human_report(findings, stale))
     return 1 if findings else 0

@@ -53,7 +53,7 @@ def _registered_metrics(pctx: ProjectContext) -> List[
     the scanned files: (metric, path, line, col)."""
     out = []
     for ctx in pctx.files:
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
                     and node.func.attr in _REGISTRY_METHODS
@@ -72,7 +72,7 @@ def _env_reads(pctx: ProjectContext) -> List[Tuple[str, str, int, int]]:
     environ.get — (var, path, line, col)."""
     out = []
     for ctx in pctx.files:
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             var = None
             if isinstance(node, ast.Call):
                 name = ctx.imports.resolve(node.func)

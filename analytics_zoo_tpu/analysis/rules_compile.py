@@ -63,7 +63,7 @@ class JitCompileInServeLoop(Rule):
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:
         if not ctx.is_hot_path:
             return
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not isinstance(node, ast.Call):
                 continue
             func = node.func

@@ -138,7 +138,7 @@ class EngineUnlockedWrite(Rule):
     description = "cross-thread attribute write without a lock"
 
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:
-        for cls in ast.walk(ctx.tree):
+        for cls in ctx.walk():
             if not isinstance(cls, ast.ClassDef):
                 continue
             info = _ClassInfo(cls, ctx)
@@ -185,7 +185,7 @@ class LockOrder(Rule):
 
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:
         pairs: Dict[Tuple[str, str], ast.With] = {}
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not isinstance(node, ast.With):
                 continue
             inner = [i.context_expr for i in node.items

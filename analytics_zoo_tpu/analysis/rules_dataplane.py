@@ -62,7 +62,7 @@ class RowwiseMapInDataPlane(Rule):
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:
         if not _in_data_plane(ctx.path):
             return
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)):
                 continue

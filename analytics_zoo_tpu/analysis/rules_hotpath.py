@@ -102,7 +102,7 @@ class WallclockHotpath(Rule):
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:
         if not ctx.is_hot_path:
             return
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not isinstance(node, ast.Call):
                 continue
             name = ctx.imports.resolve(node.func)
@@ -134,7 +134,7 @@ class HotpathHostSync(Rule):
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:
         if not ctx.is_hot_path:
             return
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not isinstance(node, ast.Call):
                 continue
             label = self._sync_label(ctx, node)

@@ -47,7 +47,7 @@ class JitInLoop(Rule):
     description = "jit constructed inside a loop"
 
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if isinstance(node, ast.Call) \
                     and _is_jit_constructor(ctx, node) \
                     and any(isinstance(a, _LOOPS) for a in ancestors(node)):
@@ -70,7 +70,7 @@ class JitCallInline(Rule):
     description = "jit built and invoked in one expression"
 
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if isinstance(node, ast.Call) \
                     and isinstance(node.func, ast.Call) \
                     and _is_jit_constructor(ctx, node.func):
@@ -95,7 +95,7 @@ class JitStaticUnhashable(Rule):
     description = "unhashable static_argnums/static_argnames value"
 
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not (isinstance(node, ast.Call)
                     and _is_jit_constructor(ctx, node)):
                 continue

@@ -28,12 +28,17 @@ from __future__ import annotations
 import atexit
 import logging
 import os
+import threading
 import warnings
 from typing import Optional, Sequence
 
 logger = logging.getLogger(__name__)
 
 _active_context: Optional["ZooTpuContext"] = None
+# guards the init/stop transitions of _active_context: frontend handler
+# threads read the context while the main thread (or its atexit hook)
+# swaps it
+_context_lock = threading.Lock()
 
 
 class OrcaContextMeta(type):
@@ -240,11 +245,12 @@ def init_orca_context(cluster_mode: str = "local",
     from analytics_zoo_tpu.parallel.mesh import build_mesh
     mesh = build_mesh(axes=mesh_axes, shape=mesh_shape)
 
-    _active_context = ZooTpuContext(
-        cluster_mode=cluster_mode,
-        mesh=mesh,
-        num_processes=jax.process_count(),
-        process_index=jax.process_index())
+    with _context_lock:
+        _active_context = ZooTpuContext(
+            cluster_mode=cluster_mode,
+            mesh=mesh,
+            num_processes=jax.process_count(),
+            process_index=jax.process_index())
     atexit.register(stop_orca_context)
     logger.info("Initialized %r", _active_context)
     return _active_context
@@ -256,5 +262,6 @@ def stop_orca_context():
     if _active_context is None:
         return
     from analytics_zoo_tpu.parallel import mesh as _mesh_mod
-    _mesh_mod._default_mesh = None
-    _active_context = None
+    with _context_lock:
+        _mesh_mod._default_mesh = None
+        _active_context = None

@@ -335,15 +335,17 @@ class FlightRecorder:
         self._notes.append(str(msg))
 
     def attach(self) -> "FlightRecorder":
-        if not self._attached:
-            self._tracer.add_hook(self.observe)
-            self._attached = True
+        with self._lock:   # attach races detach on the teardown paths
+            if not self._attached:
+                self._tracer.add_hook(self.observe)
+                self._attached = True
         return self
 
     def detach(self):
-        if self._attached:
-            self._tracer.remove_hook(self.observe)
-            self._attached = False
+        with self._lock:
+            if self._attached:
+                self._tracer.remove_hook(self.observe)
+                self._attached = False
 
     # --------------------------------------------------------- dumping
     def snapshot(self, reason: str = "") -> dict:
@@ -478,6 +480,9 @@ def maybe_arm_from_env() -> Optional[FlightRecorder]:
 # ------------------------------------------------------- backend probe
 
 _BACKEND_CACHE: Dict[str, Any] = {}
+# probe_backend is called from the serve loop, supervisors, and dump
+# paths concurrently — the cache update must not interleave with clear()
+_BACKEND_LOCK = threading.Lock()
 
 
 def backend_state(timeout_s: float = 2.0, import_jax: bool = False) -> dict:
@@ -518,7 +523,8 @@ def backend_state(timeout_s: float = 2.0, import_jax: bool = False) -> dict:
     if not result:
         return {"status": "wedged", "probe_timeout_s": timeout_s}
     if result.get("status") == "ok":
-        _BACKEND_CACHE.update(result)
+        with _BACKEND_LOCK:
+            _BACKEND_CACHE.update(result)
     return dict(result)
 
 
@@ -532,4 +538,5 @@ def reset_for_tests():
             _FLIGHT_RECORDER.detach()
             _FLIGHT_RECORDER.disarm()
             _FLIGHT_RECORDER = None
-    _BACKEND_CACHE.clear()
+    with _BACKEND_LOCK:
+        _BACKEND_CACHE.clear()

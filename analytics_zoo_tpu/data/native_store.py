@@ -19,6 +19,7 @@ import os
 import pickle
 import subprocess
 import tempfile
+import threading
 from typing import Any, List, Optional, Sequence
 
 import numpy as np
@@ -27,11 +28,22 @@ _SRC = os.path.join(os.path.dirname(__file__), "native", "zstore.cpp")
 _BUILD_DIR = os.path.join(os.path.dirname(__file__), "native", "build")
 _lib = None
 _lib_failed = False
+_lib_lock = threading.Lock()
 
 
 def load_native_lib():
     """Compile (once) and dlopen libzstore. Returns None when no
     toolchain — callers fall back to the pure-python tiers."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lib_lock:
+        return _load_native_lib_locked()
+
+
+def _load_native_lib_locked():
+    """Build+dlopen under ``_lib_lock`` — two shard workers racing here
+    would otherwise both run g++ against the same output file."""
     global _lib, _lib_failed
     if _lib is not None or _lib_failed:
         return _lib
@@ -77,7 +89,12 @@ def load_native_lib():
 
 
 class NativeBlobStore:
-    """Raw byte-blob store over the native arena."""
+    """Raw byte-blob store over the native arena.
+
+    Not thread-safe: the C arena handles its own internal locking, but
+    ``close()`` frees the handle, so callers keep one store per owning
+    thread (the shard pool fetches on the submitting thread) or
+    serialize close against in-flight gets externally."""
 
     def __init__(self, capacity_bytes: int, directory: Optional[str] = None):
         lib = load_native_lib()

@@ -124,9 +124,11 @@ def _map_shards(fn: Callable[[Any], Any], n: int,
     hist, busy = _data_metrics()
     t0 = time.perf_counter()
     workers = data_workers()
+    # stats stays confined to this (driving) thread while the map runs;
+    # only the finished snapshot is published, so a concurrent reader of
+    # LAST_RUN_STATS never sees a half-filled dict
     stats = {"op": op, "shards": n, "workers": max(1, workers),
              "in_flight_peak": 0}
-    LAST_RUN_STATS[op] = stats
     try:
         if workers <= 1 or n <= 1:
             for i in range(n):
@@ -162,6 +164,7 @@ def _map_shards(fn: Callable[[Any], Any], n: int,
     finally:
         busy.set(0)
         hist.labels(op).observe(time.perf_counter() - t0)
+        LAST_RUN_STATS[op] = dict(stats)
 
 
 class XShards:

@@ -1121,18 +1121,20 @@ class ClusterServing:
     def set_advertise(self, host: str, port: int):
         """Where peers can scrape this replica's ``/metrics`` — filled in
         by the FrontEnd that owns this engine (port 0 = headless)."""
-        self._advertise = (host, int(port))
+        with self._state_lock:   # heartbeater reads it from its thread
+            self._advertise = (host, int(port))
 
     def _replica_info(self) -> fleet.ReplicaInfo:
         with self._state_lock:
             n = self.records_out
-        host, port = self._advertise
+            host, port = self._advertise
+            started = self._started_wall
         # wall clock by design: heartbeat ages are compared across
         # processes/hosts (see common/fleet.py module docstring)
         now = time.time()  # zoolint: disable=wallclock-hotpath
         return fleet.ReplicaInfo(
             replica_id=self.replica_id, host=host, port=port,
-            started_at=self._started_wall, last_heartbeat=now,
+            started_at=started, last_heartbeat=now,
             records_total=n, stream=self.stream)
 
     # ---------------------------------------------------------------- api
@@ -1164,8 +1166,9 @@ class ClusterServing:
         # any frontend can enumerate/scrape this replica
         # (ZOO_FLEET_HEARTBEAT_S=0 opts out)
         if self._heartbeater is None and fleet.heartbeat_interval_s() > 0:
-            self._started_wall = \
-                time.time()  # zoolint: disable=wallclock-hotpath
+            with self._state_lock:
+                self._started_wall = \
+                    time.time()  # zoolint: disable=wallclock-hotpath
             registry = fleet.ReplicaRegistry(self.broker_host,
                                              self.broker_port)
             self._heartbeater = fleet.Heartbeater(registry,

@@ -24,14 +24,35 @@ run() { echo "== pytest $*"; python -m pytest -q "$@"; }
 # inline "# zoolint: disable=RULE"), and the seeded-violation fixture
 # must FAIL — a passing fixture means the linter itself regressed.
 lint_zoolint() {
-  echo "== zoolint: analytics_zoo_tpu"
+  echo "== zoolint: analytics_zoo_tpu (interprocedural pass included)"
   python -m analytics_zoo_tpu.analysis analytics_zoo_tpu
   echo "== zoolint: seeded-violation fixture (must fail)"
-  if python -m analytics_zoo_tpu.analysis --no-baseline \
-       tests/fixtures/zoolint >/dev/null; then
+  if fixture_out="$(python -m analytics_zoo_tpu.analysis --no-baseline \
+       tests/fixtures/zoolint 2>&1)"; then
     echo "zoolint passed the seeded-violation fixture — linter regressed" >&2
     exit 1
   fi
+  # every whole-program rule must fire on its seeded fixture by id — a
+  # non-zero exit from the per-file rules alone is not good enough
+  for rule in cross-thread-unlocked-state lock-order-inversion \
+              blocking-under-lock thread-leak; do
+    if ! grep -q "$rule" <<<"$fixture_out"; then
+      echo "zoolint fixture never tripped $rule — rule regressed" >&2
+      exit 1
+    fi
+  done
+  echo "== zoolint: docs/concurrency.md drift check"
+  owndir="$(mktemp -d)"
+  python -m analytics_zoo_tpu.analysis analytics_zoo_tpu \
+    --ownership-report "$owndir/concurrency.md" >/dev/null
+  if ! diff -q docs/concurrency.md "$owndir/concurrency.md" >/dev/null || \
+     ! diff -q docs/concurrency.json "$owndir/concurrency.json" >/dev/null; then
+    echo "docs/concurrency.md is stale — regenerate with:" >&2
+    echo "  python -m analytics_zoo_tpu.analysis analytics_zoo_tpu \\" >&2
+    echo "    --ownership-report docs/concurrency.md" >&2
+    exit 1
+  fi
+  rm -rf "$owndir"
 }
 
 case "$lane" in
@@ -152,8 +173,19 @@ PY
             ;;
   # fleet observability (ISSUE 6): snapshot merge algebra, replica
   # registry + SLO burn units, and the two-replica federation smoke
-  # (subprocess engines, one broker, merged /metrics?scope=fleet)
-  fleet)    run -m "not slow" tests/test_fleet.py ;;
+  # (subprocess engines, one broker, merged /metrics?scope=fleet). The
+  # seeded race fixture must trip the whole-program ownership rule: a
+  # heartbeater-style helper-method write the per-file rule can't see.
+  fleet)    run -m "not slow" tests/test_fleet.py
+            echo "== zoolint: seeded heartbeater race must fire"
+            drift="$(python -m analytics_zoo_tpu.analysis --no-baseline \
+                       tests/fixtures/zoolint 2>&1 || true)"
+            if ! grep "cross-thread-unlocked-state" <<<"$drift" | \
+                 grep -q "fleet/bad_shared_state.py"; then
+              echo "ownership rule missed the seeded heartbeater race" >&2
+              exit 1
+            fi
+            ;;
   # wedge resilience (ISSUE 7): fault injector, backend supervisor,
   # checkpoint fallback, fit auto-resume, serving failover — then an
   # armed bench smoke whose built-in wedge drill must leave a
@@ -196,6 +228,13 @@ PY
                        tests/fixtures/zoolint 2>&1 || true)"
             if ! grep -q "zoo_serving_redelivered_bogus_total" <<<"$drift"; then
               echo "catalog drift missed the seeded zoo_serving_* violation" >&2
+              exit 1
+            fi
+            # the chaos drills' kill paths hang on leaked non-daemon
+            # threads — the seeded leak must trip the lifecycle rule
+            if ! grep "thread-leak" <<<"$drift" | \
+                 grep -q "chaos/bad_thread_leak.py"; then
+              echo "zoolint missed the seeded non-daemon thread leak" >&2
               exit 1
             fi
             echo "== bench --smoke chaos (replica-kill drill + scaling floor)"
@@ -243,6 +282,19 @@ PY
             fi
             if ! grep -q "ZOO_SERVING_MAX_WAIT_BOGUS_MS" <<<"$drift"; then
               echo "catalog drift missed the seeded scheduling env var" >&2
+              exit 1
+            fi
+            # a scheduler sleeping under a contended lock stalls every
+            # submitter; a cross-file ABBA pair deadlocks under load —
+            # both seeded races must trip the whole-program lock rules
+            if ! grep "blocking-under-lock" <<<"$drift" | \
+                 grep -q "scheduling/bad_blocking.py"; then
+              echo "zoolint missed the seeded sleep-under-lock" >&2
+              exit 1
+            fi
+            if ! grep "lock-order-inversion" <<<"$drift" | \
+                 grep -q "scheduling/"; then
+              echo "zoolint missed the seeded cross-file lock inversion" >&2
               exit 1
             fi
             echo "== bench --smoke scheduling (batch-lane flood drill)"

@@ -35,6 +35,8 @@ def test_rule_registry_complete():
         "jit-in-loop", "jit-call-inline", "jit-static-unhashable",
         "jit-compile-in-serve-loop",
         "engine-unlocked-write", "lock-order",
+        "cross-thread-unlocked-state", "lock-order-inversion",
+        "blocking-under-lock", "thread-leak",
         "metric-undocumented", "metric-undeclared", "envvar-undocumented",
         "rowwise-map-in-data-plane",
     }
@@ -523,6 +525,8 @@ def test_seeded_fixture_trips_every_family():
         "jit-in-loop", "jit-call-inline", "jit-static-unhashable",
         "jit-compile-in-serve-loop",
         "engine-unlocked-write", "lock-order",
+        "cross-thread-unlocked-state", "lock-order-inversion",
+        "blocking-under-lock", "thread-leak",
         "metric-undocumented", "envvar-undocumented",
         "rowwise-map-in-data-plane",
     }
@@ -573,6 +577,110 @@ def test_cli_partial_scan_keeps_baseline_quiet(monkeypatch, capsys):
     out = capsys.readouterr().out
     assert rc == 0, out
     assert "stale" not in out
+
+
+def _cli_tree(tmp_path):
+    """A minimal anchored checkout with one wallclock finding."""
+    (tmp_path / ".git").mkdir()
+    mod = tmp_path / "serving" / "mod.py"
+    mod.parent.mkdir()
+    mod.write_text("import time\n\n\ndef stamp():\n"
+                   "    return time.time()\n")
+    return mod
+
+
+def test_cli_github_format(tmp_path, capsys):
+    from analytics_zoo_tpu.analysis import cli
+    mod = _cli_tree(tmp_path)
+    rc = cli.main(["--no-baseline", "--format=github", str(mod)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    line = out.strip().splitlines()[0]
+    assert line.startswith("::error file=serving/mod.py,line=5,")
+    assert "title=zoolint wallclock-hotpath" in line
+    # clean scans emit a notice, not silence
+    (tmp_path / "clean.py").write_text("X = 1\n")
+    rc = cli.main(["--no-baseline", "--format=github",
+                   str(tmp_path / "clean.py")])
+    out = capsys.readouterr().out
+    assert rc == 0 and "::notice" in out
+
+
+def test_cli_exit_codes_distinguish_usage_and_crash(monkeypatch, capsys):
+    from analytics_zoo_tpu.analysis import cli
+    # usage error: 2
+    assert cli.main(["/no/such/path.py"]) == 2
+    assert cli.main(["--rules", "bogus-rule", "."]) == 2
+    # internal crash: 3 (so CI can tell findings from linter bugs)
+    def boom(*a, **k):
+        raise RuntimeError("linter bug")
+    monkeypatch.setattr(cli, "analyze_paths", boom)
+    assert cli.main(["--no-baseline", "."]) == 3
+    err = capsys.readouterr().err
+    assert "internal error" in err and "RuntimeError" in err
+
+
+def test_cli_jobs_parallel_matches_serial(capsys):
+    from analytics_zoo_tpu.analysis import cli
+    args = ["--no-baseline", "--format=json", FIXTURE]
+    rc1 = cli.main(["--jobs", "1"] + args)
+    out1 = capsys.readouterr().out
+    rc4 = cli.main(["--jobs", "4"] + args)
+    out4 = capsys.readouterr().out
+    assert rc1 == rc4 == 1
+    assert json.loads(out1) == json.loads(out4)
+
+
+def test_cli_migrate_baseline_v1_to_v2(tmp_path, capsys):
+    from analytics_zoo_tpu.analysis import cli
+    mod = _cli_tree(tmp_path)
+    findings = analyze_paths([str(mod)], root=str(tmp_path))
+    (f, fp1), = baseline_lib.fingerprints(findings, str(tmp_path),
+                                          version=1)
+    bl = tmp_path / "dev" / "zoolint-baseline.json"
+    bl.parent.mkdir()
+    bl.write_text(json.dumps({"version": 1, "entries": [{
+        "fingerprint": fp1, "rule": f.rule, "path": f.path,
+        "line": f.line, "message": f.message,
+        "justification": "known wallclock, kept on purpose"}]}))
+    # a normal run refuses the v1 file with a pointer at the migration
+    assert cli.main([str(mod)]) == 2
+    assert "--migrate-baseline" in capsys.readouterr().err
+    # one-shot migration preserves the justification ...
+    assert cli.main(["--migrate-baseline", str(mod)]) == 0
+    assert "migrated" in capsys.readouterr().out
+    entries = baseline_lib.load(str(bl))
+    (entry,) = entries.values()
+    assert entry["justification"] == "known wallclock, kept on purpose"
+    # ... and the migrated baseline keeps the tree quiet across a rewrap
+    assert cli.main([str(mod)]) == 0
+    capsys.readouterr()
+    mod.write_text("import time\n\n\ndef stamp():\n"
+                   "    return max(time.time(),\n               0 * 1)\n")
+    findings = analyze_paths([str(mod)], root=str(tmp_path))
+    bl.write_text(json.dumps({"version": 2, "entries": [
+        dict(e, fingerprint=fp) for (_f, fp), e in
+        zip(baseline_lib.fingerprints(findings, str(tmp_path)),
+            entries.values())]}))
+    mod.write_text("import time\n\n\ndef stamp():\n"
+                   "    return max(time.time(), 0 * 1)\n")
+    findings2 = analyze_paths([str(mod)], root=str(tmp_path))
+    left, stale = baseline_lib.apply(
+        findings2, baseline_lib.load(str(bl)), str(tmp_path))
+    assert left == [] and stale == []
+
+
+def test_cli_ownership_report(tmp_path, capsys):
+    from analytics_zoo_tpu.analysis import cli
+    _cli_tree(tmp_path)
+    out_md = tmp_path / "docs" / "concurrency.md"
+    rc = cli.main(["--ownership-report", str(out_md),
+                   str(tmp_path / "serving")])
+    assert rc == 0
+    assert "ownership report written" in capsys.readouterr().out
+    assert out_md.is_file()
+    js = json.loads((tmp_path / "docs" / "concurrency.json").read_text())
+    assert [r["root"] for r in js["roots"]][0] == "main"
 
 
 def test_syntax_error_is_a_finding(tmp_path):
