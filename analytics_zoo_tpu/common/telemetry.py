@@ -350,9 +350,28 @@ def _merge_hist_entry(name: str, a: Dict[str, Any],
             "reservoir": _subsample_sorted(res, SNAPSHOT_RESERVOIR)}
 
 
+#: Gauges describing a physical resource owned by ONE process — a mesh
+#: shard's resident parameter bytes, the decode cache's current rung.
+#: Two replicas of the same sharded model both report
+#: ``zoo_shard_hbm_bytes{shard=0}``; summing those series across the
+#: fleet would fabricate a device holding 2x the real bytes, so the
+#: fleet merge takes the max instead (the fleet view answers "how big is
+#: the biggest shard", never a total).
+NON_ADDITIVE_GAUGES = frozenset({
+    "zoo_shard_hbm_bytes",
+    "zoo_kv_cache_rung",
+})
+
+
+def _merge_scalar(name: str, a, b):
+    if name in NON_ADDITIVE_GAUGES:
+        return max(a, b)
+    return a + b
+
+
 def _merge_family(name: str, a: Any, b: Any) -> Any:
     if isinstance(a, (int, float)) and isinstance(b, (int, float)):
-        return a + b
+        return _merge_scalar(name, a, b)
     if _is_hist_entry(a) and _is_hist_entry(b):
         return _merge_hist_entry(name, a, b)
     if isinstance(a, dict) and isinstance(b, dict) \
@@ -365,7 +384,7 @@ def _merge_family(name: str, a: Any, b: Any) -> Any:
                 out[k] = _merge_hist_entry(name, out[k], v)
             elif isinstance(out[k], (int, float)) \
                     and isinstance(v, (int, float)):
-                out[k] = out[k] + v
+                out[k] = _merge_scalar(name, out[k], v)
             else:
                 raise ValueError(
                     f"series {name}{{{k}}}: incompatible snapshot shapes")
@@ -504,7 +523,10 @@ class MetricsRegistry:
         """Fold snapshot ``other`` into snapshot ``base`` and return the
         merged dict (inputs are not mutated). Counters and gauges add
         (summing is the only associative choice for gauges; a fleet-wide
-        gauge reads as a total), histogram series add bucket counts /
+        gauge reads as a total) — except the ``NON_ADDITIVE_GAUGES``
+        per-shard resource gauges, whose identically-labeled series from
+        different replicas describe the same-sized resource and merge by
+        max, never a sum. Histogram series add bucket counts /
         count / sum and take a subsampled union of the reservoirs. Raises
         ``ValueError`` when the same series has incompatible shapes
         (histogram-vs-scalar, differing ``le`` edges) — the fleet scraper

@@ -1,0 +1,161 @@
+"""Bucketed autoregressive decode — seq-length rungs, never a
+per-request recompile.
+
+The reference's ``Seq2Seq.infer`` zero-pads the decoder buffer to one
+fixed ``max_seq_len`` so XLA compiles once — every request pays the
+longest generation's compute. Here the decode buffer lives in a
+:class:`BucketedKVCache`: it is padded to the current **seq-length rung**
+of a :class:`~analytics_zoo_tpu.common.compile_ahead.BucketLadder` and
+grows rung→rung as generation proceeds, so short generations run short
+shapes and the whole length range compiles to a handful of executables —
+all AOT-warmable through the same compile-ahead ladder the batch axis
+already uses.
+
+Correctness leans on causality, not luck: the decoder is a
+strictly-causal scan over time, so step ``t``'s output depends only on
+positions ``<= t`` — zero padding past the live positions cannot change
+it, and rung-padded decode is **bitwise identical** to an unpadded
+reference (asserted by tests/test_generation.py, tail lengths and
+rung-growth boundaries included).
+"""
+
+from __future__ import annotations
+
+from time import perf_counter
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.common import compile_ahead, telemetry
+
+#: generation modes: ``raw`` feeds the predicted vector straight back
+#: (the reference ``Seq2Seq.infer`` semantics); ``greedy`` feeds the
+#: one-hot argmax; ``sample`` feeds a one-hot temperature sample.
+MODES = ("raw", "greedy", "sample")
+
+#: default seq-length ladder bounds for generate requests
+DEFAULT_SEQ_RUNGS = (8, 128)
+
+_REG = telemetry.get_registry()
+_M_DECODE_STEPS = _REG.counter(
+    "zoo_decode_steps_total",
+    "Autoregressive decode steps executed (one per generated position "
+    "per batch dispatch)")
+_M_KV_RUNG = _REG.gauge(
+    "zoo_kv_cache_rung",
+    "Current seq-length rung of the bucketed decode/KV cache — climbs "
+    "power-of-two rungs as generation proceeds, never per-step shapes")
+
+
+def seq_ladder(max_seq_len: int,
+               min_rung: int = DEFAULT_SEQ_RUNGS[0]):
+    """The seq-length rung ladder for generations up to ``max_seq_len``."""
+    lo = max(2, min(int(min_rung), int(max_seq_len)))
+    return compile_ahead.BucketLadder(lo, max(lo, int(max_seq_len)))
+
+
+class BucketedKVCache:
+    """The decoder feedback buffer, padded to the live seq-length rung.
+
+    For the RNN seq2seq zoo the "KV cache" *is* the teacher-forcing
+    buffer the model re-consumes each step; attention models slot their
+    key/value blocks behind the same rung discipline. ``view()`` is
+    always ``[batch, rung, dim]`` with zeros past :attr:`length`, so the
+    shapes XLA sees are exactly the ladder's rungs.
+    """
+
+    def __init__(self, batch: int, dim: int, ladder=None,
+                 start: Optional[np.ndarray] = None,
+                 dtype=np.float32):
+        self.ladder = ladder
+        self.length = 0
+        self.dim = int(dim)
+        rung = ladder.rung_for(1) if ladder is not None else 1
+        self._buf = np.zeros((int(batch), int(rung), self.dim), dtype)
+        if start is not None:
+            self.append(np.asarray(start, dtype))
+        _M_KV_RUNG.set(self.rung)
+
+    @property
+    def rung(self) -> int:
+        return int(self._buf.shape[1])
+
+    def append(self, vec: np.ndarray) -> None:
+        """Write one position; grow buffer to the next rung when full.
+        Growth re-pads with zeros — never a per-step shape."""
+        if self.length == self._buf.shape[1]:
+            new_rung = (self.ladder.rung_for(self.length + 1)
+                        if self.ladder is not None else self.length + 1)
+            grown = np.zeros((self._buf.shape[0], new_rung, self.dim),
+                             self._buf.dtype)
+            grown[:, :self.length, :] = self._buf
+            self._buf = grown
+            _M_KV_RUNG.set(self.rung)
+        self._buf[:, self.length, :] = vec
+        self.length += 1
+
+    def view(self) -> np.ndarray:
+        return self._buf
+
+
+def _feedback(vec: np.ndarray, mode: str, temperature: float,
+              rng: Optional[np.random.Generator]) -> np.ndarray:
+    """Turn one step's raw prediction into the vector fed back."""
+    if mode == "raw":
+        return vec
+    if mode == "greedy":
+        ids = np.argmax(vec, axis=-1)
+    else:                                   # sample
+        t = max(float(temperature), 1e-6)
+        z = vec / t
+        z = z - z.max(axis=-1, keepdims=True)
+        p = np.exp(z)
+        p /= p.sum(axis=-1, keepdims=True)
+        ids = np.array([rng.choice(p.shape[-1], p=row) for row in p])
+    out = np.zeros_like(vec)
+    out[np.arange(vec.shape[0]), ids] = 1.0
+    return out
+
+
+def decode_loop(predict_fn: Callable[[np.ndarray, np.ndarray], np.ndarray],
+                input_seq: np.ndarray, start_sign: np.ndarray,
+                max_new_tokens: int, *, ladder=None, mode: str = "raw",
+                temperature: float = 1.0, seed: Optional[int] = None,
+                trace_ids: Sequence[str] = ()) -> np.ndarray:
+    """Run the autoregressive loop: prefill + ``max_new_tokens`` steps
+    through the bucketed cache.
+
+    ``predict_fn(enc, dec) -> [batch, t_dec, dim]`` is the full-sequence
+    decoder (the jitted/AOT model apply); step ``t`` reads position
+    ``t-1`` of its output, exactly the reference ``infer`` recurrence.
+    ``ladder=None`` runs the exact-length unpadded reference (one shape
+    per step — the parity baseline, not a serving path). Returns the
+    generated ``[batch, max_new_tokens, dim]`` sequence (raw vectors, or
+    one-hot rows for greedy/sample).
+    """
+    if mode not in MODES:
+        raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+    input_seq = np.asarray(input_seq)
+    start = np.asarray(start_sign, np.float32)
+    batch, dim = input_seq.shape[0], start.shape[-1]
+    steps = int(max_new_tokens)
+    if steps < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    rng = np.random.default_rng(seed) if mode == "sample" else None
+    tracer = telemetry.get_tracer()
+
+    cache = BucketedKVCache(batch, dim, ladder, start)
+    gen = np.zeros((batch, steps, dim), np.float32)
+    for t in range(1, steps + 1):
+        t0 = perf_counter()
+        # the buffer holds positions [0, t) — output t-1 is causal in
+        # them, so the rung's zero tail cannot change it
+        out = np.asarray(predict_fn(input_seq, cache.view()))
+        fed = _feedback(out[:, t - 1, :], mode, temperature, rng)
+        cache.append(fed)
+        gen[:, t - 1, :] = fed
+        _M_DECODE_STEPS.inc(batch)
+        t1 = perf_counter()
+        for uri in trace_ids:
+            tracer.record(uri, f"decode_step_{t}", t0, t1, parent="device")
+    return gen

@@ -65,6 +65,9 @@ class InferenceModel:
         self._sample_spec = None    # ((sample_shape, dtype), ...) per input
         self._exec_cache: Optional[compile_ahead.ExecutableCache] = None
         self._warm_threads: list = []
+        # set by shard(): the mesh executable the dispatch seam rides —
+        # params partitioned per strategy, avals carrying shardings
+        self._sharded = None
 
     # ------------------------------------------------------------- loaders
     def load_zoo(self, model) -> "InferenceModel":
@@ -255,6 +258,50 @@ class InferenceModel:
             # old executables — the new forward needs new ones
             self._exec_cache = compile_ahead.ExecutableCache(
                 self._jitted, name="inference_model")
+            # a re-install also invalidates any mesh layout: the new
+            # forward must be re-sharded explicitly
+            self._sharded = None
+
+    def shard(self, strategy, param_rules=None, mesh=None,
+              devices=None) -> "InferenceModel":
+        """Repartition the loaded model onto a device mesh: parameters
+        placed per the :class:`~analytics_zoo_tpu.parallel.strategy.
+        ShardingStrategy` (e.g. ``"tp8"``, ``"fsdp"``, ``"dp2,tp4"``)
+        and every subsequent predict/warm dispatch runs the mesh
+        executable. The serving seam above (bucket ladder, assembly
+        loop, warmup) is unchanged — executables key on batch
+        shape/dtype, and warmup walks the ladder with sharded avals so
+        bucket growth stays a stall-free swap."""
+        from analytics_zoo_tpu.parallel.sharded_executable import (
+            ShardedExecutable,
+        )
+
+        with self._lock:
+            if self._apply is None:
+                raise RuntimeError("load a model before shard")
+            apply_fn, params = self._apply, self._params
+        se = ShardedExecutable(apply_fn, params, strategy,
+                               param_rules=param_rules, mesh=mesh,
+                               devices=devices, name="inference_model")
+        with self._lock:
+            self._params = se.params
+            self._jitted = se._jitted
+            self._exec_cache = se.cache
+            self._sharded = se
+        return self
+
+    def shard_info(self) -> Optional[Dict[str, Any]]:
+        """Per-shard HBM accounting for the mesh executable (None when
+        unsharded) — the `/healthz` payload proving no single device
+        holds the full model."""
+        with self._lock:
+            se = self._sharded
+        if se is None:
+            return None
+        hbm = se.shard_hbm_bytes()
+        return {"strategy": str(se.strategy), "n_shards": se.n_shards,
+                "total_param_bytes": se.total_param_bytes(),
+                "shard_hbm_bytes": hbm}
 
     # ------------------------------------------------------ compile-ahead
     def _remember_spec(self, xs, overwrite: bool = False):
@@ -292,13 +339,28 @@ class InferenceModel:
     def _aot_avals(self, params, spec, rung):
         import jax
 
+        with self._lock:
+            sharded = self._sharded
+
         def aval(a):
+            # carry the leaf's sharding: an AOT build lowered without it
+            # compiles a different executable than the live dispatch
+            # needs, so the "warm" rung silently recompiles on first use
+            sh = getattr(a, "sharding", None)
+            if sh is not None:
+                try:
+                    return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype,
+                                                sharding=sh)
+                except TypeError:       # older jax: no sharding kwarg
+                    pass
             if hasattr(a, "shape") and hasattr(a, "dtype"):
                 return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
             arr = np.asarray(a)
             return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
 
         p_avals = jax.tree_util.tree_map(aval, params)
+        if sharded is not None:
+            return (p_avals,) + sharded.batch_avals(spec, rung)
         return (p_avals,) + compile_ahead.batch_avals(spec, rung)
 
     def warm_up(self, rungs=None, sample_input=None, block: bool = False):
@@ -369,6 +431,79 @@ class InferenceModel:
             return cache.ready(*self._aot_avals(params, spec, rung))
         except Exception:
             return False
+
+    # ------------------------------------------------------------ generate
+    def warm_decode(self, max_seq_len: int, rungs=None, seq_rungs=None,
+                    block: bool = False):
+        """AOT-compile the decode grid: every (batch rung × seq-length
+        rung) shape a ``generate`` up to ``max_seq_len`` can present, so
+        the decode loop never recompiles — the KV cache's rung growth is
+        a swap onto an already-built executable. Needs a 2-input
+        (encoder, decoder) spec; the decoder's time axis is rewritten per
+        seq rung. Returns the warmup thread (None when nothing to do)."""
+        from analytics_zoo_tpu.inference import generation
+
+        with self._lock:
+            spec, cache = self._sample_spec, self._exec_cache
+            params, ladder = self._params, self._ladder
+        if cache is None or spec is None or len(spec) < 2:
+            return None
+        if seq_rungs is None:
+            seq_rungs = generation.seq_ladder(int(max_seq_len)).rungs
+        if rungs is None:
+            rungs = ladder.rungs if ladder is not None else ()
+        dec_shape, dec_dtype = spec[-1]
+        todo = []
+        for rung in sorted({int(r) for r in rungs}):
+            for sr in sorted({int(s) for s in seq_rungs}):
+                dspec = spec[:-1] + (
+                    ((int(sr),) + tuple(dec_shape[1:]), dec_dtype),)
+                avals = self._aot_avals(params, dspec, rung)
+                if not cache.ready(*avals):
+                    todo.append(avals)
+        if not todo:
+            return None
+        if block:
+            for avals in todo:
+                cache.warm(*avals)
+            return None
+        t = cache.warm_async(todo)
+        with self._lock:
+            self._warm_threads = [w for w in self._warm_threads
+                                  if w.is_alive()] + [t]
+        return t
+
+    def generate(self, input_seq, start_sign, max_new_tokens: int = 16, *,
+                 mode: str = "greedy", temperature: float = 1.0,
+                 seed: Optional[int] = None, ladder=None,
+                 trace_ids: Sequence[str] = ()) -> np.ndarray:
+        """Autoregressive generation through the AOT dispatch seam:
+        sharded prefill + decode loop over the bucketed KV cache
+        (generation.decode_loop), every step running the (batch rung ×
+        seq rung) executables ``warm_decode`` built — never a per-request
+        recompile. The loaded model must be a 2-input encoder/decoder
+        (e.g. the seq2seq zoo via ``load_zoo``). Returns the generated
+        ``[batch, max_new_tokens, output_dim]`` sequence."""
+        from analytics_zoo_tpu.inference import generation
+
+        with self._lock:
+            if self._apply is None:
+                raise RuntimeError("load a model before generate")
+            if self._n_inputs != 2:
+                raise ValueError(
+                    "generate needs a 2-input (encoder, decoder) model, "
+                    f"got {self._n_inputs} inputs")
+        if ladder is None:
+            ladder = generation.seq_ladder(int(max_new_tokens) + 1)
+
+        def step(enc, dec):
+            return np.asarray(self.predict_fetch(
+                self.predict_async((enc, dec))))
+
+        return generation.decode_loop(
+            step, input_seq, start_sign, max_new_tokens, ladder=ladder,
+            mode=mode, temperature=temperature, seed=seed,
+            trace_ids=trace_ids)
 
     # ------------------------------------------------------------- predict
     def _snapshot(self):

@@ -658,13 +658,32 @@ class JaxEstimator:
         bs = int(batch_size)
 
         def batched(extra_lead):
+            mesh = self._ensure_mesh()
+
             def f(a):
                 shape = getattr(a, "shape", None)
                 dtype = getattr(a, "dtype", None)
                 if shape is None or dtype is None:
                     raise TypeError("dataset tensors are not materialized")
-                return jax.ShapeDtypeStruct(
-                    tuple(extra_lead) + (bs,) + tuple(shape[1:]), dtype)
+                shp = tuple(extra_lead) + (bs,) + tuple(shape[1:])
+                # the hot loop feeds committed mesh-placed batches
+                # (device_iterator/device_scan_iterator shard the batch
+                # dim per the strategy, scan lead unsharded); an aval
+                # without that sharding lowers a different executable,
+                # so the "precompiled" step silently recompiles on its
+                # first real batch
+                try:
+                    from jax.sharding import (
+                        NamedSharding, PartitionSpec as P,
+                    )
+                    base = self.strategy.batch_spec(
+                        len(shp) - len(extra_lead))
+                    spec = P(*([None] * len(extra_lead)), *base) \
+                        if extra_lead else base
+                    return jax.ShapeDtypeStruct(
+                        shp, dtype, sharding=NamedSharding(mesh, spec))
+                except TypeError:   # older jax: no sharding kwarg
+                    return jax.ShapeDtypeStruct(shp, dtype)
             return f
 
         def state_avals(with_sharding: bool):

@@ -77,18 +77,25 @@ class Seq2Seq(ZooModel):
                                   else x, **kwargs)
 
     def infer(self, input_seq: np.ndarray, start_sign: np.ndarray,
-              max_seq_len: int = 30) -> np.ndarray:
+              max_seq_len: int = 30, mode: str = "raw",
+              temperature: float = 1.0, seed=None) -> np.ndarray:
         """Autoregressive generation (ref Seq2Seq.infer): feed the decoder
-        its own last prediction. Each step re-runs the jitted graph with a
-        growing — but padded-to-``max_seq_len`` — decoder input so XLA
-        compiles once."""
-        batch = input_seq.shape[0]
-        dec = np.zeros((batch, max_seq_len, self.output_dim), np.float32)
-        dec[:, 0, :] = start_sign
-        for t in range(1, max_seq_len):
-            out = self.model.predict((input_seq, dec))
-            dec[:, t, :] = np.asarray(out)[:, t - 1, :]
-        return dec[:, 1:, :]
+        its own last prediction. The decoder buffer rides the bucketed
+        seq-length ladder (generation.decode_loop) — power-of-two rungs
+        instead of one padded-to-``max_seq_len`` shape, bitwise identical
+        because the decoder scan is strictly causal in time. ``mode``
+        extends the reference raw-vector feedback with one-hot
+        ``greedy``/``sample`` generation."""
+        from analytics_zoo_tpu.inference import generation
+        input_seq = np.asarray(input_seq)
+        if max_seq_len <= 1:
+            return np.zeros((input_seq.shape[0], 0, self.output_dim),
+                            np.float32)
+        return generation.decode_loop(
+            lambda enc, dec: self.model.predict((enc, dec)),
+            input_seq, start_sign, int(max_seq_len) - 1,
+            ladder=generation.seq_ladder(max_seq_len), mode=mode,
+            temperature=temperature, seed=seed)
 
     def _config(self):
         return dict(input_dim=self.input_dim, output_dim=self.output_dim,

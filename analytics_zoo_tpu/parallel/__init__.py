@@ -1,2 +1,3 @@
 from analytics_zoo_tpu.parallel.mesh import build_mesh, get_default_mesh  # noqa: F401
+from analytics_zoo_tpu.parallel.sharded_executable import ShardedExecutable  # noqa: F401
 from analytics_zoo_tpu.parallel.strategy import ShardingStrategy  # noqa: F401

@@ -66,22 +66,28 @@ class InputQueue:
 
     def _encode(self, uri: Optional[str], inputs: Dict,
                 priority: Optional[str] = None,
-                deadline_ms: Optional[float] = None
+                deadline_ms: Optional[float] = None,
+                generate: Optional[Dict] = None
                 ) -> "tuple[str, str, Optional[tuple], str]":
         """(uri, payload, trace, lane) — ``trace`` is ``(t_enc_pc,
         sampled)`` for natively-encoded records (the stamp the engine's
         queue-wait accounting reads), None for Arrow records (the
         reference wire format has no side channel, so Arrow records get
-        lane routing but no deadline). ``lane`` is the validated priority
-        the broker partitions delivery on."""
+        lane routing but no deadline or generate options). ``lane`` is
+        the validated priority the broker partitions delivery on."""
         if not inputs:
             raise ValueError("enqueue needs at least one named tensor")
         lane = schema.validate_priority(priority)
         if deadline_ms is not None and deadline_ms <= 0:
             raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
+        gen = schema.validate_generate(generate)
         uri = schema.validate_uri(uri or uuid.uuid4().hex)
         coerced = {k: self._coerce(v) for k, v in inputs.items()}
         if self.arrow:
+            if gen is not None:
+                raise ValueError(
+                    "generate requests need the native record format — "
+                    "the Arrow wire format carries no side channel")
             return uri, schema.encode_record_arrow(
                 uri, coerced, self.cipher), None, lane
         # dual-clock stamp: perf_counter is CLOCK_MONOTONIC on Linux
@@ -97,13 +103,16 @@ class InputQueue:
             trace["p"] = lane
         if deadline_ms is not None:
             trace["d"] = float(deadline_ms)
+        if gen is not None:
+            trace["g"] = gen
         payload = schema.encode_record(uri, coerced, self.cipher,
                                        trace=trace)
         return uri, payload, (t_pc, sampled), lane
 
     def enqueue(self, uri: Optional[str] = None,
                 priority: Optional[str] = None,
-                deadline_ms: Optional[float] = None, **inputs) -> str:
+                deadline_ms: Optional[float] = None,
+                generate: Optional[Dict] = None, **inputs) -> str:
         """``enqueue("img1", x=ndarray)``; returns the uri (generated when
         not given). Multi-input models pass several named tensors.
         ``enqueue("img1", image=jpeg_bytes)`` sends the raw encoded image
@@ -113,13 +122,22 @@ class InputQueue:
         ``priority`` routes the record onto a broker lane
         (``schema.PRIORITIES``; default "default") and ``deadline_ms``
         bounds how stale a result is still useful — the engine stores an
-        explicit expired error once it lapses. The names ``priority`` and
-        ``deadline_ms`` are therefore reserved and cannot name input
-        tensors. Raises :class:`ShedError` immediately when admission
-        control is shedding the lane — a fast-fail instead of a poll
-        timeout."""
+        explicit expired error once it lapses.
+
+        ``generate`` turns the record into an autoregressive generate
+        request (``{"max_new_tokens": 16, "mode": "greedy",
+        "temperature": 1.0, "seed": None}``, all optional): the record
+        carries the encoder tensor plus a ``start`` tensor (the decoder
+        start sign), and the engine answers with the generated
+        ``[steps, dim]`` sequence from the model's bucketed decode loop
+        instead of a one-shot prediction.
+
+        The names ``priority``, ``deadline_ms`` and ``generate`` are
+        therefore reserved and cannot name input tensors. Raises
+        :class:`ShedError` immediately when admission control is shedding
+        the lane — a fast-fail instead of a poll timeout."""
         uri, payload, trace, lane = self._encode(uri, inputs, priority,
-                                                 deadline_ms)
+                                                 deadline_ms, generate)
         try:
             self._client.xadd(self.stream, payload, lane=lane)
         except ShedError:
@@ -150,21 +168,22 @@ class InputQueue:
                                     else image})
 
     def enqueue_batch(self, records, priority: Optional[str] = None,
-                      deadline_ms: Optional[float] = None) -> "list[str]":
+                      deadline_ms: Optional[float] = None,
+                      generate: Optional[Dict] = None) -> "list[str]":
         """Enqueue many records in pipelined socket writes — the high-
         throughput path (the reference client achieves the same with a
         redis-py pipeline of XADDs). ``records`` is an iterable of
         ``(uri, {name: tensor, ...})`` pairs; pass ``None`` as a uri to
         have one generated. Returns the uris in order. ``priority`` /
-        ``deadline_ms`` apply to every record in the batch; a shedding
-        lane raises :class:`ShedError` (some earlier records of the batch
-        may have been accepted — uris are returned only on full
-        success)."""
+        ``deadline_ms`` / ``generate`` apply to every record in the
+        batch; a shedding lane raises :class:`ShedError` (some earlier
+        records of the batch may have been accepted — uris are returned
+        only on full success)."""
         uris, cmds, traces = [], [], []
         lane = schema.validate_priority(priority)
         for uri, inputs in records:
             uri, payload, trace, _ = self._encode(uri, inputs, priority,
-                                                  deadline_ms)
+                                                  deadline_ms, generate)
             uris.append(uri)
             traces.append(trace)
             cmds.append(("XADD", self.stream, payload, lane))

@@ -92,6 +92,23 @@ def image_pipeline(model_name: str, source: str = "imagenet"):
     return ndarray_chain(preprocessor(model_name, source=source))
 
 
+class _GenBatch:
+    """One assembled autoregressive-generate batch riding the dispatch
+    pipeline: the encoder prefill tensor, the decoder start sign, and the
+    request's decode parameters (schema.validate_generate wire form).
+    ``_dispatch`` routes it onto the model's decode loop instead of the
+    one-shot predict; the host-side result rides the pipeline window the
+    way the CPU-failover result does."""
+
+    __slots__ = ("enc", "start", "params", "trace_uris")
+
+    def __init__(self, enc, start, params, trace_uris=()):
+        self.enc = enc
+        self.start = start
+        self.params = dict(params)
+        self.trace_uris = tuple(trace_uris)
+
+
 class ClusterServing:
     """The serving job (ref ClusterServing.scala:31).
 
@@ -148,6 +165,14 @@ class ClusterServing:
     batch-lane enqueues at the broker while per-lane p99 burn says the
     path is saturated (docs/observability.md "Priority lanes & admission
     control").
+
+    Autoregressive generate: a record enqueued with ``generate={...}``
+    (InputQueue/frontend) carries its decode parameters on the trace
+    side channel. The engine assembles generate records into their own
+    batches (identical decode params only) and dispatches the model's
+    decode loop — (sharded) AOT prefill plus bucketed seq-length rungs,
+    inference/generation.py — flushing each record's generated
+    ``[steps, dim]`` sequence as its typed result.
     """
 
     #: consecutive full dequeues that count as "sustained backlog"
@@ -239,8 +264,17 @@ class ClusterServing:
             lane: 0.0 for lane in schema.PRIORITIES}
         self._lanes_priority = ",".join(schema.PRIORITIES)
         # the assembly bucket: decoded records waiting to fill a batch —
-        # (entry_id, uri, inputs, queue_meta, lane, t_arrive, t_deadline)
+        # (entry_id, uri, inputs, queue_meta, lane, t_arrive, t_deadline,
+        #  gen) where gen is the normalized generate request or None
         self._asm: List[tuple] = []
+        # ZOO_SERVING_DECODE_MAX_SEQ: when > 0 and the model supports
+        # warm_decode, ladder warmup ALSO AOT-compiles the autoregressive
+        # decode shapes — every (batch rung × seq-length rung up to this
+        # many positions) pair — so a generate request's growing decoder
+        # buffer swaps rungs without an in-band compile. 0 (default)
+        # leaves decode shapes to compile on first use.
+        raw = os.environ.get("ZOO_SERVING_DECODE_MAX_SEQ", "").strip()
+        self._decode_max_seq = int(raw) if raw else 0
         # ZOO_SERVING_ADMISSION_S: cadence of the admission-control tick
         # (SLO burn check + broker XSHED flip + lane depth gauges);
         # 0 disables admission control entirely
@@ -422,7 +456,8 @@ class ClusterServing:
         out. With the default max_wait of 0 this is the arrival time
         itself — every read dispatches immediately (legacy behavior)."""
         t = float("inf")
-        for _eid, _uri, _inputs, _m, lane, t_arr, t_deadline in self._asm:
+        for _eid, _uri, _inputs, _m, lane, t_arr, t_deadline, _g \
+                in self._asm:
             t = min(t, t_arr + self.max_wait_ms.get(lane, 0.0) / 1000.0)
             if t_deadline is not None:
                 t = min(t, max(t_arr, t_deadline - self.SLACK_MARGIN_S))
@@ -581,10 +616,23 @@ class ClusterServing:
                 self._expire_record(uri, lane, term_cmds)
                 term_acks.append(ack)
                 continue
+            # generate side channel: re-validated at intake so a hand-
+            # rolled record with junk decode params errors HERE, typed,
+            # instead of blowing up the device batch
+            try:
+                g = schema.validate_generate(
+                    meta.get("g") if isinstance(meta, dict) else None)
+            except ValueError as e:
+                term_cmds.append((
+                    "HSET", self.result_key, uri, schema.encode_error(
+                        f"bad generate request: {e}", self.cipher)))
+                self._err_counter.inc()
+                term_acks.append(ack)
+                continue
             self._lane_credit[lane] = \
                 self._lane_credit.get(lane, 0.0) + 1.0
             self._asm.append((eid, uri, inputs, m, lane, t_dq1,
-                              t_deadline))
+                              t_deadline, g))
         if term_acks or term_cmds:
             client.pipeline(term_cmds + term_acks)
             self._mark_done(term_acks, self._conn_gen)
@@ -605,10 +653,27 @@ class ClusterServing:
         self._asm = self._asm[self.batch_size:]
         self._grow_batch_on_backlog(len(take))
 
+        # generate and plain-predict records never share a device batch
+        # (different executables, different result shapes), and generate
+        # records only batch with identical decode params. Dispatch the
+        # largest kind now; the rest go back to the bucket's head — still
+        # un-acked, keeping their lease and arrival stamps, so progress
+        # is guaranteed (every turn serves at least one kind)
+        kinds: Dict = {}
+        for e in take:
+            key = tuple(sorted(e[7].items())) if e[7] is not None else None
+            kinds.setdefault(key, []).append(e)
+        best_kind = max(kinds, key=lambda k: len(kinds[k]))
+        if len(kinds) > 1:
+            self._asm = [e for k, members in kinds.items()
+                         if k != best_kind for e in members] + self._asm
+            take = kinds[best_kind]
+        gen_params = dict(best_kind) if best_kind is not None else None
+
         err_cmds: list = []
         ack_cmds = []
         uris, rows, metas = [], [], []
-        for eid, uri, inputs, m, lane, _t_arr, t_deadline in take:
+        for eid, uri, inputs, m, lane, _t_arr, t_deadline, _g in take:
             ack_cmds.append(("XACK", self.stream, self.group, str(eid)))
             if t_deadline is not None and now >= t_deadline:
                 # expired while waiting in the bucket
@@ -644,22 +709,53 @@ class ClusterServing:
             client.pipeline(err_cmds + ack_cmds)
             self._mark_done(ack_cmds, self._conn_gen)
             return None
-        cols = self.input_cols or sorted(rows[0].keys())
-        batch = [np.stack([r[c] for r in rows]) for c in cols]
         n = len(rows)
-        # pad to the nearest ladder rung at or below the current bucket —
-        # a short dequeue rides a smaller pre-compiled executable instead
-        # of padding all the way up (zoo_bucket_pad_fraction is the waste)
-        rung = min(self.ladder.rung_for(n), self.batch_size)
-        batch = list(compile_ahead.pad_to_rung(batch, rung, site="serving"))
+        sampled = self._tracer.should_sample()
+        if gen_params is not None:
+            # generate batch: the record's "start" tensor seeds the
+            # decoder, its remaining tensor feeds the encoder prefill;
+            # both pad to the batch rung so prefill rides the same
+            # pre-compiled (sharded) rungs as plain predicts
+            bad = None
+            if "start" not in rows[0]:
+                bad = "generate records need a 'start' input tensor"
+            elif len(rows[0]) != 2:
+                bad = ("generate records carry exactly two inputs: the "
+                       "encoder tensor and 'start'")
+            if bad is not None:
+                for uri in uris:
+                    err_cmds.append((
+                        "HSET", self.result_key, uri,
+                        schema.encode_error(bad, self.cipher)))
+                    self._err_counter.inc()
+                client.pipeline(err_cmds + ack_cmds)
+                self._mark_done(ack_cmds, self._conn_gen)
+                return None
+            enc_col = next(k for k in sorted(rows[0]) if k != "start")
+            rung = min(self.ladder.rung_for(n), self.batch_size)
+            enc, start = list(compile_ahead.pad_to_rung(
+                [np.stack([r[enc_col] for r in rows]),
+                 np.stack([r["start"] for r in rows])],
+                rung, site="serving"))
+            x = _GenBatch(enc, start, gen_params,
+                          tuple(uris) if sampled else ())
+        else:
+            cols = self.input_cols or sorted(rows[0].keys())
+            batch = [np.stack([r[c] for r in rows]) for c in cols]
+            # pad to the nearest ladder rung at or below the current
+            # bucket — a short dequeue rides a smaller pre-compiled
+            # executable instead of padding all the way up
+            # (zoo_bucket_pad_fraction is the waste)
+            rung = min(self.ladder.rung_for(n), self.batch_size)
+            batch = list(compile_ahead.pad_to_rung(batch, rung,
+                                                   site="serving"))
+            x = batch[0] if len(batch) == 1 else tuple(batch)
         t_pp1 = time.perf_counter()
         self.timer.record("preprocess", t_pp1 - t0)
-        x = batch[0] if len(batch) == 1 else tuple(batch)
         # trace=(dequeue start/end, preprocess start/end) when this batch
         # is sampled — _finish turns the stamps plus the Completed's
         # dispatch/device timing into per-uri spans
-        trace = (t_dq0, t_dq1, t0, t_pp1) \
-            if self._tracer.should_sample() else None
+        trace = (t_dq0, t_dq1, t0, t_pp1) if sampled else None
         # x rides the ctx too so a backend-lost batch can be re-dispatched
         # on the CPU fallback at retire time (_failover_redispatch); the
         # connection generation gates the dedupe bookkeeping in _finish
@@ -791,6 +887,7 @@ class ClusterServing:
             if has_spec is not None and not has_spec():
                 return False           # retry once the model is loaded
             warm_up(rungs=list(self._warm_rungs))
+            self._kick_decode_warmup()
             self._warm_kicked = True
             return True
         except Exception:
@@ -798,6 +895,22 @@ class ClusterServing:
                              "with in-band compiles")
             self._warm_kicked = True
             return False
+
+    def _kick_decode_warmup(self):
+        """AOT-warm the autoregressive decode rungs too
+        (``ZOO_SERVING_DECODE_MAX_SEQ`` > 0 and the model supports
+        ``warm_decode``): every batch-rung × seq-length-rung pair
+        compiles in the background, so a generate request's growing
+        decoder buffer swaps rungs without an in-band compile."""
+        if self._decode_max_seq <= 0:
+            return
+        fn = getattr(self.model, "warm_decode", None)
+        if fn is None:
+            return
+        try:
+            fn(self._decode_max_seq, rungs=list(self._warm_rungs))
+        except Exception:
+            logger.debug("decode warmup kick failed", exc_info=True)
 
     def wait_warm(self, timeout: Optional[float] = None
                   ) -> "ClusterServing":
@@ -815,12 +928,34 @@ class ClusterServing:
         While failover is active, dispatch routes to the pre-built CPU
         rung instead — synchronous by nature, the host result rides the
         pipeline window as-is."""
+        if isinstance(x, _GenBatch):
+            return self._dispatch_generate(x)
         if self.failover_active:
             cpu_predict = getattr(self.model, "predict_cpu", None)
             if cpu_predict is not None:
                 return cpu_predict(x)
         fn = getattr(self.model, "predict_async", None)
         return fn(x) if fn is not None else self.model.predict(x)
+
+    def _dispatch_generate(self, gb: "_GenBatch"):
+        """Run one generate batch's decode loop: (sharded) AOT prefill
+        plus ``n`` bucketed decode steps (inference/generation.py).
+        Synchronous by nature — every step feeds the previous step's
+        output back — so the host ``[batch, steps, dim]`` result rides
+        the pipeline window as-is, like the CPU-failover path. Sampled
+        batches pass their uris through as decode-span trace ids."""
+        p = gb.params
+        n = int(p.get("n", 16))
+        kw = dict(mode=p.get("m", "greedy"),
+                  temperature=float(p.get("t", 1.0)), seed=p.get("s"))
+        fn = getattr(self.model, "generate", None)
+        if fn is not None:
+            return fn(gb.enc, gb.start, n, trace_ids=gb.trace_uris, **kw)
+        fn = getattr(self.model, "infer", None)
+        if fn is not None:       # duck-typed zoo model (e.g. Seq2Seq)
+            return fn(gb.enc, gb.start, n + 1, **kw)
+        raise TypeError("model supports neither generate() nor infer() — "
+                        "generate records need an autoregressive model")
 
     def _fetch(self, pending):
         fn = getattr(self.model, "predict_fetch", None)
@@ -864,7 +999,9 @@ class ClusterServing:
         then falls through to the normal error-result path."""
         x = comp.ctx[6] if len(comp.ctx) > 6 else None
         cpu_predict = getattr(self.model, "predict_cpu", None)
-        if x is None or cpu_predict is None:
+        if x is None or cpu_predict is None or isinstance(x, _GenBatch):
+            # a generate batch has no one-shot CPU rung to fail over to —
+            # its records take the normal error-result path
             return None
         self._enter_failover(comp.error)
         try:
@@ -1224,6 +1361,16 @@ class ClusterServing:
                    "records_expired": self.records_expired,
                    "admission_shedding": self.admission_shedding}
         out.update(self.timer.summary())
+        # model-parallel placement: strategy, shard count and per-shard
+        # HBM bytes when the model was sharded (InferenceModel.shard)
+        fn = getattr(self.model, "shard_info", None)
+        if fn is not None:
+            try:
+                info = fn()
+            except Exception:
+                info = None
+            if info:
+                out["sharding"] = info
         return out
 
     def __enter__(self):

@@ -10,6 +10,10 @@ enqueues to Redis and awaits the result). Endpoints:
   broker lane and ``"deadline_ms"`` bounds result staleness: a shed lane
   answers 429 immediately (``code: "shed"``), an expired deadline answers
   504 with ``code: "expired"`` instead of the generic poll timeout.
+  Optional ``"generate"`` (``{"max_new_tokens", "mode", "temperature",
+  "seed"}``) turns the record into an autoregressive generate request —
+  inputs then carry the encoder tensor plus a ``start`` tensor, and the
+  result is the engine's generated ``[steps, dim]`` sequence.
 - ``GET  /metrics``  → engine metrics JSON by default; Prometheus text
   exposition (format 0.0.4) when the request asks for it — ``Accept:``
   containing ``text/plain`` or ``openmetrics``, or ``?format=prometheus``.
@@ -25,7 +29,8 @@ enqueues to Redis and awaits the result). Endpoints:
   degrades the response to partial instead of failing it.
 - ``GET  /healthz``  → readiness JSON: broker reachability, input queue
   depth (total and per priority lane), consumer-group backlog, lane
-  admission state, fleet replica counts, SLO burn rates.
+  admission state, fleet replica counts, SLO burn rates, and — when the
+  model is sharded — the ``sharding`` block with per-shard HBM bytes.
   503 when the broker is unreachable, when the queue depth exceeds
   ``max_backlog``, or when the SLO monitor (common/slo.py) sheds —
   every window's burn rate past ``ZOO_SLO_SHED_BURN`` — so load
@@ -283,6 +288,17 @@ class _Handler(BaseHTTPRequestHandler):
         # replica is visible from the probe itself; the probe thread is
         # timeout-joined, so a wedged backend can never hang /healthz
         out["backend"] = profiling.backend_state(timeout_s=2.0)
+        # model-parallel placement when the engine's model is sharded:
+        # strategy, shard count, total and PER-SHARD parameter HBM bytes
+        # — capacity dashboards read placement from the liveness probe
+        si = getattr(getattr(engine, "model", None), "shard_info", None)
+        if si is not None:
+            try:
+                info = si()
+            except Exception:
+                info = None
+            if info:
+                out["sharding"] = info
         sup = resilience.supervisor_snapshot()
         if sup is not None:
             out["backend_supervisor"] = sup
@@ -354,6 +370,7 @@ class _Handler(BaseHTTPRequestHandler):
             uri = in_q.enqueue(payload.get("uri"),
                                priority=payload.get("priority"),
                                deadline_ms=payload.get("deadline_ms"),
+                               generate=payload.get("generate"),
                                **inputs)
             t_enq1 = time.perf_counter()
         except ShedError as e:

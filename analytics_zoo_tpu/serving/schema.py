@@ -55,6 +55,46 @@ def validate_priority(priority: Optional[str]) -> str:
     return priority
 
 
+#: generation feedback modes a generate record may request (mirrors
+#: inference/generation.MODES — duplicated so the wire schema stays
+#: importable without the jax-backed inference stack)
+GENERATE_MODES = ("raw", "greedy", "sample")
+
+
+def validate_generate(generate) -> Optional[Dict[str, Any]]:
+    """Normalize a client ``generate`` request into the compact wire form
+    carried on the record's trace side channel (the ``"g"`` key):
+    ``{"n": steps[, "m": mode, "t": temperature, "s": seed]}`` — defaults
+    (greedy, temperature 1.0, no seed) are omitted from the wire. Accepts
+    the long keys ``max_new_tokens``/``mode``/``temperature``/``seed`` or
+    the wire keys; ``None`` passes through (not a generate record)."""
+    if generate is None:
+        return None
+    if not isinstance(generate, dict):
+        raise ValueError("generate must be a dict of decode options")
+    g = dict(generate)
+    n = g.pop("max_new_tokens", g.pop("n", 16))
+    mode = g.pop("mode", g.pop("m", "greedy"))
+    temperature = g.pop("temperature", g.pop("t", 1.0))
+    seed = g.pop("seed", g.pop("s", None))
+    if g:
+        raise ValueError(f"unknown generate keys: {sorted(g)}")
+    n = int(n)
+    if n < 1:
+        raise ValueError(f"generate max_new_tokens must be >= 1, got {n}")
+    if mode not in GENERATE_MODES:
+        raise ValueError(
+            f"bad generate mode {mode!r}: one of {GENERATE_MODES}")
+    out: Dict[str, Any] = {"n": n}
+    if mode != "greedy":
+        out["m"] = str(mode)
+    if float(temperature) != 1.0:
+        out["t"] = float(temperature)
+    if seed is not None:
+        out["s"] = int(seed)
+    return out
+
+
 class ImageBytes:
     """Raw encoded image (JPEG/PNG) riding a record — decoded and run
     through the engine-side preprocessing chain, exactly the reference's

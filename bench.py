@@ -336,6 +336,9 @@ SERVE_N, SERVE_BATCH, SERVE_HIDDEN, SERVE_WINDOW = 2048, 64, 256, 4
 # best-of-k per mode, interleaved: single-core broker/scheduler jitter
 # swings a lone pass by ~±15%, drowning the overlap delta
 SERVE_REPS = 3
+# autoregressive decode bench shapes (shrunk by smoke): batch rows
+# decoded together × generated positions per row
+DECODE_BATCH, DECODE_STEPS, DECODE_HIDDEN = 8, 32, 64
 
 
 def _serve_once(im, payloads, tag, pipeline_window=SERVE_WINDOW):
@@ -506,6 +509,149 @@ def _measure_cold_start():
         "serving_post_warmup_recompiles": int(jit_misses() - base),
         "serving_bucket_growth": growth,
         "serving_bucket_peak": peak,
+    }
+
+
+def measure_serving_sharded():
+    """Model-parallel serving (ISSUE 14): the engine dispatching through
+    the ShardedExecutable seam — parameters partitioned across every
+    visible device (parallel/mesh + strategy), warmup walking the bucket
+    ladder with sharded avals. Gated artifacts: end-to-end records/s
+    through the sharded executable, the max per-shard parameter fraction
+    (< 1.0 proves no single device holds the full model), and ZERO
+    post-warmup recompiles across a bucket-growth boundary. Reproduce
+    off-chip with ``XLA_FLAGS=--xla_force_host_platform_device_count=8``
+    on CPU."""
+    import jax
+    import numpy as np
+    import flax.linen as nn
+    from analytics_zoo_tpu.common import telemetry
+    from analytics_zoo_tpu.inference import InferenceModel
+    from analytics_zoo_tpu.serving import (
+        Broker, ClusterServing, InputQueue, OutputQueue,
+    )
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return {"serving_sharded_skipped":
+                f"needs >= 2 devices, have {n_dev}"}
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            for _ in range(3):
+                x = nn.relu(nn.Dense(SERVE_HIDDEN)(x))
+            return nn.Dense(8)(x)
+
+    def jit_misses():
+        fam = telemetry.snapshot().get("zoo_jit_cache_misses_total", {})
+        if not isinstance(fam, dict):
+            return float(fam or 0.0)
+        return float(fam.get("fn=inference_model", 0.0))
+
+    im = InferenceModel().load_flax(Net(), np.zeros((1, 16), np.float32))
+    # tensor-parallel over every device: Dense kernels split on the
+    # output-feature axis, biases replicate
+    im.shard(f"tp{n_dev}", param_rules=[(r"kernel", (None, "model"))])
+    info = im.shard_info()
+    max_fraction = max(info["shard_hbm_bytes"].values()) \
+        / max(info["total_param_bytes"], 1)
+    min_rung = max(2, SERVE_BATCH // 4)
+    # enough backlog that dequeues at the bottom rung come back full far
+    # past BACKLOG_GROW_AFTER — at least one growth boundary is crossed
+    n = 24 * min_rung
+    rng = np.random.default_rng(21)
+    payloads = rng.standard_normal((n, 16)).astype(np.float32)
+    with Broker.launch() as broker:
+        eng = ClusterServing(im, broker.port, batch_size=min_rung,
+                             min_batch_size=min_rung,
+                             max_batch_size=SERVE_BATCH,
+                             pipeline_window=2)
+        start_rung = eng.batch_size
+        in_q = InputQueue(port=broker.port)
+        out_q = OutputQueue(port=broker.port)
+        eng.start()
+        eng.wait_warm(timeout=240.0)
+        base = jit_misses()
+        t0 = time.perf_counter()
+        uris = in_q.enqueue_batch(
+            (f"sh{i}", {"x": payloads[i]}) for i in range(n))
+        res = out_q.query_many(uris, timeout=120.0)
+        dt = time.perf_counter() - t0
+        peak = eng.batch_size
+        eng.stop()
+    missing = [u for u, v in res.items() if v is None]
+    assert not missing, f"{len(missing)} sharded records unanswered"
+    growth = eng.ladder.rungs.index(peak) \
+        - eng.ladder.rungs.index(start_rung)
+    return {
+        "serving_sharded_records_per_sec": round(n / dt, 1),
+        "serving_sharded_n_shards": int(info["n_shards"]),
+        "serving_sharded_max_shard_fraction": round(max_fraction, 4),
+        "serving_sharded_post_warmup_recompiles":
+            int(jit_misses() - base),
+        "serving_sharded_bucket_growth": growth,
+    }
+
+
+def measure_decode():
+    """Autoregressive decode through the bucketed KV-cache ladder
+    (ISSUE 14): InferenceModel.generate over the seq2seq zoo, with the
+    (batch rung × seq rung) decode grid AOT-built by ``warm_decode``
+    first so the loop's rung growth never recompiles. Gated artifacts:
+    ``decode_tokens_per_sec`` (higher-better) and the per-step latency
+    tail ``decode_p99_ms`` (lower-better via the ``_p99_ms`` rule)."""
+    import numpy as np
+    from analytics_zoo_tpu.common import compile_ahead, telemetry
+    from analytics_zoo_tpu.inference import InferenceModel, generation
+    from analytics_zoo_tpu.models import Seq2Seq
+
+    batch, steps = DECODE_BATCH, DECODE_STEPS
+    m = Seq2Seq(input_dim=8, output_dim=8, hidden_size=DECODE_HIDDEN,
+                rnn_type="gru", encoder_seq_len=8, decoder_seq_len=4)
+    im = InferenceModel().load_zoo(m)
+    rng = np.random.default_rng(7)
+    enc = rng.standard_normal((batch, 8, 8)).astype(np.float32)
+    start = np.zeros((batch, 8), np.float32)
+    # one predict registers the 2-input spec, then the decode grid for
+    # this batch rung compiles ahead of the measured loop
+    im.predict((enc, np.zeros((batch, 1, 8), np.float32)))
+    im.set_ladder(compile_ahead.BucketLadder(batch, batch))
+    im.warm_decode(steps + 1, block=True)
+
+    def jit_misses():
+        fam = telemetry.snapshot().get("zoo_jit_cache_misses_total", {})
+        if not isinstance(fam, dict):
+            return float(fam or 0.0)
+        return float(fam.get("fn=inference_model", 0.0))
+
+    ladder = generation.seq_ladder(steps + 1)
+    step_times = []
+
+    def timed_step(e, d):
+        t0 = time.perf_counter()
+        out = np.asarray(im.predict_fetch(im.predict_async((e, d))))
+        step_times.append(time.perf_counter() - t0)
+        return out
+
+    # untimed pass absorbs any residual first-touch cost, then the
+    # measured pass must run entirely on pre-built executables
+    generation.decode_loop(timed_step, enc, start, steps, ladder=ladder,
+                           mode="greedy")
+    step_times.clear()
+    base = jit_misses()
+    t0 = time.perf_counter()
+    gen = generation.decode_loop(timed_step, enc, start, steps,
+                                 ladder=ladder, mode="greedy")
+    dt = time.perf_counter() - t0
+    assert gen.shape == (batch, steps, 8)
+    return {
+        "decode_tokens_per_sec": round(batch * steps / dt, 1),
+        "decode_p99_ms": round(
+            float(np.percentile(step_times, 99)) * 1000.0, 3),
+        "decode_steps": steps,
+        "decode_batch": batch,
+        "decode_post_warmup_recompiles": int(jit_misses() - base),
     }
 
 
@@ -1295,7 +1441,12 @@ def _find_previous_bench_record(bench_dir: str | None = None):
 _LOWER_BETTER_SUFFIXES = ("_p50_ms", "_p99_ms", "_p99_interactive_ms",
                           "_p50_interactive_ms", "_ms", "_ms_per_batch32",
                           "cold_start_seconds", "failover_seconds",
-                          "_seconds", "_s")
+                          "_seconds", "_s",
+                          # ISSUE 14: post-warmup recompiles must stay at
+                          # zero (any growth is a compile-ahead ladder
+                          # leak) and the largest shard's fraction of the
+                          # model must shrink or hold as sharding improves
+                          "_recompiles", "_shard_fraction")
 # bookkeeping fields that are numeric but not performance metrics
 _GATE_SKIP = {"n", "rc"}
 
@@ -1552,6 +1703,7 @@ def _smoke():
     global PRIO_FLOOD, PRIO_INT
     global RECSYS_ROWS, RECSYS_SHARDS, RECSYS_USERS, RECSYS_ITEMS
     global RECSYS_BATCH
+    global DECODE_BATCH, DECODE_STEPS, DECODE_HIDDEN
     N_ROWS, BATCH = 2048, 256
     WARMUP_STEPS, MEASURE_STEPS, STEPS_PER_LOOP = 2, 4, 2
     SERVE_N, SERVE_BATCH, SERVE_HIDDEN = 64, 8, 32
@@ -1560,13 +1712,16 @@ def _smoke():
     RECSYS_ROWS, RECSYS_SHARDS = 1500, 4
     RECSYS_USERS, RECSYS_ITEMS = 60, 40
     RECSYS_BATCH = 128
+    DECODE_BATCH, DECODE_STEPS, DECODE_HIDDEN = 4, 8, 16
     out = {
         "metric": "ncf_train_samples_per_sec",
         "value": 0.0, "unit": "samples/s", "vs_baseline": 0.0,
         "mode": "smoke",
         "device": jax.devices()[0].device_kind,
     }
-    rec = _assemble_record(out, (measure_serving, measure_serving_failover,
+    rec = _assemble_record(out, (measure_serving, measure_serving_sharded,
+                                 measure_decode,
+                                 measure_serving_failover,
                                  measure_serving_multi_replica,
                                  measure_replica_kill_failover,
                                  measure_serving_priority,
@@ -1610,6 +1765,7 @@ def main():
     }
     _run_with_deadline(
         out, (measure_bert, measure_tcn, measure_serving,
+              measure_serving_sharded, measure_decode,
               measure_serving_failover, measure_serving_multi_replica,
               measure_replica_kill_failover, measure_serving_priority,
               measure_flash_attention,

@@ -9,7 +9,7 @@
 #   dev/run-tests.sh smoke        # fast pre-push subset (<5 min, 1 core)
 #   Lanes: smoke core data keras models zouwu automl serving interop
 #          examples telemetry fleet resilience zoolint kernels chaos
-#          scheduling
+#          scheduling sharded
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -318,6 +318,53 @@ assert rec.get("serving_priority_flood_records", 0) > 0, \
     "drill ran without a batch-lane flood"
 print(f"scheduling OK: interactive p99={p99}ms (budget {budget}ms) "
       f"mixed throughput={rps} rec/s")
+PY
+            ;;
+  # sharded executor seam + bucketed decode (ISSUE 14): dispatch
+  # equivalence and recompile-flat warm rungs on the forced 8-device
+  # mesh, bitwise rung-padding parity, the end-to-end generate flow —
+  # then the sharded/decode bench measures at smoke size. The seeded
+  # zoolint fixture must flag undeclared zoo_shard_* / zoo_decode_*
+  # names: a quiet drift check on the new families means the linter
+  # regressed, not that the tree is clean.
+  sharded)  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+              run -m "not slow" tests/test_generation.py \
+              tests/test_sharded_serving.py
+            echo "== zoolint: drift must flag undeclared shard/decode names"
+            drift="$(python -m analytics_zoo_tpu.analysis --no-baseline \
+                       tests/fixtures/zoolint 2>&1 || true)"
+            for name in zoo_shard_hbm_bogus_bytes \
+                        zoo_decode_steps_bogus_total \
+                        ZOO_SERVING_DECODE_BOGUS_SEQ; do
+              if ! grep -q "$name" <<<"$drift"; then
+                echo "catalog drift missed the seeded $name violation" >&2
+                exit 1
+              fi
+            done
+            echo "== bench sharded/decode smoke (8 forced host devices)"
+            JAX_PLATFORMS=cpu \
+              XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+              python - <<'PY'
+import bench
+bench.SERVE_BATCH, bench.SERVE_HIDDEN = 8, 32
+bench.DECODE_BATCH, bench.DECODE_STEPS, bench.DECODE_HIDDEN = 4, 8, 16
+sh = bench.measure_serving_sharded()
+# the tentpole's proof obligations: every device carries a strict
+# fraction of the model, and a post-warmup burst crossing a bucket
+# growth boundary never recompiles
+assert sh.get("serving_sharded_n_shards") == 8, sh
+assert 0 < sh["serving_sharded_max_shard_fraction"] < 1.0, sh
+assert sh["serving_sharded_post_warmup_recompiles"] == 0, sh
+assert sh["serving_sharded_bucket_growth"] >= 1, sh
+assert sh["serving_sharded_records_per_sec"] > 0, sh
+dec = bench.measure_decode()
+assert dec["decode_tokens_per_sec"] > 0, dec
+assert dec["decode_post_warmup_recompiles"] == 0, dec
+print(f"sharded OK: {sh['serving_sharded_records_per_sec']} rec/s "
+      f"max_shard_fraction={sh['serving_sharded_max_shard_fraction']} "
+      f"growth={sh['serving_sharded_bucket_growth']} recompiles=0")
+print(f"decode OK: {dec['decode_tokens_per_sec']} tok/s "
+      f"p99={dec['decode_p99_ms']}ms recompiles=0")
 PY
             ;;
   release)  bash "$(dirname "$0")/release.sh" ;;

@@ -28,4 +28,14 @@ def register_bogus(registry):
                        "not in docs")  # VIOLATION metric-undocumented
     wait = os.getenv(
         "ZOO_SERVING_MAX_WAIT_BOGUS_MS")  # VIOLATION envvar-undocumented
-    return c, flag, g, knob, r, lease, d, wait
+    # sharded-executor families the catalog does NOT list: the drift
+    # check must flag new per-shard / decode metrics (zoo_shard_hbm_bytes
+    # and the decode counters landed with the sharded seam; undeclared
+    # siblings must fire, not coast on the prefix)
+    s = registry.gauge("zoo_shard_hbm_bogus_bytes", ("shard",),
+                      )  # VIOLATION metric-undocumented
+    t = registry.counter("zoo_decode_steps_bogus_total",
+                         "not in docs")  # VIOLATION metric-undocumented
+    seq = os.getenv(
+        "ZOO_SERVING_DECODE_BOGUS_SEQ")  # VIOLATION envvar-undocumented
+    return c, flag, g, knob, r, lease, d, wait, s, t, seq
