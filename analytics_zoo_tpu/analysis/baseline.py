@@ -235,6 +235,26 @@ def migrate(path: str, findings: List[Finding],
     return len(entries), dropped
 
 
+def prune(path: str, stale_fps: Iterable[str]) -> int:
+    """Rewrite the baseline at ``path`` without the given fingerprints,
+    preserving entry order and justifications. Returns how many entries
+    were removed. A missing file prunes nothing."""
+    if not os.path.isfile(path):
+        return 0
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    drop = set(stale_fps)
+    entries = [e for e in data.get("entries", ())
+               if e.get("fingerprint") not in drop]
+    removed = len(data.get("entries", ())) - len(entries)
+    if removed:
+        data["entries"] = entries
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(data, fh, indent=2, sort_keys=False)
+            fh.write("\n")
+    return removed
+
+
 def apply(findings: List[Finding], baseline: Dict[str, dict],
           root: Optional[str]) -> Tuple[List[Finding], List[dict]]:
     """(surviving findings, stale baseline entries). A stale entry's

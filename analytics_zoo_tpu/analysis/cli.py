@@ -12,14 +12,15 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import time
 import traceback
 from typing import List, Optional
 
 from analytics_zoo_tpu.analysis import baseline as baseline_lib
 from analytics_zoo_tpu.analysis import report
 from analytics_zoo_tpu.analysis.core import (
-    all_rules, analyze_paths, build_model_for_paths, find_repo_root,
-    iter_python_files, relpath,
+    CFG_STATS, all_rules, analyze_paths, build_model_for_paths,
+    find_repo_root, iter_python_files, relpath,
 )
 
 
@@ -50,6 +51,15 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--write-baseline", action="store_true",
                    help="write the current findings to the baseline "
                         "(preserving surviving justifications) and exit 0")
+    p.add_argument("--prune-baseline", nargs="?", const="report",
+                   choices=("report", "fix"), metavar="fix",
+                   help="list baseline entries whose fingerprint matched "
+                        "no finding in this scan; --prune-baseline=fix "
+                        "also deletes them from the file (exit 0 either "
+                        "way)")
+    p.add_argument("--timing", action="store_true",
+                   help="print scan wall time and CFG cache statistics "
+                        "to stderr")
     p.add_argument("--migrate-baseline", action="store_true",
                    help="one-shot rewrite of a version-1 baseline to the "
                         "line-drift-stable version-2 fingerprints")
@@ -108,8 +118,16 @@ def _run(args) -> int:
               f"({len(model.roots)} roots)")
         return 0
 
+    CFG_STATS["built"] = CFG_STATS["hits"] = 0
+    t0 = time.perf_counter()
     findings = analyze_paths(args.paths, rules=rules, root=root,
                              jobs=_jobs(args))
+    if args.timing:
+        n_files = sum(1 for _ in iter_python_files(args.paths))
+        print(f"zoolint: scanned {n_files} files in "
+              f"{time.perf_counter() - t0:.2f}s (CFGs "
+              f"built={CFG_STATS['built']} "
+              f"cache-hits={CFG_STATS['hits']})", file=sys.stderr)
 
     baseline_path = args.baseline
     if baseline_path is None and root is not None:
@@ -139,6 +157,38 @@ def _run(args) -> int:
             return 2
         n = baseline_lib.save(baseline_path, findings, root)
         print(f"baseline written: {baseline_path} ({n} entries)")
+        return 0
+    if args.prune_baseline:
+        if baseline_path is None or not os.path.isfile(baseline_path):
+            print("--prune-baseline: no baseline file to prune")
+            return 0
+        try:
+            entries = baseline_lib.load(baseline_path)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        # like apply() below, only entries this run could have re-found
+        # are judged — a partial scan must never prune what it cannot see
+        scanned = {relpath(p, root) for p in iter_python_files(args.paths)}
+        in_scope = {fp: e for fp, e in entries.items()
+                    if e["path"] in scanned and e["rule"] in rules}
+        _, stale = baseline_lib.apply(findings, in_scope, root)
+        if not stale:
+            print(f"baseline {baseline_path}: 0 stale entries "
+                  f"({len(in_scope)} in scope)")
+            return 0
+        for e in stale:
+            print(f"stale baseline entry {e['fingerprint']} "
+                  f"({e['rule']} at {e['path']}:{e['line']})")
+        if args.prune_baseline == "fix":
+            n = baseline_lib.prune(
+                baseline_path, {e["fingerprint"] for e in stale})
+            print(f"baseline pruned: {baseline_path} "
+                  f"({n} entries removed)")
+        else:
+            print(f"{len(stale)} stale entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} — re-run with "
+                  f"--prune-baseline=fix to delete them")
         return 0
 
     stale: List[dict] = []

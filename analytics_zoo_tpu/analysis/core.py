@@ -21,10 +21,13 @@ Two rule scopes:
 from __future__ import annotations
 
 import ast
+import hashlib
 import os
 import re
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import (Any, Callable, Dict, Iterable, List, Optional, Sequence,
+                    Set, Tuple)
 
 #: path segments whose files count as hot-path (the serve/dispatch/train
 #: inner loops) — hot-path-only rules look at these trees exclusively
@@ -115,6 +118,7 @@ class FileContext:
     imports: ImportMap = None  # type: ignore[assignment]
     _order: Optional[List[ast.AST]] = None
     _span: Optional[Dict[int, Tuple[int, int]]] = None
+    _cfg_cache: Optional[Dict[int, Tuple[str, "CFG"]]] = None
 
     def __post_init__(self):
         self.lines = self.source.splitlines()
@@ -161,6 +165,39 @@ class FileContext:
 
     def line_text(self, line: int) -> str:
         return self.lines[line - 1] if 0 < line <= len(self.lines) else ""
+
+    def func_hash(self, func: ast.AST) -> str:
+        """v2 normalized-statement hash of a function's source extent —
+        the CFG cache validator. Same normalization as the baseline v2
+        fingerprints (comments stripped, whitespace collapsed), so a
+        comment/formatting edit does not invalidate a cached CFG."""
+        from analytics_zoo_tpu.analysis import baseline as _baseline
+        lo = getattr(func, "lineno", 1)
+        hi = getattr(func, "end_lineno", lo) or lo
+        parts = []
+        for ln in range(lo, hi + 1):
+            text = " ".join(_baseline._strip_comment(
+                self.line_text(ln)).split())
+            if text:
+                parts.append(text)
+        digest = hashlib.sha256("\n".join(parts).encode("utf-8"))
+        return digest.hexdigest()[:16]
+
+    def cfg(self, func: ast.AST) -> "CFG":
+        """The control-flow graph of ``func``, memoized per file and
+        keyed by the v2 normalized-statement hash: every path-sensitive
+        rule scanning this file shares one build per function body."""
+        if self._cfg_cache is None:
+            self._cfg_cache = {}
+        fhash = self.func_hash(func)
+        hit = self._cfg_cache.get(id(func))
+        if hit is not None and hit[0] == fhash:
+            CFG_STATS["hits"] += 1
+            return hit[1]
+        CFG_STATS["built"] += 1
+        graph = CFG(func)
+        self._cfg_cache[id(func)] = (fhash, graph)
+        return graph
 
 
 @dataclass
@@ -218,7 +255,8 @@ def register(rule_cls):
 def all_rules() -> Dict[str, Rule]:
     from analytics_zoo_tpu.analysis import (  # noqa: F401
         rules_catalog, rules_compile, rules_concurrency, rules_dataplane,
-        rules_hotpath, rules_jit, rules_locks, rules_ownership,
+        rules_hotpath, rules_jit, rules_lifecycle, rules_locks,
+        rules_ownership, rules_taint,
     )
     return dict(_RULES)
 
@@ -246,6 +284,366 @@ def suppressed(ctx: FileContext, finding: Finding) -> bool:
         if fm and finding.rule in _parse_rule_list(fm.group("rules")):
             return True
     return False
+
+
+# ------------------------------------------------- control-flow graphs
+#
+# Per-function CFGs power the path-sensitive rule families
+# (rules_lifecycle, rules_taint). One statement per block keeps exception
+# edges precise: a statement that may raise mid-block would otherwise
+# leak the block-exit fact onto the handler edge. Synthetic (stmt=None)
+# blocks mark structure: entry/exit/raise, branch joins, loop exits,
+# finally copies, with-exit.
+
+#: built/hit counters for the shared per-file CFG cache — reset by the
+#: CLI per scan, printed by ``--timing`` and the zoolint CI lane.
+CFG_STATS: Dict[str, int] = {"built": 0, "hits": 0}
+
+
+class CFGBlock:
+    """One CFG node. ``stmt`` holds at most one AST statement (None for
+    synthetic blocks); ``label`` says what the block *means* — for
+    ``branch``/``loop-head`` blocks the semantics cover only the test /
+    iterator of the carried If/While/For node, never its body."""
+
+    __slots__ = ("idx", "stmt", "label", "succs", "preds")
+
+    def __init__(self, idx: int, stmt: Optional[ast.AST], label: str):
+        self.idx = idx
+        self.stmt = stmt
+        self.label = label
+        self.succs: List[Tuple[int, str]] = []   # (block idx, edge kind)
+        self.preds: List[Tuple[int, str]] = []
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        at = getattr(self.stmt, "lineno", "-")
+        return f"<B{self.idx} {self.label} L{at}>"
+
+
+class CFG:
+    """Control-flow graph of one function body.
+
+    Edge kinds: ``normal`` (fallthrough), ``true``/``false`` (branch and
+    loop test outcomes), ``back`` (loop back-edge), ``break``,
+    ``continue``, ``return``, ``exc`` (exception edge). Exception edges
+    are *optimistic by construction*: only statements that contain a
+    call, an ``assert``, or a ``raise`` get them, routed through the
+    enclosing handler/finally chain (``finally`` bodies are built twice —
+    a shared normal copy and a shared exceptional copy — plus fresh
+    inline copies for each abrupt ``return``/``break``/``continue`` that
+    crosses them). Analyses that want pessimism simply include the
+    ``raise`` exit in their checked exits; optimistic ones ignore it."""
+
+    def __init__(self, func: ast.AST):
+        self.func = func
+        self.blocks: List[CFGBlock] = []
+        self.entry = 0
+        self.exit = 0
+        self.raise_exit = 0
+        self._stmt_blocks: Dict[int, List[int]] = {}
+        _CFGBuilder(self).build(func)
+
+    def block(self, idx: int) -> CFGBlock:
+        return self.blocks[idx]
+
+    def blocks_of(self, stmt: ast.AST) -> List[int]:
+        """Every block carrying ``stmt`` — 2+ for finally-body and
+        abrupt-exit duplication, else 0 or 1."""
+        return list(self._stmt_blocks.get(id(stmt), ()))
+
+    def edge_kinds(self) -> Set[str]:
+        return {k for b in self.blocks for _, k in b.succs}
+
+
+def _has_call(node: Optional[ast.AST]) -> bool:
+    if node is None:
+        return False
+    return any(isinstance(n, ast.Call) for n in ast.walk(node))
+
+
+_NO_RAISE_STMTS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                   ast.Pass, ast.Global, ast.Nonlocal, ast.Break,
+                   ast.Continue, ast.Import, ast.ImportFrom)
+
+
+def _may_raise(stmt: ast.AST) -> bool:
+    if isinstance(stmt, (ast.Raise, ast.Assert)):
+        return True
+    if isinstance(stmt, _NO_RAISE_STMTS):
+        return False
+    return _has_call(stmt)
+
+
+class _TryFrame:
+    __slots__ = ("handler_entries", "catch_all", "fin_exc_entry")
+
+    def __init__(self, handler_entries, catch_all, fin_exc_entry):
+        self.handler_entries = handler_entries
+        self.catch_all = catch_all
+        self.fin_exc_entry = fin_exc_entry
+
+
+def _is_catch_all(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    for n in names:
+        tail = n.attr if isinstance(n, ast.Attribute) else \
+            n.id if isinstance(n, ast.Name) else ""
+        if tail in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+class _CFGBuilder:
+    def __init__(self, cfg: CFG):
+        self.cfg = cfg
+        self.frames: List[_TryFrame] = []       # enclosing try frames
+        self.fin_pending: List[list] = []       # finalbodys abrupt exits cross
+        self.loops: List[Tuple[int, int, int]] = []  # (head, after, fin depth)
+
+    # -------------------------------------------------------- plumbing
+    def _new(self, stmt: Optional[ast.AST], label: str) -> int:
+        b = CFGBlock(len(self.cfg.blocks), stmt, label)
+        self.cfg.blocks.append(b)
+        if stmt is not None:
+            self.cfg._stmt_blocks.setdefault(id(stmt), []).append(b.idx)
+        return b.idx
+
+    def _edge(self, src: Optional[int], dst: int, kind: str):
+        if src is None:
+            return
+        self.cfg.blocks[src].succs.append((dst, kind))
+        self.cfg.blocks[dst].preds.append((src, kind))
+
+    def _exc_edges(self, b: int, frames: Optional[List[_TryFrame]] = None):
+        """Route an exception raised at block ``b`` through the handler/
+        finally chain: innermost handlers first; a catch-all stops the
+        walk; a finally (exceptional copy) absorbs the escape — its tail
+        continues outward with the frames outside it."""
+        frames = self.frames if frames is None else frames
+        for fr in reversed(frames):
+            for h in fr.handler_entries:
+                self._edge(b, h, "exc")
+            if fr.catch_all:
+                return
+            if fr.fin_exc_entry is not None:
+                self._edge(b, fr.fin_exc_entry, "exc")
+                return
+        self._edge(b, self.cfg.raise_exit, "exc")
+
+    def _inline_finallys(self, cur: int, upto: int) -> int:
+        """Fresh copies of every pending finally body from innermost down
+        to depth ``upto`` — the path a return/break/continue actually
+        executes on its way out. Each copy is built with only the
+        *outer* finallys pending, so a return inside a finally body
+        inlines outward instead of recursing into itself."""
+        saved = self.fin_pending
+        idx = len(saved)
+        while idx > upto and cur is not None:
+            idx -= 1
+            self.fin_pending = saved[:idx]
+            cur = self._seq(saved[idx], cur, "normal")
+        self.fin_pending = saved
+        return cur
+
+    # ------------------------------------------------------- dispatch
+    def build(self, func: ast.AST):
+        self.cfg.entry = self._new(None, "entry")
+        self.cfg.exit = self._new(None, "exit")
+        self.cfg.raise_exit = self._new(None, "raise")
+        cur = self._seq(getattr(func, "body", []), self.cfg.entry, "normal")
+        self._edge(cur, self.cfg.exit, "normal")
+
+    def _seq(self, stmts, cur: Optional[int], kind: str) -> Optional[int]:
+        first = True
+        for s in stmts:
+            if cur is None:                 # unreachable tail: still built
+                cur = self._new(None, "unreachable")
+                first = False
+            cur = self._stmt(s, cur, kind if first else "normal")
+            first = False
+        return cur
+
+    def _stmt(self, node, cur, kind) -> Optional[int]:
+        if isinstance(node, ast.If):
+            return self._branch(node, cur, kind)
+        if isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+            return self._loop(node, cur, kind)
+        if isinstance(node, ast.Try):
+            return self._try(node, cur, kind)
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            return self._with(node, cur, kind)
+        if isinstance(node, ast.Match):
+            return self._match(node, cur, kind)
+        b = self._new(node, type(node).__name__.lower())
+        self._edge(cur, b, kind)
+        if isinstance(node, ast.Return):
+            if _may_raise(node):
+                self._exc_edges(b)
+            end = self._inline_finallys(b, 0)
+            self._edge(end, self.cfg.exit, "return")
+            return None
+        if isinstance(node, ast.Raise):
+            self._exc_edges(b)
+            return None
+        if isinstance(node, (ast.Break, ast.Continue)):
+            if self.loops:
+                head, after, depth = self.loops[-1]
+                end = self._inline_finallys(b, depth)
+                if isinstance(node, ast.Break):
+                    self._edge(end, after, "break")
+                else:
+                    self._edge(end, head, "continue")
+            return None
+        if _may_raise(node):
+            self._exc_edges(b)
+        return b
+
+    def _branch(self, node: ast.If, cur, kind) -> Optional[int]:
+        b = self._new(node, "branch")
+        self._edge(cur, b, kind)
+        if _has_call(node.test):
+            self._exc_edges(b)
+        join = self._new(None, "join")
+        tcur = self._seq(node.body, b, "true")
+        self._edge(tcur, join, "normal")
+        if node.orelse:
+            ecur = self._seq(node.orelse, b, "false")
+            self._edge(ecur, join, "normal")
+        else:
+            self._edge(b, join, "false")
+        return join if self.cfg.blocks[join].preds else None
+
+    def _loop(self, node, cur, kind) -> int:
+        head = self._new(node, "loop-head")
+        self._edge(cur, head, kind)
+        test = node.test if isinstance(node, ast.While) else node.iter
+        if _has_call(test):
+            self._exc_edges(head)
+        after = self._new(None, "loop-exit")
+        self.loops.append((head, after, len(self.fin_pending)))
+        bcur = self._seq(node.body, head, "true")
+        self._edge(bcur, head, "back")
+        self.loops.pop()
+        if node.orelse:     # runs on normal exhaustion only (no break)
+            ocur = self._seq(node.orelse, head, "false")
+            self._edge(ocur, after, "normal")
+        else:
+            self._edge(head, after, "false")
+        return after
+
+    def _with(self, node, cur, kind) -> Optional[int]:
+        b = self._new(node, "with")     # context exprs + __enter__
+        self._edge(cur, b, kind)
+        self._exc_edges(b)
+        wcur = self._seq(node.body, b, "normal")
+        if wcur is None:
+            return None
+        wx = self._new(None, "with-exit")   # __exit__ on the normal path
+        self._edge(wcur, wx, "normal")
+        return wx
+
+    def _match(self, node: ast.Match, cur, kind) -> Optional[int]:
+        head = self._new(node, "branch")
+        self._edge(cur, head, kind)
+        if _has_call(node.subject):
+            self._exc_edges(head)
+        join = self._new(None, "join")
+        for case in node.cases:
+            ccur = self._seq(case.body, head, "true")
+            self._edge(ccur, join, "normal")
+        self._edge(head, join, "false")     # no case matched
+        return join
+
+    def _try(self, node: ast.Try, cur, kind) -> Optional[int]:
+        after = self._new(None, "join")
+        handler_entries = [self._new(h, "except") for h in node.handlers]
+        catch_all = any(_is_catch_all(h) for h in node.handlers)
+        fin_exc = self._new(None, "finally-exc") if node.finalbody else None
+        outer_frames = list(self.frames)
+
+        # body + orelse raise into THIS frame's handlers/finally
+        self.frames.append(_TryFrame(handler_entries, catch_all, fin_exc))
+        if node.finalbody:
+            self.fin_pending.append(node.finalbody)
+        bcur = self._seq(node.body, cur, kind)
+        if node.orelse and bcur is not None:
+            bcur = self._seq(node.orelse, bcur, "normal")
+        self.frames.pop()
+
+        # handler bodies: an exception inside a handler escapes outward,
+        # but still runs this try's finally on the way
+        self.frames.append(_TryFrame([], False, fin_exc))
+        hends = []
+        for hb in handler_entries:
+            hends.append(self._seq(self.cfg.blocks[hb].stmt.body,
+                                   hb, "normal"))
+        self.frames.pop()
+        if node.finalbody:
+            self.fin_pending.pop()
+
+        if node.finalbody:
+            # shared normal copy: body/orelse + handler completions
+            fin_n = self._new(None, "finally")
+            for e in [bcur] + hends:
+                self._edge(e, fin_n, "normal")
+            fcur = self._seq(node.finalbody, fin_n, "normal")
+            self._edge(fcur, after, "normal")
+            # shared exceptional copy: tail re-raises outward
+            fe_cur = self._seq(node.finalbody, fin_exc, "normal")
+            if fe_cur is not None:
+                self._exc_edges(fe_cur, outer_frames)
+        else:
+            for e in [bcur] + hends:
+                self._edge(e, after, "normal")
+        return after if self.cfg.blocks[after].preds else None
+
+
+def dataflow(cfg: CFG, transfer: Callable[[CFGBlock, Any], Any], *,
+             init: Any, bottom: Any, join: Callable[[Any, Any], Any],
+             backward: bool = False,
+             edge_fn: Optional[Callable[[CFGBlock, str, Any], Any]] = None,
+             ) -> Dict[int, Any]:
+    """Generic worklist gen/kill solve over a CFG.
+
+    Returns the fixpoint fact per block at its *entry* (forward) or
+    *exit* (backward). ``transfer(block, fact)`` crosses the block in
+    the analysis direction; ``edge_fn(src_block, kind, fact)`` may
+    refine the fact per outgoing edge kind (``None`` = edge contributes
+    nothing) — ``src_block`` is always the edge's source in CFG
+    direction, i.e. the branch that owns the ``true``/``false`` kind.
+    Facts must support ``==``; ``join`` must be monotone.
+
+    Blocks carry one statement, so in forward mode an ``exc`` edge
+    propagates the block's *entry* fact: a statement that raises did not
+    complete its effect (an ``append`` that blew up appended nothing)."""
+    facts: Dict[int, Any] = {b.idx: bottom for b in cfg.blocks}
+    if backward:
+        for s in (cfg.exit, cfg.raise_exit):
+            facts[s] = init
+    else:
+        facts[cfg.entry] = init
+    work = deque(range(len(cfg.blocks)))
+    guard = 0
+    limit = 64 * len(cfg.blocks) + 256
+    while work and guard < limit:
+        guard += 1
+        i = work.popleft()
+        crossed = transfer(cfg.blocks[i], facts[i])
+        edges = cfg.blocks[i].preds if backward else cfg.blocks[i].succs
+        for j, kind in edges:
+            src = cfg.blocks[j] if backward else cfg.blocks[i]
+            base = facts[i] if (kind == "exc" and not backward) else crossed
+            f = base if edge_fn is None else edge_fn(src, kind, base)
+            if f is None:
+                continue
+            merged = join(facts[j], f)
+            if merged != facts[j]:
+                facts[j] = merged
+                work.append(j)
+    return facts
 
 
 # ------------------------------------------------------------------ engine
@@ -773,6 +1171,22 @@ class ProjectModel:
                 return None
             dotted = nxt
         return None
+
+    def reachable(self, seeds: Iterable[str]) -> Set[str]:
+        """Call-graph closure: every function qual reachable from
+        ``seeds`` over ``edges`` — the interprocedural summary the
+        path-sensitive rules piggyback on (e.g. the jit-region closure
+        of rules_taint)."""
+        seen: Set[str] = set()
+        stack = [q for q in seeds if q in self.functions]
+        while stack:
+            q = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            stack.extend(c for c in self.edges.get(q, ())
+                         if c in self.functions and c not in seen)
+        return seen
 
     # ------------------------------------------------------------ typing
     def _resolve_type(self, expr, ctx: FileContext,

@@ -1,0 +1,502 @@
+"""JAX hot-path dataflow rules — device-value taint over per-function
+CFGs plus the jit-region closure on the ProjectModel call graph.
+
+* ``tainted-host-sync`` — values produced by jit-wrapped callables /
+  ``device_put`` are device arrays; converting one to host
+  (``float``/``int``/``bool``/``np.asarray``/``.item()``/``.tolist()``)
+  or branching on it inside a serve/decode/fit loop is an implicit
+  host↔device sync per iteration. This is the *dataflow* sibling of the
+  lexical ``hotpath-host-sync`` rule: it follows the value, so it fires
+  in helpers the lexical rule's hot-name heuristic misses, and it
+  catches implicit truthiness (``if y:``) the lexical rule cannot see.
+* ``shape-dependent-branch-in-jit`` — python ``if``/``while`` on traced
+  values inside a jitted body (the function itself or anything the call
+  graph says it reaches): branching on a traced scalar raises at trace
+  time, and branching on ``.shape``/``len()`` of a traced array bakes a
+  per-shape specialization — the recompile hazard class the runtime's
+  compile counter only reports after the fact.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from analytics_zoo_tpu.analysis.core import (
+    CFG, FileContext, Finding, HOT_PATH_SEGMENTS, ProjectContext, Rule,
+    ancestors, dataflow, module_name, register,
+)
+from analytics_zoo_tpu.analysis.rules_hotpath import HOT_FN_TOKENS
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+_LOOPS = (ast.For, ast.While, ast.AsyncFor)
+
+#: callee tails that construct a jit-compiled callable (same set the
+#: lexical rules_jit family recognizes)
+_JIT_TAILS = frozenset({"jit", "pjit", "instrument_jit"})
+
+#: packages whose files carry serve/decode/fit hot loops — the lexical
+#: hot-path set plus inference/ (the decode loop lives there)
+_TAINT_SEGMENTS = HOT_PATH_SEGMENTS | {"inference"}
+
+#: host-conversion callables by resolved name
+_CONVERTERS = frozenset({"float", "int", "bool"})
+_NP_COPIES = frozenset({"numpy.asarray", "numpy.array"})
+
+
+def _nearest_function(node: ast.AST) -> Optional[ast.AST]:
+    for a in ancestors(node):
+        if isinstance(a, _FUNCS):
+            return a
+    return None
+
+
+def _in_loop_of(node: ast.AST, fn: ast.AST) -> bool:
+    for a in ancestors(node):
+        if a is fn:
+            return False
+        if isinstance(a, _LOOPS):
+            return True
+    return False
+
+
+def _names_in(node: Optional[ast.AST]) -> Set[str]:
+    if node is None:
+        return set()
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _target_names(tgt: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(tgt)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)}
+
+
+def _is_jit_constructor(ctx: FileContext, call: ast.Call) -> bool:
+    name = ctx.imports.resolve(call.func)
+    parts = name.split(".") if name else []
+    return len(parts) > 1 and parts[-1] in _JIT_TAILS
+
+
+def _fn_tokens(name: str) -> Set[str]:
+    return {t for t in name.lower().split("_") if t}
+
+
+class _TaintScan:
+    """Per-function taint facts: which locals may hold device values at
+    each CFG block entry."""
+
+    def __init__(self, ctx: FileContext, fn: ast.AST,
+                 jit_locals: Set[str], jit_fns: Set[str]):
+        self.ctx = ctx
+        self.fn = fn
+        self.jit_locals = jit_locals    # locals bound to jit(f)
+        self.jit_fns = jit_fns          # file-level @jit function names
+        self.cfg: CFG = ctx.cfg(fn)
+        self.facts = dataflow(
+            self.cfg, self._transfer, init=frozenset(),
+            bottom=frozenset(), join=lambda a, b: a | b)
+
+    # ------------------------------------------------------- sources
+    def source_call(self, call: ast.Call) -> bool:
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in self.jit_locals or f.id in self.jit_fns:
+                return True
+            # the conventional jitted-apply parameter (predict_fn,
+            # step_fn, apply_fn...) — device out unless proven otherwise
+            if f.id.endswith("_fn"):
+                return True
+            return False
+        name = self.ctx.imports.resolve(f)
+        return bool(name) and name.split(".")[-1] == "device_put"
+
+    def expr_tainted(self, expr: Optional[ast.AST],
+                     tainted: frozenset) -> bool:
+        if expr is None:
+            return False
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id in tainted:
+                return True
+            if isinstance(n, ast.Call) and self.source_call(n):
+                return True
+        return False
+
+    # ------------------------------------------------------ transfer
+    def _transfer(self, block, fact):
+        s = block.stmt
+        if s is None:
+            return fact
+        if isinstance(s, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = s.targets if isinstance(s, ast.Assign) else [s.target]
+            names: Set[str] = set()
+            for t in targets:
+                names |= _target_names(t)
+            value = getattr(s, "value", None)
+            rhs = self.expr_tainted(value, fact) or (
+                isinstance(s, ast.AugAssign) and
+                any(n in fact for n in names))
+            return fact | names if rhs else fact - names
+        if block.label == "loop-head" and \
+                isinstance(s, (ast.For, ast.AsyncFor)):
+            names = _target_names(s.target)
+            if self.expr_tainted(s.iter, fact):
+                return fact | names
+            return fact - names
+        return fact
+
+    def fact_at(self, node: ast.AST) -> frozenset:
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            hits = self.cfg.blocks_of(cur)
+            if hits:
+                return self.facts.get(hits[0], frozenset())
+            cur = getattr(cur, "_zl_parent", None)
+        return frozenset()
+
+
+@register
+class TaintedHostSync(Rule):
+    """A device value synced to host inside a hot loop, found by taint.
+
+    Tracks values produced by jit-wrapped callables (``step =
+    jax.jit(f)`` then ``y = step(x)``), ``*_fn`` apply parameters, and
+    ``device_put`` through assignments, and flags host conversions
+    (``float``/``int``/``bool``/``np.asarray``/``.item()``/``.tolist()``)
+    and implicit truthiness (``if y:``) on them inside a loop. Syncs the
+    lexical ``hotpath-host-sync`` rule already owns (hot-named function
+    in a hot package) are skipped, so one defect reports once."""
+
+    id = "tainted-host-sync"
+    description = "device-tainted value forced to host inside a loop"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if not (_TAINT_SEGMENTS & set(ctx.path.split("/")[:-1])):
+            return
+        jit_fns = {n.name for n in ctx.walk() if isinstance(n, _FUNCS)
+                   and any(self._jit_decorator(ctx, d)
+                           for d in n.decorator_list)}
+        for fn in (n for n in ctx.walk() if isinstance(n, _FUNCS)):
+            jit_locals = {
+                n.targets[0].id for n in ctx.walk(fn)
+                if isinstance(n, ast.Assign) and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+                and isinstance(n.value, ast.Call)
+                and _is_jit_constructor(ctx, n.value)}
+            if not (jit_locals or jit_fns or self._has_fn_calls(ctx, fn)):
+                continue
+            scan = _TaintScan(ctx, fn, jit_locals, jit_fns)
+            yield from self._sinks(ctx, fn, scan)
+
+    @staticmethod
+    def _jit_decorator(ctx: FileContext, dec: ast.AST) -> bool:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = ctx.imports.resolve(target)
+        parts = name.split(".") if name else []
+        if len(parts) > 1 and parts[-1] in _JIT_TAILS:
+            return True
+        if parts and parts[-1] == "partial" and isinstance(dec, ast.Call) \
+                and dec.args:
+            inner = ctx.imports.resolve(dec.args[0])
+            ip = inner.split(".") if inner else []
+            return len(ip) > 1 and ip[-1] in _JIT_TAILS
+        return False
+
+    @staticmethod
+    def _has_fn_calls(ctx: FileContext, fn: ast.AST) -> bool:
+        return any(isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                   and n.func.id.endswith("_fn") for n in ctx.walk(fn))
+
+    def _sinks(self, ctx: FileContext, fn: ast.AST,
+               scan: _TaintScan) -> Iterable[Finding]:
+        lexical_owns = ctx.is_hot_path and \
+            bool(_fn_tokens(fn.name) & HOT_FN_TOKENS)
+        for node in ctx.walk(fn):
+            if _nearest_function(node) is not fn:
+                continue
+            if isinstance(node, ast.Call):
+                label, overlaps, method = self._sync_label(ctx, node)
+                if label is None or not _in_loop_of(node, fn):
+                    continue
+                if lexical_owns and overlaps:
+                    continue        # hotpath-host-sync reports this one
+                fact = scan.fact_at(node)
+                if self._call_tainted(node, scan, fact, method):
+                    yield Finding(
+                        self.id, ctx.path, node.lineno, node.col_offset,
+                        f"{label} on a device-tainted value inside the "
+                        f"`{fn.name}` loop forces a host sync per "
+                        "iteration — keep the value on device or fence "
+                        "it outside the loop")
+            elif isinstance(node, (ast.If, ast.While)) and \
+                    _in_loop_of(node, fn):
+                fact = scan.fact_at(node)
+                if self._branch_tainted(node.test, scan, fact):
+                    yield Finding(
+                        self.id, ctx.path, node.lineno, node.col_offset,
+                        "branching on a device-tainted value inside the "
+                        f"`{fn.name}` loop is an implicit host sync per "
+                        "iteration — compute the predicate on host or "
+                        "use lax.cond/where")
+
+    @staticmethod
+    def _sync_label(ctx: FileContext,
+                    node: ast.Call) -> Tuple[Optional[str], bool, bool]:
+        """(human label, overlaps-with-lexical-rule, is-method-sink)"""
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in ("item", "tolist") \
+                and not node.args:
+            return f".{f.attr}()", f.attr == "item", True
+        name = ctx.imports.resolve(f)
+        if name in _NP_COPIES:
+            return f"{name}()", True, False
+        if name in _CONVERTERS and len(node.args) == 1 and \
+                not isinstance(node.args[0], ast.Constant):
+            return f"{name}()", name == "float", False
+        return None, False, False
+
+    @staticmethod
+    def _call_tainted(node: ast.Call, scan: _TaintScan,
+                      fact: frozenset, method: bool) -> bool:
+        if method:                                  # .item()/.tolist()
+            return scan.expr_tainted(node.func.value, fact)
+        return any(scan.expr_tainted(a, fact) for a in node.args)
+
+    @staticmethod
+    def _branch_tainted(test: ast.AST, scan: _TaintScan,
+                        fact: frozenset) -> bool:
+        """Bare truthiness / comparison on a tainted value — not
+        ``is``/``isinstance`` checks (static at trace time)."""
+        if isinstance(test, ast.Name):
+            return test.id in fact
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return TaintedHostSync._branch_tainted(test.operand, scan, fact)
+        if isinstance(test, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+                return False
+            return scan.expr_tainted(test, fact)
+        if isinstance(test, ast.BoolOp):
+            return any(TaintedHostSync._branch_tainted(v, scan, fact)
+                       for v in test.values)
+        return False
+
+
+# ----------------------------------------- shape-dependent-branch-in-jit
+
+class _JitEntry:
+    __slots__ = ("qual", "static_names", "static_nums")
+
+    def __init__(self, qual: str, static_names: Set[str],
+                 static_nums: Set[int]):
+        self.qual = qual
+        self.static_names = static_names
+        self.static_nums = static_nums
+
+
+def _static_spec(call_kwargs) -> Tuple[Set[str], Set[int]]:
+    names: Set[str] = set()
+    nums: Set[int] = set()
+    for kw in call_kwargs:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            vals = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            names |= {e.value for e in vals
+                      if isinstance(e, ast.Constant)
+                      and isinstance(e.value, str)}
+        elif kw.arg == "static_argnums":
+            v = kw.value
+            vals = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            nums |= {e.value for e in vals
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, int)}
+    return names, nums
+
+
+@register
+class ShapeBranchInJit(Rule):
+    """Python branching on traced values/shapes inside a jitted body.
+
+    Jitted entries are functions decorated ``@jax.jit`` /
+    ``@partial(jax.jit, ...)`` or passed to a jit constructor; the
+    jit *region* is their call-graph closure on the ProjectModel (a
+    helper called from a jitted body traces too). Inside the region,
+    an ``if``/``while`` whose test reads a traced parameter (non-static
+    params at entries; arguments fed from traced caller values in
+    helpers) either raises TracerBoolConversionError at trace time
+    (value test) or bakes one executable per shape (``.shape`` /
+    ``len()`` test — the silent recompile hazard). ``is``/``is not``,
+    ``isinstance`` and ``hasattr`` tests are static at trace time and
+    exempt. Fix: ``lax.cond``/``lax.select`` for values; make the
+    argument static or branch outside jit for shapes."""
+
+    id = "shape-dependent-branch-in-jit"
+    scope = "project"
+    description = "python branch on a traced value/shape inside jit"
+
+    def check_project(self, pctx: ProjectContext) -> Iterable[Finding]:
+        model = pctx.model()
+        entries = self._entries(pctx, model)
+        if not entries:
+            return
+        region = model.reachable(entries)
+        tainted = self._region_taint(model, entries, region)
+        for qual in sorted(region):
+            fn = model.functions.get(qual)
+            if fn is None or fn.node is None or fn.is_test:
+                continue
+            yield from self._branches(fn, tainted.get(qual, frozenset()))
+
+    # ------------------------------------------------------- entries
+    def _entries(self, pctx: ProjectContext,
+                 model) -> Dict[str, _JitEntry]:
+        entries: Dict[str, _JitEntry] = {}
+        for fn in model.functions.values():
+            node = fn.node
+            if node is None or not isinstance(node, _FUNCS):
+                continue
+            for dec in node.decorator_list:
+                if TaintedHostSync._jit_decorator(fn.ctx, dec):
+                    kwargs = dec.keywords if isinstance(dec, ast.Call) \
+                        else []
+                    names, nums = _static_spec(kwargs)
+                    entries[fn.qual] = _JitEntry(fn.qual, names, nums)
+        # functions passed to a jit constructor: step = jax.jit(f, ...)
+        for ctx in pctx.files:
+            mod = module_name(ctx.path)
+            for call in (n for n in ctx.walk()
+                         if isinstance(n, ast.Call)):
+                if not _is_jit_constructor(ctx, call) or not call.args:
+                    continue
+                arg = call.args[0]
+                if not isinstance(arg, (ast.Name, ast.Attribute)):
+                    continue
+                r = model.resolve_dotted(ctx.imports.resolve(arg), mod)
+                if r is None or r[0] != "func" or r[1].node is None:
+                    continue
+                names, nums = _static_spec(call.keywords)
+                prev = entries.get(r[1].qual)
+                if prev is not None:
+                    names |= prev.static_names
+                    nums |= prev.static_nums
+                entries[r[1].qual] = _JitEntry(r[1].qual, names, nums)
+        return entries
+
+    # -------------------------------------------------- region taint
+    def _region_taint(self, model, entries: Dict[str, _JitEntry],
+                      region: Set[str]) -> Dict[str, frozenset]:
+        """Tainted (traced) local names per region function: non-static
+        params at entries, call-site-fed params in helpers, closed over
+        assignments — a bounded worklist over the call graph."""
+        tainted: Dict[str, Set[str]] = {}
+        for qual, ent in entries.items():
+            fn = model.functions.get(qual)
+            if fn is None or fn.node is None:
+                continue
+            params = self._param_names(fn.node)
+            tainted[qual] = {
+                p for i, p in enumerate(params)
+                if p not in ("self", "cls")
+                and p not in ent.static_names
+                and i not in ent.static_nums}
+        for _ in range(4):
+            changed = False
+            # intraprocedural closure over straight-line assignments
+            for qual in list(tainted):
+                fn = model.functions.get(qual)
+                if fn is None or fn.node is None:
+                    continue
+                t = tainted[qual]
+                for n in fn.ctx.walk(fn.node):
+                    if isinstance(n, ast.Assign) and \
+                            _names_in(n.value) & t:
+                        for tg in n.targets:
+                            new = _target_names(tg) - t
+                            if new:
+                                t |= new
+                                changed = True
+            # interprocedural: traced args taint helper params
+            for caller, callee, node, _held in model.call_sites:
+                if caller not in tainted or callee not in region or \
+                        not isinstance(node, ast.Call):
+                    continue
+                cfn = model.functions.get(callee)
+                if cfn is None or cfn.node is None:
+                    continue
+                params = self._param_names(cfn.node)
+                offset = 1 if params[:1] in (["self"], ["cls"]) and \
+                    isinstance(node.func, ast.Attribute) else 0
+                tset = tainted[caller]
+                dst = tainted.setdefault(callee, set())
+                for i, a in enumerate(node.args):
+                    if _names_in(a) & tset and i + offset < len(params):
+                        if params[i + offset] not in dst:
+                            dst.add(params[i + offset])
+                            changed = True
+                for kw in node.keywords:
+                    if kw.arg and _names_in(kw.value) & tset and \
+                            kw.arg in params and kw.arg not in dst:
+                        dst.add(kw.arg)
+                        changed = True
+            if not changed:
+                break
+        return {q: frozenset(v) for q, v in tainted.items()}
+
+    @staticmethod
+    def _param_names(node: ast.AST) -> List[str]:
+        a = node.args
+        return [p.arg for p in
+                list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+
+    # ------------------------------------------------------ branches
+    def _branches(self, fn, tainted: frozenset) -> Iterable[Finding]:
+        if not tainted:
+            return
+        for node in fn.ctx.walk(fn.node):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            if _nearest_function(node) is not fn.node:
+                continue
+            kind = self._test_kind(node.test, tainted)
+            if kind is None:
+                continue
+            if kind == "shape":
+                msg = ("python branch on the shape of a traced value "
+                       f"inside jitted `{fn.name}` — one executable is "
+                       "compiled per shape; make the argument static "
+                       "(static_argnums) or branch outside jit")
+            else:
+                msg = ("python branch on a traced value inside jitted "
+                       f"`{fn.name}` — this raises at trace time (or "
+                       "silently recompiles); use lax.cond / lax.select")
+            yield Finding(self.id, fn.ctx.path, node.lineno,
+                          node.col_offset, msg)
+
+    @staticmethod
+    def _test_kind(test: ast.AST, tainted: frozenset) -> Optional[str]:
+        kind: Optional[str] = None
+        for n in ast.walk(test):
+            if isinstance(n, ast.Call):
+                f = n.func
+                nm = f.id if isinstance(f, ast.Name) else \
+                    f.attr if isinstance(f, ast.Attribute) else ""
+                if nm in ("isinstance", "hasattr", "getattr", "callable"):
+                    return None
+                if nm == "len" and n.args and \
+                        _names_in(n.args[0]) & tainted:
+                    kind = "shape"
+            if isinstance(n, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops):
+                # `x is None` on an optional param: static at trace time
+                shadow = _names_in(n)
+                tainted = tainted - shadow
+            if isinstance(n, ast.Attribute) and \
+                    n.attr in ("shape", "ndim", "size") and \
+                    _names_in(n.value) & tainted:
+                kind = "shape"
+        if kind == "shape":
+            return kind
+        leaves = {x.id for x in ast.walk(test)
+                  if isinstance(x, ast.Name) and x.id in tainted}
+        if leaves:
+            return "value"
+        return None

@@ -605,34 +605,49 @@ class ClusterServing:
                 self._err_counter.inc()
                 term_acks.append(ack)
                 continue
-            m = self._queue_wait(meta, t_dq1)
-            t_deadline = None
-            d = meta.get("d") if isinstance(meta, dict) else None
-            if isinstance(d, (int, float)) and d > 0 and m is not None:
-                # deadline is relative to the client's enqueue stamp,
-                # already mapped onto this clock by _queue_wait
-                t_deadline = m[0] + d / 1000.0
-            if t_deadline is not None and t_dq1 >= t_deadline:
-                self._expire_record(uri, lane, term_cmds)
-                term_acks.append(ack)
-                continue
-            # generate side channel: re-validated at intake so a hand-
-            # rolled record with junk decode params errors HERE, typed,
-            # instead of blowing up the device batch
+            # from here to the bucket append the eid is in _inflight_ids
+            # but not yet settled: an exception escaping to _run's
+            # catch-all would strand it — redeliveries of the id are
+            # dropped by the dedupe ring while the entry itself is never
+            # acked or served, re-pending until a reconnect. Terminate
+            # the record instead: typed error + ack, like any bad record.
             try:
-                g = schema.validate_generate(
-                    meta.get("g") if isinstance(meta, dict) else None)
-            except ValueError as e:
+                m = self._queue_wait(meta, t_dq1)
+                t_deadline = None
+                d = meta.get("d") if isinstance(meta, dict) else None
+                if isinstance(d, (int, float)) and d > 0 and m is not None:
+                    # deadline is relative to the client's enqueue stamp,
+                    # already mapped onto this clock by _queue_wait
+                    t_deadline = m[0] + d / 1000.0
+                if t_deadline is not None and t_dq1 >= t_deadline:
+                    self._expire_record(uri, lane, term_cmds)
+                    term_acks.append(ack)
+                    continue
+                # generate side channel: re-validated at intake so a hand-
+                # rolled record with junk decode params errors HERE, typed,
+                # instead of blowing up the device batch
+                try:
+                    g = schema.validate_generate(
+                        meta.get("g") if isinstance(meta, dict) else None)
+                except ValueError as e:
+                    term_cmds.append((
+                        "HSET", self.result_key, uri, schema.encode_error(
+                            f"bad generate request: {e}", self.cipher)))
+                    self._err_counter.inc()
+                    term_acks.append(ack)
+                    continue
+                self._lane_credit[lane] = \
+                    self._lane_credit.get(lane, 0.0) + 1.0
+                self._asm.append((eid, uri, inputs, m, lane, t_dq1,
+                                  t_deadline, g))
+            except Exception as e:
+                logger.exception("record intake failed for %s", eid)
                 term_cmds.append((
                     "HSET", self.result_key, uri, schema.encode_error(
-                        f"bad generate request: {e}", self.cipher)))
+                        f"record intake failed: {e}", self.cipher)))
                 self._err_counter.inc()
                 term_acks.append(ack)
                 continue
-            self._lane_credit[lane] = \
-                self._lane_credit.get(lane, 0.0) + 1.0
-            self._asm.append((eid, uri, inputs, m, lane, t_dq1,
-                              t_deadline, g))
         if term_acks or term_cmds:
             client.pipeline(term_cmds + term_acks)
             self._mark_done(term_acks, self._conn_gen)

@@ -24,20 +24,34 @@ run() { echo "== pytest $*"; python -m pytest -q "$@"; }
 # inline "# zoolint: disable=RULE"), and the seeded-violation fixture
 # must FAIL — a passing fixture means the linter itself regressed.
 lint_zoolint() {
-  echo "== zoolint: analytics_zoo_tpu (interprocedural pass included)"
-  python -m analytics_zoo_tpu.analysis analytics_zoo_tpu
+  echo "== zoolint: analytics_zoo_tpu (interprocedural + dataflow passes)"
+  python -m analytics_zoo_tpu.analysis analytics_zoo_tpu --timing
+  echo "== zoolint: stale-baseline check (warning only)"
+  python -m analytics_zoo_tpu.analysis analytics_zoo_tpu --prune-baseline
   echo "== zoolint: seeded-violation fixture (must fail)"
   if fixture_out="$(python -m analytics_zoo_tpu.analysis --no-baseline \
        tests/fixtures/zoolint 2>&1)"; then
     echo "zoolint passed the seeded-violation fixture — linter regressed" >&2
     exit 1
   fi
-  # every whole-program rule must fire on its seeded fixture by id — a
-  # non-zero exit from the per-file rules alone is not good enough
+  # every whole-program / path-sensitive rule must fire on its seeded
+  # fixture by id — a non-zero exit from the per-file rules alone is
+  # not good enough
   for rule in cross-thread-unlocked-state lock-order-inversion \
-              blocking-under-lock thread-leak; do
+              blocking-under-lock thread-leak \
+              record-ack-leak lock-release-path span-pairing \
+              tainted-host-sync shape-dependent-branch-in-jit; do
     if ! grep -q "$rule" <<<"$fixture_out"; then
       echo "zoolint fixture never tripped $rule — rule regressed" >&2
+      exit 1
+    fi
+  done
+  # the workflow-annotation format must carry the new findings too
+  gh_out="$(python -m analytics_zoo_tpu.analysis --no-baseline \
+       --format=github tests/fixtures/zoolint 2>&1 || true)"
+  for rule in record-ack-leak tainted-host-sync; do
+    if ! grep -q "^::error .*$rule" <<<"$gh_out"; then
+      echo "zoolint --format=github lost the $rule annotation" >&2
       exit 1
     fi
   done

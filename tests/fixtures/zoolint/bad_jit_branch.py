@@ -1,0 +1,55 @@
+"""Seeded shape-dependent-branch-in-jit violations.
+
+Python branches on traced values inside the jit region: a shape branch
+and a value branch in a decorated entry, and a value branch in a helper
+the call graph proves is reached from a jitted body. Static arguments
+and ``is None`` tests are the negative controls. Never imported;
+fixture data for dev/run-tests.sh zoolint and
+tests/test_zoolint_dataflow.py.
+"""
+
+import functools
+
+import jax
+
+
+@jax.jit
+def scale_clamped(x, limit):
+    # VIOLATION shape-dependent-branch-in-jit: one executable compiled
+    # per input length
+    if x.shape[0] > 8:
+        return x[:8]
+    # VIOLATION shape-dependent-branch-in-jit: traced-scalar branch
+    # raises at trace time
+    if limit > 0:
+        return x * limit
+    return x
+
+
+def _helper_norm(v, eps):
+    # VIOLATION shape-dependent-branch-in-jit: `eps` is fed from a
+    # traced caller value — this helper traces inside `normalize`
+    if eps > 0:
+        return v / eps
+    return v
+
+
+@jax.jit
+def normalize(v, eps):
+    return _helper_norm(v, eps)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def pad_static(x, block):
+    """Negative control: `block` is a static argument."""
+    if block > 1:
+        return x
+    return x
+
+
+@jax.jit
+def with_default(x, bias):
+    """Negative control: `is None` is static at trace time."""
+    if bias is None:
+        return x
+    return x + bias

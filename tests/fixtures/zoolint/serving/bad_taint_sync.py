@@ -1,0 +1,58 @@
+"""Seeded tainted-host-sync violations.
+
+Function names deliberately avoid the lexical rule's hot-name tokens
+(dispatch/serve/step/...) so every finding here belongs to the taint
+rule, not ``hotpath-host-sync`` — that is the point: the dataflow rule
+follows the value into helpers the name heuristic misses. Never
+imported; fixture data for dev/run-tests.sh zoolint and
+tests/test_zoolint_dataflow.py.
+"""
+
+import jax
+import numpy as np
+
+
+def _step_impl(params, tok):
+    return tok
+
+
+def autoregress(params, seq, steps):
+    step = jax.jit(_step_impl)
+    out = seq
+    host = None
+    for _t in range(steps):
+        out = step(params, out)
+        # VIOLATION tainted-host-sync: np.asarray on the jit output
+        # forces a device->host copy every iteration
+        host = np.asarray(out)
+        # VIOLATION tainted-host-sync: implicit truthiness on a device
+        # value blocks on the transfer each iteration
+        if out:
+            break
+    return host
+
+
+def accumulate(predict_fn, batches):
+    total = 0.0
+    for b in batches:
+        y = predict_fn(b)
+        # VIOLATION tainted-host-sync: float() on the *_fn apply output
+        total += float(y)
+    return total
+
+
+def host_math(xs):
+    """Negative control: nothing here is device-tainted."""
+    total = 0.0
+    for x in xs:
+        total += float(x)
+    return total
+
+
+def fenced(params, seq, steps):
+    """Negative control: the single sync sits outside the loop."""
+    step = jax.jit(_step_impl)
+    out = seq
+    for _t in range(steps):
+        out = step(params, out)
+    return np.asarray(out)

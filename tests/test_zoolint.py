@@ -39,6 +39,8 @@ def test_rule_registry_complete():
         "blocking-under-lock", "thread-leak",
         "metric-undocumented", "metric-undeclared", "envvar-undocumented",
         "rowwise-map-in-data-plane",
+        "record-ack-leak", "lock-release-path", "span-pairing",
+        "tainted-host-sync", "shape-dependent-branch-in-jit",
     }
     for rid, rule in rules.items():
         assert rule.id == rid
@@ -529,6 +531,8 @@ def test_seeded_fixture_trips_every_family():
         "blocking-under-lock", "thread-leak",
         "metric-undocumented", "envvar-undocumented",
         "rowwise-map-in-data-plane",
+        "record-ack-leak", "lock-release-path", "span-pairing",
+        "tainted-host-sync", "shape-dependent-branch-in-jit",
     }
     # and the suppressed half of the fixture stays quiet
     sup = [f for f in findings

@@ -1,0 +1,555 @@
+"""zoolint v3: CFG construction, the worklist solver, the five
+path-sensitive rules (positive and negative per rule), the CFG cache,
+the CLI surface (--timing, --prune-baseline, --jobs), and the
+acceptance demo — a hand-introduced exception-edge ack drop in
+serving/engine.py that record-ack-leak must catch."""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from analytics_zoo_tpu.analysis import analyze_paths, analyze_source
+from analytics_zoo_tpu.analysis.core import (
+    CFG, CFG_STATS, dataflow, parse_file,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "zoolint")
+ENGINE = os.path.join(REPO, "analytics_zoo_tpu", "serving", "engine.py")
+
+
+def _cfg(src):
+    tree = ast.parse(textwrap.dedent(src))
+    fn = tree.body[0]
+    return CFG(fn), fn
+
+
+def _scan(src, relpath="serving/mod.py"):
+    return analyze_source(textwrap.dedent(src), relpath)
+
+
+def _rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def _cli(*args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "analytics_zoo_tpu.analysis", *args],
+        cwd=cwd, capture_output=True, text=True)
+
+
+# ------------------------------------------------------------ golden CFGs
+
+def test_cfg_loop_break_continue_edges():
+    g, fn = _cfg("""
+    def f(xs):
+        t = 0
+        for x in xs:
+            if x < 0:
+                continue
+            if x > 9:
+                break
+            t = t + x
+        return t
+    """)
+    kinds = g.edge_kinds()
+    assert {"true", "false", "back", "break", "continue",
+            "return"} <= kinds
+    loop = fn.body[1]
+    head = g.blocks_of(loop)[0]
+    # the back edge and the continue edge both target the loop head
+    back_srcs = [b.idx for b in g.blocks
+                 for d, k in b.succs if d == head and k == "back"]
+    cont_srcs = [b.idx for b in g.blocks
+                 for d, k in b.succs if d == head and k == "continue"]
+    assert back_srcs and cont_srcs
+    # break leaves the loop without touching the head
+    brk = [d for b in g.blocks for d, k in b.succs if k == "break"]
+    assert brk and head not in brk
+
+
+def test_cfg_try_finally_duplicates_finally_body():
+    g, fn = _cfg("""
+    def f(x):
+        try:
+            return g(x)
+        finally:
+            done()
+    """)
+    fin = fn.body[0].finalbody[0]
+    copies = g.blocks_of(fin)
+    # one copy per way of reaching it: normal fallthrough, exception,
+    # and the inline copy the return crosses
+    assert len(copies) == 3
+    # the return's copy continues to the function exit with kind return
+    assert any((g.exit, "return") in g.block(b).succs for b in copies)
+    # the exceptional copy re-raises: it reaches the raise exit
+    assert any((g.raise_exit, "exc") in g.block(b).succs for b in copies)
+
+
+def test_cfg_exception_edges_route_to_handler():
+    g, fn = _cfg("""
+    def f(x):
+        try:
+            y = decode(x)
+        except ValueError:
+            y = None
+        return y
+    """)
+    risky = fn.body[0].body[0]
+    handler = fn.body[0].handlers[0].body[0]
+    rb = g.blocks_of(risky)[0]
+    hb = g.blocks_of(handler)[0]
+    # the call statement's exception edge lands at the handler entry,
+    # whose block chain reaches the handler body — not the raise exit
+    reach, seen = [rb], set()
+    hit = False
+    while reach:
+        cur = reach.pop()
+        if cur in seen:
+            continue
+        seen.add(cur)
+        if cur == hb:
+            hit = True
+        reach.extend(d for d, _k in g.block(cur).succs)
+    assert hit
+    # a non-catch-all handler still lets the exception escape
+    assert g.raise_exit in seen
+
+
+def test_cfg_with_desugaring():
+    g, fn = _cfg("""
+    def f(p):
+        with open(p) as fh:
+            data = fh.read()
+        return data
+    """)
+    w = fn.body[0]
+    wb = g.blocks_of(w)
+    assert len(wb) == 1 and g.block(wb[0]).label == "with"
+    # the context expression can raise; the body flows through the
+    # with-exit back to the function tail
+    assert (g.raise_exit, "exc") in g.block(wb[0]).succs
+    body = g.blocks_of(w.body[0])[0]
+    exits = [d for d, _k in g.block(body).succs
+             if g.block(d).label == "with-exit"]
+    assert exits
+
+
+def test_dataflow_forward_join_over_branches_and_loops():
+    g, fn = _cfg("""
+    def f(a, xs):
+        if a:
+            x = 1
+        else:
+            x = 2
+        n = 0
+        for v in xs:
+            n = n + 1
+        return x + n
+    """)
+
+    def transfer(block, fact):
+        s = block.stmt
+        if isinstance(s, ast.Assign):
+            return fact | {t.id for t in s.targets
+                           if isinstance(t, ast.Name)}
+        if block.label == "loop-head" and isinstance(s, ast.For):
+            return fact | {s.target.id}
+        return fact
+
+    facts = dataflow(g, transfer, init=frozenset(), bottom=frozenset(),
+                     join=lambda a, b: a | b)
+    assert {"x", "n", "v"} <= facts[g.exit]
+
+
+def test_dataflow_backward_reach_avoid():
+    g, fn = _cfg("""
+    def f(a):
+        if a:
+            return 1
+        return 2
+    """)
+    ret1 = g.blocks_of(fn.body[0].body[0])[0]
+
+    def transfer(block, fact):
+        return False if block.idx == ret1 else fact
+
+    facts = dataflow(g, transfer, init=True, bottom=False,
+                     join=lambda a, b: a or b, backward=True)
+    # from the entry, the `return 2` path reaches exit without ret1
+    assert facts[g.entry] is True
+
+
+# ------------------------------------------------------- record-ack-leak
+
+_LEAK = """
+def drain(client, stream, group):
+    entries = client.xreadgroup(group, "w", {stream: ">"})
+    acks = []
+    for eid, payload in entries:
+        if payload is None:
+            continue
+        acks.append(("XACK", stream, group, eid))
+    client.pipeline(acks)
+"""
+
+_CLEAN = """
+def drain(client, stream, group):
+    entries = client.xreadgroup(group, "w", {stream: ">"})
+    acks = []
+    buckets = []
+    for eid, payload in entries:
+        if payload is None:
+            acks.append(("XACK", stream, group, eid))
+            continue
+        buckets.append((eid, payload))
+    if acks:
+        client.pipeline(acks)
+    return buckets
+"""
+
+
+def test_ack_leak_positive_and_negative():
+    assert "record-ack-leak" in _rules_of(_scan(_LEAK))
+    assert "record-ack-leak" not in _rules_of(_scan(_CLEAN))
+
+
+def test_ack_leak_needs_serving_path():
+    assert "record-ack-leak" not in _rules_of(_scan(_LEAK, "data/mod.py"))
+
+
+def test_ack_leak_escaping_exception_is_not_a_leak():
+    # the lease/redelivery contract covers exceptions that propagate
+    # out of the function — only *handled-and-continued* paths leak
+    src = """
+    def drain(client, stream, group):
+        entries = client.xreadgroup(group, "w", {stream: ">"})
+        acks = []
+        for eid, payload in entries:
+            decode(payload)
+            acks.append(("XACK", stream, group, eid))
+        client.pipeline(acks)
+    """
+    assert "record-ack-leak" not in _rules_of(_scan(src))
+
+
+def test_ack_leak_double_settlement():
+    src = """
+    def drain(client, stream, group):
+        entries = client.xreadgroup(group, "w", {stream: ">"})
+        acks = []
+        buckets = []
+        for eid, payload in entries:
+            buckets.append((eid, payload))
+            acks.append(("XACK", stream, group, eid))
+        client.pipeline(acks)
+    """
+    f = [x for x in _scan(src) if x.rule == "record-ack-leak"]
+    assert f and "more than once" in f[0].message
+
+
+def test_ack_flush_positive_negative_and_guard():
+    unflushed = """
+    def drain(client, stream, group):
+        entries = client.xreadgroup(group, "w", {stream: ">"})
+        acks = []
+        for eid, p in entries:
+            acks.append(("XACK", stream, group, eid))
+    """
+    f = [x for x in _scan(unflushed) if x.rule == "record-ack-leak"]
+    assert f and "without being flushed" in f[0].message
+    # an `if acks:` truthiness guard proves the unflushed path is empty
+    assert "record-ack-leak" not in _rules_of(_scan(_CLEAN))
+
+
+def test_ack_flush_in_finally_counts_on_every_path():
+    src = """
+    def drain(client, stream, group):
+        entries = client.xreadgroup(group, "w", {stream: ">"})
+        acks = []
+        try:
+            for eid, p in entries:
+                acks.append(("XACK", stream, group, eid))
+        finally:
+            client.pipeline(acks)
+    """
+    assert "record-ack-leak" not in _rules_of(_scan(src))
+
+
+# ----------------------------------------------------- lock-release-path
+
+def test_lock_release_positive_and_negative():
+    bad = """
+    def submit(lock, jobs):
+        lock.acquire()
+        if not jobs:
+            return 0
+        n = len(jobs)
+        lock.release()
+        return n
+    """
+    good = """
+    def submit(lock, jobs):
+        lock.acquire()
+        try:
+            return len(jobs)
+        finally:
+            lock.release()
+    """
+    assert "lock-release-path" in _rules_of(_scan(bad))
+    assert "lock-release-path" not in _rules_of(_scan(good))
+
+
+def test_lock_release_tested_acquire_skipped():
+    src = """
+    def submit(lock, jobs):
+        got = lock.acquire(timeout=1.0)
+        if not got:
+            return 0
+        return len(jobs)
+    """
+    assert "lock-release-path" not in _rules_of(_scan(src))
+
+
+def test_lock_release_exception_edge_counts():
+    src = """
+    def submit(lock, jobs):
+        lock.acquire()
+        payload = jobs.encode()
+        lock.release()
+        return payload
+    """
+    assert "lock-release-path" in _rules_of(_scan(src))
+
+
+# --------------------------------------------------------- span-pairing
+
+def test_span_pairing_positive_negative_and_carveout():
+    bad = """
+    def traced(tracer, batch):
+        tracer.attach("s")
+        if batch is None:
+            return None
+        out = list(batch)
+        tracer.detach("s")
+        return out
+    """
+    good = """
+    def traced(tracer, batch):
+        tracer.attach("s")
+        try:
+            return list(batch)
+        finally:
+            tracer.detach("s")
+    """
+    forever = """
+    def install(tracer):
+        tracer.attach("process-lifetime")
+        return tracer
+    """
+    assert "span-pairing" in _rules_of(_scan(bad))
+    assert "span-pairing" not in _rules_of(_scan(good))
+    assert "span-pairing" not in _rules_of(_scan(forever))
+
+
+# ----------------------------------------------------- tainted-host-sync
+
+def test_taint_sync_positive_branch_and_negative():
+    bad = """
+    import jax
+    import numpy as np
+
+    def autoregress(params, seq, steps):
+        step = jax.jit(seq)
+        out = seq
+        for _t in range(steps):
+            out = step(params, out)
+            host = np.asarray(out)
+            if out:
+                break
+        return host
+    """
+    findings = [f for f in _scan(bad) if f.rule == "tainted-host-sync"]
+    assert len(findings) == 2            # the asarray and the branch
+    clean = """
+    import jax
+    import numpy as np
+
+    def fenced(params, seq, steps):
+        step = jax.jit(seq)
+        out = seq
+        for _t in range(steps):
+            out = step(params, out)
+        return np.asarray(out)
+    """
+    assert "tainted-host-sync" not in _rules_of(_scan(clean))
+
+
+def test_taint_killed_by_reassignment():
+    src = """
+    import jax
+
+    def gen(params, xs):
+        step = jax.jit(xs)
+        y = step(params, xs)
+        y = 0
+        total = 0
+        for x in xs:
+            total = total + float(y)
+        return total
+    """
+    assert "tainted-host-sync" not in _rules_of(_scan(src))
+
+
+def test_taint_fn_parameter_convention():
+    src = """
+    def accumulate(predict_fn, batches):
+        total = 0.0
+        for b in batches:
+            y = predict_fn(b)
+            total = total + float(y)
+        return total
+    """
+    assert "tainted-host-sync" in _rules_of(_scan(src))
+    # inference/ is in scope too (the decode loop lives there)
+    assert "tainted-host-sync" in _rules_of(_scan(src, "inference/gen.py"))
+    # ...but a cold package is not
+    assert "tainted-host-sync" not in _rules_of(_scan(src, "automl/gen.py"))
+
+
+# ------------------------------------- shape-dependent-branch-in-jit
+
+def test_jit_branch_fixture_lines():
+    path = os.path.join(FIXTURE, "bad_jit_branch.py")
+    findings = [f for f in analyze_paths([path], root=REPO)
+                if f.rule == "shape-dependent-branch-in-jit"]
+    by_kind = {(f.line, "shape" in f.message) for f in findings}
+    src = open(path).read().splitlines()
+    shape_line = next(i for i, l in enumerate(src, 1)
+                      if "x.shape[0] > 8" in l)
+    value_line = next(i for i, l in enumerate(src, 1) if "limit > 0" in l)
+    helper_line = next(i for i, l in enumerate(src, 1) if "eps > 0" in l)
+    assert (shape_line, True) in by_kind
+    assert (value_line, False) in by_kind
+    assert (helper_line, False) in by_kind     # reached via call graph
+    # static_argnums and `is None` negative controls stay quiet
+    assert len(findings) == 3
+
+
+# ------------------------------------------------------------ CFG cache
+
+def test_cfg_cache_hits_and_rebuild():
+    ctx, err = parse_file(ENGINE, REPO)
+    assert err is None
+    fn = next(n for n in ctx.walk()
+              if isinstance(n, ast.FunctionDef) and n.name == "_produce")
+    CFG_STATS["built"] = CFG_STATS["hits"] = 0
+    g1 = ctx.cfg(fn)
+    g2 = ctx.cfg(fn)
+    assert g1 is g2
+    assert CFG_STATS == {"built": 1, "hits": 1}
+    # the cache key is the v2 normalized-statement hash, so two parses
+    # of identical source agree on it
+    ctx2, _ = parse_file(ENGINE, REPO)
+    fn2 = next(n for n in ctx2.walk()
+               if isinstance(n, ast.FunctionDef) and n.name == "_produce")
+    assert ctx.func_hash(fn) == ctx2.func_hash(fn2)
+
+
+# ------------------------------------------------ acceptance: engine demo
+
+def test_hand_introduced_ack_drop_is_caught():
+    """Delete the undecodable-record handler's ack (the PR 9/10 suites
+    never exercise a corrupt record racing an exception there) and the
+    path-sensitive rule must catch the exception-edge drop."""
+    src = open(ENGINE, encoding="utf-8").read()
+    lines = src.splitlines(keepends=True)
+    idx = next(i for i, l in enumerate(lines)
+               if "dropping undecodable record" in l)
+    assert "term_acks.append(ack)" in lines[idx + 1]
+    broken = "".join(lines[:idx + 1] + lines[idx + 2:])
+    rel = "analytics_zoo_tpu/serving/engine.py"
+
+    before = [f for f in analyze_source(src, rel)
+              if f.rule == "record-ack-leak"]
+    after = [f for f in analyze_source(broken, rel)
+             if f.rule == "record-ack-leak"]
+    new = {f.line for f in after} - {f.line for f in before}
+    assert len(new) == 1                  # exactly the intake loop
+    intake_line = max(i for i, l in enumerate(lines, 1)
+                      if "for eid, lane, payload in entries:" in l
+                      and i <= idx)
+    assert new == {intake_line}
+
+
+# -------------------------------------------------------------- CLI
+
+@pytest.mark.slow
+def test_cli_fixture_fails_and_jobs_agree():
+    r1 = _cli("--no-baseline", "--format=json", "--jobs", "1",
+              "tests/fixtures/zoolint")
+    r4 = _cli("--no-baseline", "--format=json", "--jobs", "4",
+              "tests/fixtures/zoolint")
+    assert r1.returncode == 1 and r4.returncode == 1
+    f1 = json.loads(r1.stdout)["findings"]
+    f4 = json.loads(r4.stdout)["findings"]
+    assert f1 == f4
+    assert {"record-ack-leak", "lock-release-path", "span-pairing",
+            "tainted-host-sync", "shape-dependent-branch-in-jit"} <= \
+        {f["rule"] for f in f1}
+
+
+@pytest.mark.slow
+def test_cli_timing_prints_cfg_stats():
+    r = _cli("--timing", "--no-baseline", "analytics_zoo_tpu/analysis")
+    assert r.returncode in (0, 1)
+    assert "CFGs built=" in r.stderr and "cache-hits=" in r.stderr
+
+
+@pytest.mark.slow
+def test_cli_prune_baseline_report_and_fix(tmp_path):
+    (tmp_path / ".git").mkdir()
+    mod = tmp_path / "mod.py"
+    mod.write_text("X = 1\n")
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps({"version": 2, "entries": [
+        {"fingerprint": "deadbeefdeadbeef", "rule": "wallclock-hotpath",
+         "path": "mod.py", "line": 1, "message": "gone",
+         "justification": "was justified once"}]}))
+    r = _cli(str(mod), "--baseline", str(bl), "--prune-baseline")
+    assert r.returncode == 0
+    assert "deadbeefdeadbeef" in r.stdout and "stale" in r.stdout
+    # report form does not touch the file
+    assert len(json.loads(bl.read_text())["entries"]) == 1
+    r = _cli(str(mod), "--baseline", str(bl), "--prune-baseline=fix")
+    assert r.returncode == 0
+    assert json.loads(bl.read_text())["entries"] == []
+    # an out-of-scope entry is never judged by a partial scan
+    bl.write_text(json.dumps({"version": 2, "entries": [
+        {"fingerprint": "cafecafecafecafe", "rule": "wallclock-hotpath",
+         "path": "elsewhere.py", "line": 1, "message": "gone",
+         "justification": "x"}]}))
+    r = _cli(str(mod), "--baseline", str(bl), "--prune-baseline=fix")
+    assert r.returncode == 0
+    assert len(json.loads(bl.read_text())["entries"]) == 1
+
+
+def test_shipped_tree_has_no_new_rule_findings():
+    """The five new rules are clean on the shipped tree modulo the two
+    justified baseline entries (engine dedupe loop, decode feedback)."""
+    findings = [f for f in analyze_paths(
+        [os.path.join(REPO, "analytics_zoo_tpu")], root=REPO)
+        if f.rule in ("record-ack-leak", "lock-release-path",
+                      "span-pairing", "tainted-host-sync",
+                      "shape-dependent-branch-in-jit")]
+    where = {(f.rule, f.path) for f in findings}
+    assert where == {
+        ("record-ack-leak", "analytics_zoo_tpu/serving/engine.py"),
+        ("tainted-host-sync", "analytics_zoo_tpu/inference/generation.py"),
+    }
