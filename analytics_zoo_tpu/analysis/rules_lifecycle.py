@@ -1,7 +1,7 @@
 """Resource-lifecycle rules — path-sensitive proofs over per-function
 CFGs (analysis/core.py) that acquired resources settle on *every* path.
 
-Three contracts, one engine:
+Four contracts, one engine:
 
 * ``record-ack-leak`` — every entry dequeued from the broker
   (XREADGROUP/XCLAIM) or taken from an assembly bucket must reach
@@ -18,6 +18,12 @@ Three contracts, one engine:
   receiver must balance on all paths when the function closes the pair
   at all; long-lived attaches (no matching exit anywhere in the
   function) are deliberately out of scope.
+* ``kv-page-leak`` — KV pages taken from the shared decode pool
+  (``.alloc_pages(...)`` bound to a local) must be freed or handed to a
+  new owner on every path to every exit, the raise exit included. This
+  machine-checks the paged-KV allocator contract the step-level decode
+  scheduler (PR 16) rests on: a leaked page list shrinks the pool for
+  every future admission, forever.
 """
 
 from __future__ import annotations
@@ -602,6 +608,85 @@ class LockReleasePath(Rule):
                     f"`{recv}.release()` on every exit path (an exception "
                     "or early return leaves it held) — use `with "
                     f"{recv}:` or release in a `finally`")
+
+
+#: call tails that take pages out of a shared KV pool
+_KV_ALLOC_TAILS = frozenset({"alloc_pages"})
+
+
+@register
+class KvPageLeak(Rule):
+    """KV pages allocated from the shared pool that some path strands.
+
+    For every ``x = <pool>.alloc_pages(...)`` binding, each path from
+    the allocation to each function exit — the raise exit included —
+    must settle ownership of ``x``: free it back (``free_pages(x)``),
+    hand it to a new owner (``x`` passed to any call — a cache
+    constructor, an ``extend`` — or stored into object/collection state
+    via an attribute/subscript assignment), or return/yield it to the
+    caller. An unguarded early return or an unprotected call between
+    the alloc and the settlement is itself a finding — the fix is a
+    ``try/except: free_pages(x); raise`` around the handoff (the
+    scheduler's admission path is the reference shape). A leaked page
+    list never rejoins the free list, shrinking the pool for every
+    future admission."""
+
+    id = "kv-page-leak"
+    description = "allocated KV pages may exit a path unfreed and unowned"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for fn in _functions(ctx):
+            sites = []
+            for n in ctx.walk(fn):
+                if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                        and isinstance(n.targets[0], ast.Name) \
+                        and isinstance(n.value, ast.Call) \
+                        and isinstance(n.value.func, ast.Attribute) \
+                        and n.value.func.attr in _KV_ALLOC_TAILS \
+                        and _nearest_function(n) is fn:
+                    sites.append(n)
+            if not sites:
+                continue
+            cfg = ctx.cfg(fn)
+            for site in sites:
+                name = site.targets[0].id
+                done = self._settle_blocks(ctx, fn, cfg, site, name)
+                if _must_do_before_exit(ctx, cfg, site, done):
+                    continue
+                yield Finding(
+                    self.id, ctx.path, site.lineno, site.col_offset,
+                    f"pages allocated into `{name}` can reach a function "
+                    "exit without being freed or handed off on some path "
+                    "(an early return or an exception between the "
+                    "alloc_pages and its settlement) — free them in an "
+                    "except/finally or move the handoff adjacent to the "
+                    "allocation")
+
+    @staticmethod
+    def _settle_blocks(ctx: FileContext, fn: ast.AST, cfg: CFG,
+                       site: ast.AST, name: str) -> Set[int]:
+        """Blocks where ownership of ``name`` settles: the pages are
+        freed, passed to any call (handoff — the callee owns them now),
+        stored into attribute/subscript state, or escape via
+        return/yield."""
+        done: Set[int] = set()
+        for n in ctx.walk(fn):
+            if _nearest_function(n) is not fn or n is site:
+                continue
+            if isinstance(n, ast.Call):
+                if any(name in _names_in(a) for a in n.args) or \
+                        any(name in _names_in(k.value)
+                            for k in n.keywords):
+                    done.update(_stmt_blocks(cfg, ctx, n))
+            elif isinstance(n, (ast.Return, ast.Yield)) and \
+                    name in _names_in(getattr(n, "value", None)):
+                done.update(_stmt_blocks(cfg, ctx, n))
+            elif isinstance(n, ast.Assign) and \
+                    any(isinstance(t, (ast.Attribute, ast.Subscript))
+                        for t in n.targets) and \
+                    name in _names_in(n.value):
+                done.update(_stmt_blocks(cfg, ctx, n))
+        return done
 
 
 #: enter-call tail -> exit-call tail for paired lifecycle calls

@@ -262,6 +262,21 @@ def batch_avals(spec: Sequence[Tuple[Tuple[int, ...], Any]], rung: int):
                  for shape, dtype in spec)
 
 
+def decode_grid_specs(spec, rungs, seq_rungs, avals_fn):
+    """Enumerate the decode compile grid: for every (batch rung ×
+    seq-length rung) pair, rewrite the LAST spec entry's time axis to the
+    seq rung and yield ``avals_fn(dspec, rung)``. This is the one grid
+    both ``warm_decode`` and the step scheduler's dispatch walk — the
+    chunked-prefill buffers and the speculative k-wide verify step are
+    just taller seq rungs on it, never new shapes."""
+    dec_shape, dec_dtype = spec[-1]
+    for rung in sorted({int(r) for r in rungs}):
+        for sr in sorted({int(s) for s in seq_rungs}):
+            dspec = spec[:-1] + (
+                ((int(sr),) + tuple(dec_shape[1:]), dec_dtype),)
+            yield avals_fn(dspec, rung)
+
+
 def _aval_of(x):
     import jax
     shape = getattr(x, "shape", None)

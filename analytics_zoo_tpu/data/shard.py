@@ -60,7 +60,9 @@ _POOL: Optional[ThreadPoolExecutor] = None
 _POOL_SIZE = 0
 
 #: per-op stats of the most recent parallel run — tests assert the in-flight
-#: window stayed bounded under DISK/NATIVE tiers
+#: window stayed bounded under DISK/NATIVE tiers. Writes hold _STATS_LOCK:
+#: shard ops can run from both the serve thread and the caller's thread.
+_STATS_LOCK = threading.Lock()
 LAST_RUN_STATS: Dict[str, Dict[str, Any]] = {}
 
 
@@ -164,7 +166,8 @@ def _map_shards(fn: Callable[[Any], Any], n: int,
     finally:
         busy.set(0)
         hist.labels(op).observe(time.perf_counter() - t0)
-        LAST_RUN_STATS[op] = dict(stats)
+        with _STATS_LOCK:
+            LAST_RUN_STATS[op] = dict(stats)
 
 
 class XShards:

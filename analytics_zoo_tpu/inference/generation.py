@@ -36,15 +36,24 @@ MODES = ("raw", "greedy", "sample")
 #: default seq-length ladder bounds for generate requests
 DEFAULT_SEQ_RUNGS = (8, 128)
 
-_REG = telemetry.get_registry()
-_M_DECODE_STEPS = _REG.counter(
-    "zoo_decode_steps_total",
-    "Autoregressive decode steps executed (one per generated position "
-    "per batch dispatch)")
-_M_KV_RUNG = _REG.gauge(
-    "zoo_kv_cache_rung",
-    "Current seq-length rung of the bucketed decode/KV cache — climbs "
-    "power-of-two rungs as generation proceeds, never per-step shapes")
+# metric handles are re-resolved from the live registry on every write
+# (registering an existing family is an idempotent dict hit) — a handle
+# captured at import time would go stale when telemetry.reset_for_tests
+# swaps the registry singleton under a long-lived process
+
+
+def _m_decode_steps():
+    return telemetry.get_registry().counter(
+        "zoo_decode_steps_total",
+        "Autoregressive decode steps executed (one per generated position "
+        "per batch dispatch)")
+
+
+def _m_kv_rung():
+    return telemetry.get_registry().gauge(
+        "zoo_kv_cache_rung",
+        "Current seq-length rung of the bucketed decode/KV cache — climbs "
+        "power-of-two rungs as generation proceeds, never per-step shapes")
 
 
 def seq_ladder(max_seq_len: int,
@@ -74,7 +83,7 @@ class BucketedKVCache:
         self._buf = np.zeros((int(batch), int(rung), self.dim), dtype)
         if start is not None:
             self.append(np.asarray(start, dtype))
-        _M_KV_RUNG.set(self.rung)
+        _m_kv_rung().set(self.rung)
 
     @property
     def rung(self) -> int:
@@ -90,7 +99,7 @@ class BucketedKVCache:
                              self._buf.dtype)
             grown[:, :self.length, :] = self._buf
             self._buf = grown
-            _M_KV_RUNG.set(self.rung)
+            _m_kv_rung().set(self.rung)
         self._buf[:, self.length, :] = vec
         self.length += 1
 
@@ -98,23 +107,46 @@ class BucketedKVCache:
         return self._buf
 
 
-def _feedback(vec: np.ndarray, mode: str, temperature: float,
-              rng: Optional[np.random.Generator]) -> np.ndarray:
-    """Turn one step's raw prediction into the vector fed back."""
+def sample_token_ids(vec: np.ndarray, temperature: float,
+                     rng: np.random.Generator) -> np.ndarray:
+    """Vectorized Gumbel-max temperature sampling: one token id per row.
+
+    Distributionally identical to softmax(``vec/t``) sampling but with no
+    per-row Python loop on the host hot path. The rng stream contract —
+    pinned by tests/test_generation.py — is exactly ONE uniform draw of
+    ``vec.shape`` per call (``rng.random(vec.shape)``), so a sequence
+    sampling alone consumes the same stream as the same sequence inside
+    a wider batch row-for-row only when it owns its own generator (the
+    step scheduler gives every sequence a private seeded rng for this
+    reason).
+    """
+    t = max(float(temperature), 1e-6)
+    u = rng.random(vec.shape)
+    # guard the (measure-zero) u == 0.0 draw; log(-log(u)) must be finite
+    u = np.maximum(u, np.finfo(np.float64).tiny)
+    gumbel = -np.log(-np.log(u))
+    return np.argmax(vec / t + gumbel, axis=-1)
+
+
+def feedback_rows(vec: np.ndarray, mode: str, temperature: float,
+                  rng: Optional[np.random.Generator]) -> np.ndarray:
+    """Turn one step's raw prediction rows into the vectors fed back."""
     if mode == "raw":
         return vec
     if mode == "greedy":
         ids = np.argmax(vec, axis=-1)
     else:                                   # sample
-        t = max(float(temperature), 1e-6)
-        z = vec / t
-        z = z - z.max(axis=-1, keepdims=True)
-        p = np.exp(z)
-        p /= p.sum(axis=-1, keepdims=True)
-        ids = np.array([rng.choice(p.shape[-1], p=row) for row in p])
+        ids = sample_token_ids(vec, temperature, rng)
     out = np.zeros_like(vec)
     out[np.arange(vec.shape[0]), ids] = 1.0
     return out
+
+
+def count_decode_steps(n: int) -> None:
+    """Bump the decode-steps counter by ``n`` generated positions — the
+    step scheduler's wide steps account here alongside decode_loop."""
+    if n > 0:
+        _m_decode_steps().inc(int(n))
 
 
 def decode_loop(predict_fn: Callable[[np.ndarray, np.ndarray], np.ndarray],
@@ -151,10 +183,10 @@ def decode_loop(predict_fn: Callable[[np.ndarray, np.ndarray], np.ndarray],
         # the buffer holds positions [0, t) — output t-1 is causal in
         # them, so the rung's zero tail cannot change it
         out = np.asarray(predict_fn(input_seq, cache.view()))
-        fed = _feedback(out[:, t - 1, :], mode, temperature, rng)
+        fed = feedback_rows(out[:, t - 1, :], mode, temperature, rng)
         cache.append(fed)
         gen[:, t - 1, :] = fed
-        _M_DECODE_STEPS.inc(batch)
+        _m_decode_steps().inc(batch)
         t1 = perf_counter()
         for uri in trace_ids:
             tracer.record(uri, f"decode_step_{t}", t0, t1, parent="device")

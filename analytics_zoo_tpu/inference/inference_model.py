@@ -434,13 +434,17 @@ class InferenceModel:
 
     # ------------------------------------------------------------ generate
     def warm_decode(self, max_seq_len: int, rungs=None, seq_rungs=None,
-                    block: bool = False):
+                    block: bool = False, verify_k: int = 0):
         """AOT-compile the decode grid: every (batch rung × seq-length
         rung) shape a ``generate`` up to ``max_seq_len`` can present, so
         the decode loop never recompiles — the KV cache's rung growth is
         a swap onto an already-built executable. Needs a 2-input
         (encoder, decoder) spec; the decoder's time axis is rewritten per
-        seq rung. Returns the warmup thread (None when nothing to do)."""
+        seq rung. ``verify_k > 0`` extends the grid top so the
+        speculative k-wide verify step (live length + k drafts + bonus)
+        lands on a warmed rung too; chunked prefill needs no extra shapes
+        — prefill positions fill the same rung buffers the decode steps
+        run. Returns the warmup thread (None when nothing to do)."""
         from analytics_zoo_tpu.inference import generation
 
         with self._lock:
@@ -449,18 +453,15 @@ class InferenceModel:
         if cache is None or spec is None or len(spec) < 2:
             return None
         if seq_rungs is None:
-            seq_rungs = generation.seq_ladder(int(max_seq_len)).rungs
+            seq_rungs = generation.seq_ladder(
+                int(max_seq_len) + max(0, int(verify_k))).rungs
         if rungs is None:
             rungs = ladder.rungs if ladder is not None else ()
-        dec_shape, dec_dtype = spec[-1]
-        todo = []
-        for rung in sorted({int(r) for r in rungs}):
-            for sr in sorted({int(s) for s in seq_rungs}):
-                dspec = spec[:-1] + (
-                    ((int(sr),) + tuple(dec_shape[1:]), dec_dtype),)
-                avals = self._aot_avals(params, dspec, rung)
-                if not cache.ready(*avals):
-                    todo.append(avals)
+        todo = [avals for avals in compile_ahead.decode_grid_specs(
+                    spec, rungs, seq_rungs,
+                    lambda dspec, rung: self._aot_avals(
+                        params, dspec, rung))
+                if not cache.ready(*avals)]
         if not todo:
             return None
         if block:
@@ -473,16 +474,45 @@ class InferenceModel:
                                   if w.is_alive()] + [t]
         return t
 
+    def decode_step_fn(self):
+        """The scheduler-facing step seam: one wide ``(enc, dec) -> out``
+        dispatch through the AOT executables (async submit + traced
+        fetch). A :class:`~analytics_zoo_tpu.inference.decode_scheduler.
+        DecodeScheduler` built on this callable runs every step on the
+        same (batch rung × seq rung) grid ``warm_decode`` compiled."""
+        with self._lock:
+            if self._apply is None:
+                raise RuntimeError("load a model before decode_step_fn")
+            if self._n_inputs != 2:
+                raise ValueError(
+                    "decode needs a 2-input (encoder, decoder) model, "
+                    f"got {self._n_inputs} inputs")
+
+        def step(enc, dec):
+            return np.asarray(self.predict_fetch(
+                self.predict_async((enc, dec))))
+
+        return step
+
     def generate(self, input_seq, start_sign, max_new_tokens: int = 16, *,
                  mode: str = "greedy", temperature: float = 1.0,
                  seed: Optional[int] = None, ladder=None,
-                 trace_ids: Sequence[str] = ()) -> np.ndarray:
+                 trace_ids: Sequence[str] = (), draft=None,
+                 spec_k: int = 4) -> np.ndarray:
         """Autoregressive generation through the AOT dispatch seam:
-        sharded prefill + decode loop over the bucketed KV cache
-        (generation.decode_loop), every step running the (batch rung ×
-        seq rung) executables ``warm_decode`` built — never a per-request
-        recompile. The loaded model must be a 2-input encoder/decoder
-        (e.g. the seq2seq zoo via ``load_zoo``). Returns the generated
+        sharded prefill + decode over the bucketed KV rungs, every step
+        running the (batch rung × seq rung) executables ``warm_decode``
+        built — never a per-request recompile. The loaded model must be a
+        2-input encoder/decoder (e.g. the seq2seq zoo via ``load_zoo``).
+
+        ``draft`` (another InferenceModel, or a bare ``(enc, dec)``
+        callable) switches to speculative decoding through the step
+        scheduler: the draft proposes ``spec_k`` tokens per step and this
+        model verifies them in one wide step — greedy output stays
+        bitwise identical to plain decode; without ``draft`` the classic
+        step-by-step loop runs unchanged. Each row keeps a private rng
+        stream under ``draft`` (seeded ``seed + row``), whereas the plain
+        loop draws one batch-wide stream. Returns the generated
         ``[batch, max_new_tokens, output_dim]`` sequence."""
         from analytics_zoo_tpu.inference import generation
 
@@ -493,17 +523,34 @@ class InferenceModel:
                 raise ValueError(
                     "generate needs a 2-input (encoder, decoder) model, "
                     f"got {self._n_inputs} inputs")
+        if draft is not None:
+            from analytics_zoo_tpu.inference import decode_scheduler
+
+            draft_fn = (draft.decode_step_fn()
+                        if hasattr(draft, "decode_step_fn") else draft)
+            input_seq = np.asarray(input_seq)
+            start = np.asarray(start_sign, np.float32)
+            sched = decode_scheduler.DecodeScheduler(
+                self.decode_step_fn(),
+                max_batch=max(1, int(input_seq.shape[0])),
+                max_seq=int(max_new_tokens) + 1,
+                draft_fn=draft_fn, spec_k=spec_k)
+            seqs = [sched.admit(
+                        input_seq[i], start[i], max_new_tokens,
+                        mode=mode, temperature=temperature,
+                        seed=None if seed is None else int(seed) + i,
+                        tag=i,
+                        trace_uri=(trace_ids[i]
+                                   if i < len(trace_ids) else None))
+                    for i in range(input_seq.shape[0])]
+            sched.drain()
+            return np.stack([s.result for s in seqs])
         if ladder is None:
             ladder = generation.seq_ladder(int(max_new_tokens) + 1)
-
-        def step(enc, dec):
-            return np.asarray(self.predict_fetch(
-                self.predict_async((enc, dec))))
-
         return generation.decode_loop(
-            step, input_seq, start_sign, max_new_tokens, ladder=ladder,
-            mode=mode, temperature=temperature, seed=seed,
-            trace_ids=trace_ids)
+            self.decode_step_fn(), input_seq, start_sign,
+            max_new_tokens, ladder=ladder, mode=mode,
+            temperature=temperature, seed=seed, trace_ids=trace_ids)
 
     # ------------------------------------------------------------- predict
     def _snapshot(self):

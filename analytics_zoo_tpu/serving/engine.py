@@ -42,6 +42,7 @@ from analytics_zoo_tpu.common.pipeline_io import (  # noqa: F401
     DevicePipeline,
     StageTimer,
 )
+from analytics_zoo_tpu.inference import decode_scheduler, generation
 from analytics_zoo_tpu.serving import schema
 from analytics_zoo_tpu.serving.broker import Broker, BrokerClient
 from analytics_zoo_tpu.serving.client import INPUT_STREAM, RESULT_HASH
@@ -168,11 +169,21 @@ class ClusterServing:
 
     Autoregressive generate: a record enqueued with ``generate={...}``
     (InputQueue/frontend) carries its decode parameters on the trace
-    side channel. The engine assembles generate records into their own
-    batches (identical decode params only) and dispatches the model's
-    decode loop — (sharded) AOT prefill plus bucketed seq-length rungs,
-    inference/generation.py — flushing each record's generated
-    ``[steps, dim]`` sequence as its typed result.
+    side channel. On a scheduler-capable model (``decode_step_fn``, i.e.
+    an InferenceModel) assembled generate records are handed to a
+    persistent **step-level scheduler**
+    (inference/decode_scheduler.py): live sequences advance one wide
+    step per serve-loop turn over a shared paged KV pool, newly-arrived
+    records admit mid-flight (chunked prefill), heterogeneous decode
+    params share the wide step, and interactive encode batches
+    interleave BETWEEN decode steps — a step is preempted
+    (``zoo_decode_preemptions_total``) whenever a waiting encode lane
+    outranks the live decode lanes on the weighted-deficit schedule,
+    with a starvation floor so decode always advances. ``draft_model``
+    adds speculative decoding (greedy output bitwise unchanged). Duck-
+    typed models keep the legacy whole-batch decode loop: generate
+    records batch with identical decode params only and run to
+    completion in one dispatch.
     """
 
     #: consecutive full dequeues that count as "sustained backlog"
@@ -192,6 +203,9 @@ class ClusterServing:
     #: the lane admission control sheds when per-lane SLO burn says the
     #: serving path is saturated; interactive/default always keep flowing
     ADMISSION_LANE = "batch"
+    #: consecutive preempted decode ticks before a step runs regardless —
+    #: encode pressure may slow decode, never starve it
+    DECODE_STARVATION_FLOOR = 4
 
     def __init__(self, model, broker_port: int, batch_size: int = 8,
                  stream: str = INPUT_STREAM, result_key: str = RESULT_HASH,
@@ -207,7 +221,8 @@ class ClusterServing:
                  max_batch_size: Optional[int] = None,
                  min_batch_size: Optional[int] = None,
                  warmup: bool = True,
-                 replica_id: Optional[str] = None):
+                 replica_id: Optional[str] = None,
+                 draft_model=None, spec_k: int = 4):
         self.model = model
         self.batch_size = int(batch_size)
         self.pipeline_window = int(pipeline_window)
@@ -275,6 +290,16 @@ class ClusterServing:
         # leaves decode shapes to compile on first use.
         raw = os.environ.get("ZOO_SERVING_DECODE_MAX_SEQ", "").strip()
         self._decode_max_seq = int(raw) if raw else 0
+        # --- step-level decode (inference/decode_scheduler.py): built
+        # lazily at the first generate admission on a scheduler-capable
+        # model; duck-typed models keep the whole-batch _GenBatch path
+        self._decode_sched: Optional[decode_scheduler.DecodeScheduler] = \
+            None
+        self._draft_model = draft_model
+        self._spec_k = int(spec_k)
+        # live sequence -> (uri, ack_cmd, queue-wait meta, lane, conn_gen)
+        self._gen_live: Dict = {}
+        self._decode_yield_streak = 0
         # ZOO_SERVING_ADMISSION_S: cadence of the admission-control tick
         # (SLO burn check + broker XSHED flip + lane depth gauges);
         # 0 disables admission control entirely
@@ -385,6 +410,11 @@ class ClusterServing:
             "zoo_serving_lease_reclaims_total",
             "Reclaim sweeps that claimed at least one expired lease",
             ("stream",)).labels(stream)
+        self._preempt_counter = reg.counter(
+            "zoo_decode_preemptions_total",
+            "Decode scheduler steps deferred because a waiting encode "
+            "lane outranked the live decode lanes on the weighted-"
+            "deficit schedule", ("stream",)).labels(stream)
         # cross-thread-readable mirrors for /healthz and tests
         self.records_redelivered = 0
         self.lease_reclaims = 0
@@ -550,6 +580,7 @@ class ClusterServing:
             # the bucket's entry ids describe the dead connection too; its
             # records re-deliver via their lease like any unacked entry
             self._asm.clear()
+            self._abort_decode()
         # idempotence under redelivery: an id this consumer already has in
         # flight (or has finished this connection) is dropped, so a
         # double-delivered record can never double-count or double-write.
@@ -667,6 +698,21 @@ class ClusterServing:
         take = self._asm[:self.batch_size]
         self._asm = self._asm[self.batch_size:]
         self._grow_batch_on_backlog(len(take))
+
+        # step-level decode handoff: on a scheduler-capable model the
+        # assembled generate records go straight to the persistent
+        # scheduler (heterogeneous decode params welcome — they share
+        # the wide step) and only the plain-predict remainder dispatches
+        # as a device batch. Page-pool admission control may bounce a
+        # record back to the bucket's head, still un-acked, to retry
+        # once a live sequence retires.
+        if getattr(self.model, "decode_step_fn", None) is not None:
+            gen_take = [e for e in take if e[7] is not None]
+            if gen_take:
+                take = [e for e in take if e[7] is None]
+                self._admit_generate(client, gen_take)
+                if not take:
+                    return None
 
         # generate and plain-predict records never share a device batch
         # (different executables, different result shapes), and generate
@@ -923,6 +969,12 @@ class ClusterServing:
         if fn is None:
             return
         try:
+            # a configured draft model means verify steps run k positions
+            # past the live length — warm those taller rungs too
+            fn(self._decode_max_seq, rungs=list(self._warm_rungs),
+               verify_k=(self._spec_k if self._draft_model is not None
+                         else 0))
+        except TypeError:
             fn(self._decode_max_seq, rungs=list(self._warm_rungs))
         except Exception:
             logger.debug("decode warmup kick failed", exc_info=True)
@@ -975,6 +1027,180 @@ class ClusterServing:
     def _fetch(self, pending):
         fn = getattr(self.model, "predict_fetch", None)
         return np.asarray(fn(pending) if fn is not None else pending)
+
+    # --------------------------------------------- step-level decode
+    def _ensure_scheduler(self) -> decode_scheduler.DecodeScheduler:
+        """The persistent step scheduler, built at the first generate
+        admission: the page pool sizes off this engine's batch ladder ×
+        the decode seq grid (``ZOO_SERVING_DECODE_MAX_SEQ``, falling back
+        to the default seq-ladder top)."""
+        if self._decode_sched is None:
+            draft_fn = None
+            if self._draft_model is not None:
+                draft_fn = (self._draft_model.decode_step_fn()
+                            if hasattr(self._draft_model, "decode_step_fn")
+                            else self._draft_model)
+            self._decode_sched = decode_scheduler.DecodeScheduler(
+                self.model.decode_step_fn(),
+                max_batch=self.max_batch_size,
+                max_seq=(self._decode_max_seq
+                         or generation.DEFAULT_SEQ_RUNGS[1]),
+                batch_ladder=self.ladder,
+                draft_fn=draft_fn, spec_k=self._spec_k)
+        return self._decode_sched
+
+    def _admit_generate(self, client: BrokerClient, entries: List[tuple]):
+        """Hand assembled generate records to the step scheduler. Each
+        entry settles right here: expired/malformed records flush a typed
+        result + ack now; admitted ones park their ack in ``_gen_live``
+        until the sequence retires (``_finish_decode``); a record the
+        page pool cannot hold yet goes back to the bucket's head,
+        un-acked, to retry after the next retirement."""
+        sched = self._ensure_scheduler()
+        now = time.perf_counter()
+        term_cmds: list = []
+        term_acks: list = []
+        back: list = []
+        for entry in entries:
+            eid, uri, inputs, m, lane, _t_arr, t_deadline, g = entry
+            ack = ("XACK", self.stream, self.group, str(eid))
+            if t_deadline is not None and now >= t_deadline:
+                self._expire_record(uri, lane, term_cmds)
+                term_acks.append(ack)
+                continue
+            bad = None
+            if "start" not in inputs:
+                bad = "generate records need a 'start' input tensor"
+            elif len(inputs) != 2:
+                bad = ("generate records carry exactly two inputs: the "
+                       "encoder tensor and 'start'")
+            if bad is not None:
+                term_cmds.append((
+                    "HSET", self.result_key, uri,
+                    schema.encode_error(bad, self.cipher)))
+                self._err_counter.inc()
+                term_acks.append(ack)
+                continue
+            enc_col = next(k for k in sorted(inputs) if k != "start")
+            try:
+                seq = sched.admit(
+                    np.asarray(inputs[enc_col]),
+                    np.asarray(inputs["start"], np.float32),
+                    int(g.get("n", 16)), mode=g.get("m", "greedy"),
+                    temperature=float(g.get("t", 1.0)), seed=g.get("s"),
+                    tag=uri, lane=lane,
+                    trace_uri=(uri if self._tracer.should_sample()
+                               else None))
+            except decode_scheduler.PagePoolExhausted:
+                back.append(entry)
+                continue
+            except Exception as e:
+                term_cmds.append((
+                    "HSET", self.result_key, uri, schema.encode_error(
+                        f"generate admission failed: {e}", self.cipher)))
+                self._err_counter.inc()
+                term_acks.append(ack)
+                continue
+            self._gen_live[seq] = (uri, ack, m, lane, self._conn_gen)
+        if back:
+            self._asm = back + self._asm
+        if term_acks or term_cmds:
+            client.pipeline(term_cmds + term_acks)
+            self._mark_done(term_acks, self._conn_gen)
+
+    def _decode_should_yield(self) -> bool:
+        """Per-step lane preemption, honoring the same weighted-deficit
+        order reads use: defer this decode step when records WAITING in
+        the assembly bucket belong to a lane with a strictly lower
+        credit/weight ratio than every lane currently decoding — the
+        device stays free for the imminent encode dispatch. The
+        starvation floor guarantees a step runs after
+        ``DECODE_STARVATION_FLOOR`` consecutive deferrals."""
+        if self._decode_yield_streak >= self.DECODE_STARVATION_FLOOR:
+            return False
+        if not self._asm or not self._gen_live:
+            return False
+
+        def ratio(lane):
+            return (self._lane_credit.get(lane, 0.0)
+                    / max(self.lane_weights.get(lane, 1.0), 1e-9))
+
+        waiting = min(ratio(e[4]) for e in self._asm)
+        live = min(ratio(info[3]) for info in self._gen_live.values())
+        return waiting < live
+
+    def _decode_tick(self, client: BrokerClient) -> int:
+        """One serve-loop turn's decode slice: run (or preempt) exactly
+        one scheduler step and flush whatever finished. Encode batches
+        interleave between these steps instead of behind whole
+        generations."""
+        sched = self._decode_sched
+        if sched is None or not sched.live:
+            return 0
+        if self._decode_should_yield():
+            self._decode_yield_streak += 1
+            self._preempt_counter.inc()
+            return 0
+        self._decode_yield_streak = 0
+        return self._finish_decode(client, sched.step())
+
+    def _finish_decode(self, client: BrokerClient, finished) -> int:
+        """Flush retired sequences: postprocess + typed result + held-back
+        ack, end-to-end latency on the record's own lane series. Pages
+        are already back in the pool (the scheduler freed them at
+        retirement)."""
+        if not finished:
+            return 0
+        cmds: list = []
+        acks: list = []
+        lanes_meta = []
+        t1 = time.perf_counter()
+        for seq in finished:
+            info = self._gen_live.pop(seq, None)
+            if info is None:
+                continue
+            uri, ack, m, lane, gen = info
+            if gen != self._conn_gen:
+                # admitted before a broker reconnect: the entry id means
+                # nothing to the new connection — the record re-delivers
+                # via its lease and is deduped by result idempotence
+                continue
+            try:
+                pred = seq.result
+                if self.postprocess is not None:
+                    pred = self.postprocess(pred)
+                val = schema.encode_result(pred, self.cipher)
+            except Exception as e:
+                logger.warning("postprocess failed for %s: %s", uri, e)
+                val = schema.encode_error(
+                    f"postprocess failed: {e}", self.cipher)
+            cmds.append(("HSET", self.result_key, uri, val))
+            acks.append(ack)
+            lanes_meta.append((m, lane))
+        if not acks and not cmds:
+            return 0
+        n = len(acks)
+        with self._state_lock:
+            self.records_out += n
+        self._rec_counter.inc(n)
+        for m, lane in lanes_meta:
+            if m is not None:
+                self._latency_hist.get(
+                    lane, self._latency_hist[schema.DEFAULT_PRIORITY]
+                ).observe(max(0.0, t1 - m[0]))
+        client.pipeline(cmds + acks)
+        self._mark_done(acks, self._conn_gen)
+        return n
+
+    def _abort_decode(self):
+        """Broker reconnect / shutdown: drop every live sequence — pages
+        free immediately, held-back acks are discarded, and the un-acked
+        entries re-deliver via their lease (at-least-once, never a
+        double ack)."""
+        if self._decode_sched is not None and self._decode_sched.live:
+            self._decode_sched.abort_all()
+        self._gen_live.clear()
+        self._decode_yield_streak = 0
 
     # ----------------------------------------------------------- failover
     @property
@@ -1147,10 +1373,14 @@ class ClusterServing:
             if produced is not None:
                 done = pipe.submit(*produced)
             done += pipe.drain()
-            return sum(self._finish(client, c) for c in done)
-        # while batches are in flight, poll instead of blocking in the
-        # broker read — their results are ready to drain right now
-        block_ms = 0 if pipe.in_flight else self.block_ms
+            return (sum(self._finish(client, c) for c in done)
+                    + self._decode_tick(client))
+        # while batches are in flight — or the decode scheduler holds
+        # live sequences — poll instead of blocking in the broker read:
+        # there is work ready to advance right now
+        decode_live = (self._decode_sched is not None
+                       and self._decode_sched.live > 0)
+        block_ms = 0 if (pipe.in_flight or decode_live) else self.block_ms
         produced = self._produce(client, block_ms)
         if produced is not None:
             done = pipe.submit(*produced)
@@ -1158,7 +1388,11 @@ class ClusterServing:
                 done += pipe.drain()
         else:
             done = pipe.drain()
-        return sum(self._finish(client, c) for c in done)
+        served = sum(self._finish(client, c) for c in done)
+        # decode advances AFTER the encode work of this turn was staged:
+        # one wide step per turn, preempted when a waiting encode lane
+        # outranks the decoding lanes
+        return served + self._decode_tick(client)
 
     # ------------------------------------------------- admission control
     def _admission_tick(self, client: BrokerClient):
@@ -1248,6 +1482,7 @@ class ClusterServing:
                 self._done_ids.clear()
                 self._claim_backlog.clear()
                 self._asm.clear()
+                self._abort_decode()
                 with self._state_lock:
                     # re-assert the shed flag on the next admission tick —
                     # a restarted broker came up accepting everything
@@ -1266,6 +1501,10 @@ class ClusterServing:
         except Exception:
             logger.exception("final drain failed; pending entries will be "
                              "re-delivered via XCLAIM")
+        # live decode sequences don't run to completion on stop: their
+        # entries were never acked, so another replica (or a restart)
+        # re-serves them from the lease — bounded shutdown wins
+        self._abort_decode()
         if client is not None:
             client.close()
 

@@ -339,6 +339,15 @@ SERVE_REPS = 3
 # autoregressive decode bench shapes (shrunk by smoke): batch rows
 # decoded together × generated positions per row
 DECODE_BATCH, DECODE_STEPS, DECODE_HIDDEN = 8, 32, 64
+# mixed decode/interactive drill shapes (ISSUE 16): a batch-lane flood
+# of generate records keeps the step scheduler saturated while
+# closed-loop interactive predicts must cut through BETWEEN decode
+# steps — the per-step preemption seam is what the budget gates. The
+# budget is wider than the priority drill's: an interactive record can
+# land behind at most one in-flight decode step plus one encode bucket,
+# but decode steps here are real jitted dispatches, not duck sleeps.
+MIXED_FLOOD, MIXED_INT, MIXED_STEPS = 12, 12, 12
+MIXED_BUDGET_MS = 750.0
 
 
 def _serve_once(im, payloads, tag, pipeline_window=SERVE_WINDOW):
@@ -600,10 +609,21 @@ def measure_decode():
     (batch rung × seq rung) decode grid AOT-built by ``warm_decode``
     first so the loop's rung growth never recompiles. Gated artifacts:
     ``decode_tokens_per_sec`` (higher-better) and the per-step latency
-    tail ``decode_p99_ms`` (lower-better via the ``_p99_ms`` rule)."""
+    tail ``decode_p99_ms`` (lower-better via the ``_p99_ms`` rule).
+
+    ISSUE 16 extends the same model with two step-scheduler sections:
+    ``decode_concurrent_speedup`` (N interleaved single-record streams
+    through one DecodeScheduler vs the same N drained one at a time —
+    continuous batching must beat serial decode, gated higher-better
+    and below-par-checked at 1.0) and ``decode_spec_accept_ratio``
+    (self-drafted speculative decode, asserted bitwise identical to the
+    plain greedy pass; a perfect draft accepts everything, so the ratio
+    gates higher-better at 1.0)."""
     import numpy as np
     from analytics_zoo_tpu.common import compile_ahead, telemetry
-    from analytics_zoo_tpu.inference import InferenceModel, generation
+    from analytics_zoo_tpu.inference import (
+        DecodeScheduler, InferenceModel, generation,
+    )
     from analytics_zoo_tpu.models import Seq2Seq
 
     batch, steps = DECODE_BATCH, DECODE_STEPS
@@ -645,13 +665,172 @@ def measure_decode():
                                  ladder=ladder, mode="greedy")
     dt = time.perf_counter() - t0
     assert gen.shape == (batch, steps, 8)
+    recompiles = int(jit_misses() - base)
+
+    # --- step-level continuous batching (ISSUE 16): N single-record
+    # streams through one DecodeScheduler, interleaved vs drained one at
+    # a time. The pinned batch ladder pads BOTH schedules to the same
+    # warmed batch rung, so the delta is pure step-sharing: the
+    # concurrent drain runs ~steps wide steps where the serial one runs
+    # N x steps. Bitwise parity with the plain decode above is asserted
+    # per stream — interleaving must be invisible in the output.
+    conc = 4
+    step_fn = im.decode_step_fn()
+
+    def run_streams(interleaved):
+        sched = DecodeScheduler(
+            step_fn, max_batch=batch, max_seq=steps, spec_k=0,
+            batch_ladder=compile_ahead.BucketLadder(batch, batch))
+        seqs = []
+        for i in range(conc):
+            seqs.append(sched.admit(enc[i], start[i], steps,
+                                    mode="greedy"))
+            if not interleaved:
+                sched.drain()
+        sched.drain()
+        return seqs
+
+    run_streams(True)                  # untimed: absorb first-touch cost
+    t0 = time.perf_counter()
+    serial = run_streams(False)
+    dt_serial = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    inter = run_streams(True)
+    dt_conc = time.perf_counter() - t0
+    for i in range(conc):
+        assert np.array_equal(inter[i].result, serial[i].result)
+        assert np.array_equal(inter[i].result, gen[i]), (
+            f"stream {i}: interleaved decode diverged from the plain "
+            "greedy loop")
+
+    # --- speculative decoding (ISSUE 16): the target drafts for itself
+    # (a perfect draft), the verify step widens by k — the output must
+    # stay bitwise identical to the plain greedy pass, and every
+    # proposed token is accepted, so the telemetry-derived ratio is
+    # exactly 1.0 on any host
+    def spec_counter(name):
+        val = telemetry.snapshot().get(name, 0.0)
+        return float(val if isinstance(val, (int, float)) else 0.0)
+
+    im.warm_decode(steps + 1, verify_k=4, block=True)
+    prop0 = spec_counter("zoo_spec_proposed_total")
+    acc0 = spec_counter("zoo_spec_accepted_total")
+    spec = im.generate(enc, start, steps, mode="greedy", draft=im,
+                       spec_k=4)
+    assert np.array_equal(spec, gen), (
+        "speculative greedy decode diverged from the plain loop")
+    proposed = spec_counter("zoo_spec_proposed_total") - prop0
+    accepted = spec_counter("zoo_spec_accepted_total") - acc0
+    assert proposed > 0, "draft configured but nothing was proposed"
     return {
         "decode_tokens_per_sec": round(batch * steps / dt, 1),
         "decode_p99_ms": round(
             float(np.percentile(step_times, 99)) * 1000.0, 3),
         "decode_steps": steps,
         "decode_batch": batch,
-        "decode_post_warmup_recompiles": int(jit_misses() - base),
+        "decode_post_warmup_recompiles": recompiles,
+        "decode_concurrent_tokens_per_sec":
+            round(conc * steps / dt_conc, 1),
+        "decode_single_stream_tokens_per_sec":
+            round(conc * steps / dt_serial, 1),
+        "decode_concurrent_speedup": round(dt_serial / dt_conc, 3),
+        "decode_concurrency": conc,
+        "decode_spec_accept_ratio": round(accepted / proposed, 3),
+    }
+
+
+def measure_decode_mixed():
+    """Mixed decode/interactive drill (ISSUE 16): flood the batch lane
+    with generate records so the engine's step scheduler always has live
+    sequences, then push closed-loop interactive predicts through the
+    SAME stream. Because the engine yields between scheduler steps
+    (``_decode_tick`` runs exactly one step per loop turn, and
+    ``_decode_should_yield`` defers it when a hotter lane waits), each
+    probe cuts in after at most one step instead of behind whole
+    generations — ``decode_mixed_interactive_p99_ms`` gates that
+    lower-better against ``MIXED_BUDGET_MS``. Zero loss asserted on
+    both lanes; the preemption count rides the record ungated (it is
+    workload-shaped, not a quality axis)."""
+    import numpy as np
+    from analytics_zoo_tpu.common import telemetry
+    from analytics_zoo_tpu.inference import InferenceModel
+    from analytics_zoo_tpu.models import Seq2Seq
+    from analytics_zoo_tpu.serving import (
+        Broker, ClusterServing, InputQueue, OutputQueue,
+    )
+
+    m = Seq2Seq(input_dim=8, output_dim=8, hidden_size=DECODE_HIDDEN,
+                rnn_type="gru", encoder_seq_len=8, decoder_seq_len=4)
+    im = InferenceModel().load_zoo(m)
+    rng = np.random.default_rng(29)
+    encs = rng.standard_normal((MIXED_FLOOD, 8, 8)).astype(np.float32)
+    start = np.zeros(8, np.float32)
+    probe_dec = np.zeros((4, 8), np.float32)
+
+    def preemptions():
+        fam = telemetry.snapshot().get("zoo_decode_preemptions_total", {})
+        if not isinstance(fam, dict):
+            return float(fam or 0.0)
+        return float(sum(fam.values()))
+
+    with Broker.launch() as broker:
+        eng = ClusterServing(im, broker.port, batch_size=MR_BATCH,
+                             max_batch_size=MR_BATCH, block_ms=10,
+                             warmup=False)
+        with eng.start():
+            in_q = InputQueue(port=broker.port)
+            out_q = OutputQueue(port=broker.port)
+            # untimed warm phase: one generate record walks the decode
+            # grid through every seq rung the flood will touch, one
+            # plain record builds the encode bucket — the timed phase
+            # runs entirely on in-band-compiled executables
+            wg = in_q.enqueue("mdwarm_g", priority="batch",
+                              generate={"max_new_tokens": MIXED_STEPS},
+                              x=encs[0], start=start)
+            wp = in_q.enqueue("mdwarm_p", priority="interactive",
+                              a_enc=encs[0], b_dec=probe_dec)
+            assert out_q.query(wg, timeout=120.0) is not None
+            assert out_q.query(wp, timeout=60.0) is not None
+            base_preempt = preemptions()
+            t0 = time.perf_counter()
+            flood = in_q.enqueue_batch(
+                ((f"mdg{i}", {"x": encs[i], "start": start})
+                 for i in range(MIXED_FLOOD)),
+                priority="batch",
+                generate={"max_new_tokens": MIXED_STEPS})
+            lats = []
+            for i in range(MIXED_INT):
+                t1 = time.perf_counter()
+                u = in_q.enqueue(f"mdi{i}", priority="interactive",
+                                 deadline_ms=30_000.0,
+                                 a_enc=encs[i % MIXED_FLOOD],
+                                 b_dec=probe_dec)
+                r = out_q.query(u, timeout=30.0, poll_interval=0.002)
+                assert r is not None, f"interactive {u} unanswered"
+                lats.append(time.perf_counter() - t1)
+            res = out_q.query_many(flood, timeout=120.0)
+            dt = time.perf_counter() - t0
+            missing = [u for u, v in res.items() if v is None]
+            expired = eng.metrics()["records_expired"]
+            preempted = preemptions() - base_preempt
+    assert not missing, f"{len(missing)} generate records unanswered"
+    assert expired == 0, f"{expired} records expired during the drill"
+    for u, v in res.items():
+        assert v.shape == (MIXED_STEPS, 8), f"{u}: bad generate result"
+    lats.sort()
+    p50 = lats[len(lats) // 2]
+    p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+    assert p99 * 1000.0 <= MIXED_BUDGET_MS, (
+        f"interactive p99 {p99 * 1e3:.0f}ms blew the "
+        f"{MIXED_BUDGET_MS:.0f}ms budget under the decode flood")
+    return {
+        "decode_mixed_interactive_p99_ms": round(p99 * 1000.0, 2),
+        "decode_mixed_interactive_p50_ms": round(p50 * 1000.0, 2),
+        "decode_mixed_interactive_budget_ms": MIXED_BUDGET_MS,
+        "decode_mixed_records_per_sec":
+            round((MIXED_FLOOD + MIXED_INT) / dt, 1),
+        "decode_mixed_generate_records": MIXED_FLOOD,
+        "decode_mixed_preemptions_total": int(preempted),
     }
 
 
@@ -1469,12 +1648,20 @@ def compare_bench_records(prev: dict, cur: dict,
         if not isinstance(pv, (int, float)) or \
                 not isinstance(cv, (int, float)) or pv == 0:
             continue
+        # preemption counts are workload-shaped, not a quality axis:
+        # more preemptions can mean better lane fairness or just a
+        # different arrival pattern, so they ride the record ungated
+        # (ISSUE 16)
+        if key.endswith("_preemptions_total"):
+            continue
         ratio = (cv - pv) / abs(pv)
-        # *_speedup is a ratio (higher-better) — checked FIRST because
-        # "_speedup".endswith("_s") would otherwise be a latent trap if
-        # anyone reorders the suffix tuple (ISSUE 8: flash/int8/serving
-        # speedups must gate in the winning direction)
-        if key.endswith("_speedup"):
+        # *_speedup / *_accept_ratio are ratios (higher-better) —
+        # checked FIRST because "_speedup".endswith("_s") would
+        # otherwise be a latent trap if anyone reorders the suffix
+        # tuple (ISSUE 8: flash/int8/serving speedups must gate in the
+        # winning direction; ISSUE 16: a falling speculative accept
+        # ratio is a draft-quality regression, not an improvement)
+        if key.endswith(("_speedup", "_accept_ratio")):
             lower_better = False
         else:
             lower_better = key.endswith(_LOWER_BETTER_SUFFIXES)
@@ -1704,6 +1891,7 @@ def _smoke():
     global RECSYS_ROWS, RECSYS_SHARDS, RECSYS_USERS, RECSYS_ITEMS
     global RECSYS_BATCH
     global DECODE_BATCH, DECODE_STEPS, DECODE_HIDDEN
+    global MIXED_FLOOD, MIXED_INT, MIXED_STEPS
     N_ROWS, BATCH = 2048, 256
     WARMUP_STEPS, MEASURE_STEPS, STEPS_PER_LOOP = 2, 4, 2
     SERVE_N, SERVE_BATCH, SERVE_HIDDEN = 64, 8, 32
@@ -1713,6 +1901,7 @@ def _smoke():
     RECSYS_USERS, RECSYS_ITEMS = 60, 40
     RECSYS_BATCH = 128
     DECODE_BATCH, DECODE_STEPS, DECODE_HIDDEN = 4, 8, 16
+    MIXED_FLOOD, MIXED_INT, MIXED_STEPS = 6, 6, 8
     out = {
         "metric": "ncf_train_samples_per_sec",
         "value": 0.0, "unit": "samples/s", "vs_baseline": 0.0,
@@ -1720,7 +1909,7 @@ def _smoke():
         "device": jax.devices()[0].device_kind,
     }
     rec = _assemble_record(out, (measure_serving, measure_serving_sharded,
-                                 measure_decode,
+                                 measure_decode, measure_decode_mixed,
                                  measure_serving_failover,
                                  measure_serving_multi_replica,
                                  measure_replica_kill_failover,
@@ -1766,6 +1955,7 @@ def main():
     _run_with_deadline(
         out, (measure_bert, measure_tcn, measure_serving,
               measure_serving_sharded, measure_decode,
+              measure_decode_mixed,
               measure_serving_failover, measure_serving_multi_replica,
               measure_replica_kill_failover, measure_serving_priority,
               measure_flash_attention,

@@ -1,0 +1,336 @@
+"""Step-level decode scheduler: paged KV allocator/cache units, bitwise
+interleaved-vs-isolated parity (mid-flight admission, step-boundary
+pauses, page recycling across lengths), speculative accept/reject vs the
+greedy reference, and pool admission control."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import telemetry
+from analytics_zoo_tpu.inference import generation
+from analytics_zoo_tpu.inference.decode_scheduler import (
+    DecodeScheduler, PagedKVAllocator, PagedKVCache, PagePoolExhausted,
+)
+
+DIM = 6
+
+
+def _step_fn(scale=1.0):
+    """Deterministic, strictly causal, row-independent decoder: output at
+    position t mixes enc with the cumulative sum of dec[:, :t+1] — the
+    properties the interleaving parity claim rests on."""
+    w = np.random.default_rng(0).normal(size=(DIM, DIM)).astype(np.float32)
+
+    def fn(enc, dec):
+        csum = np.cumsum(np.asarray(dec, np.float32), axis=1)
+        return np.tanh(scale * (csum @ w) + np.asarray(
+            enc, np.float32)[:, None, :])
+    return fn
+
+
+def _enc(seed, n=1):
+    rows = np.random.default_rng(seed).normal(
+        size=(n, DIM)).astype(np.float32)
+    return rows if n > 1 else rows[0]
+
+
+def _start():
+    s = np.zeros(DIM, np.float32)
+    s[0] = 1.0
+    return s
+
+
+def _reference(fn, enc_row, steps, **kw):
+    """Isolated whole-loop reference for a single sequence."""
+    return generation.decode_loop(
+        fn, enc_row[None], _start()[None], steps, ladder=None, **kw)[0]
+
+
+# ------------------------------------------------------------- allocator
+
+def test_allocator_sizing_and_pages_for():
+    alloc = PagedKVAllocator.for_grid(4, 17, DIM, page_size=8)
+    assert alloc.n_pages == 4 * 3          # ceil(17/8) per sequence
+    assert alloc.pages_for(0) == 0
+    assert alloc.pages_for(1) == 1
+    assert alloc.pages_for(8) == 1
+    assert alloc.pages_for(9) == 2
+
+
+def test_allocator_zeroes_recycled_pages_and_syncs_gauges():
+    alloc = PagedKVAllocator(4, 2, DIM)
+    pages = alloc.alloc_pages(2)
+    alloc._pool[pages[0]].fill(7.0)
+    alloc.free_pages(pages)
+    again = alloc.alloc_pages(4)
+    assert all(not alloc._pool[p].any() for p in again)
+    snap = telemetry.snapshot()
+    assert float(snap["zoo_kv_pages_in_use"]) == 4.0
+    assert float(snap["zoo_kv_pages_free"]) == 0.0
+
+
+def test_allocator_exhaustion_vs_growth():
+    alloc = PagedKVAllocator(4, 2, DIM)
+    held = alloc.alloc_pages(3)
+    # contention: another sequence holds the pages -> defer admission
+    with pytest.raises(PagePoolExhausted):
+        alloc.alloc_pages(2)
+    alloc.free_pages(held)
+    # a single request larger than the whole pool is capacity planning:
+    # the pool grows instead of raising
+    big = alloc.alloc_pages(6)
+    assert len(big) == 6 and alloc.n_pages == 6
+
+
+# ----------------------------------------------------------------- cache
+
+def test_cache_append_truncate_gather_close():
+    alloc = PagedKVAllocator(8, 2, DIM)
+    cache = PagedKVCache(alloc, alloc.alloc_pages(2))
+    rows = np.eye(DIM, dtype=np.float32)[:4]
+    cache.append_block(rows[:3])
+    assert cache.length == 3
+    assert cache.token_id(1) == 1
+    assert np.array_equal(cache.row(2), rows[2])
+    # growth past the admission reservation allocs straight into _pages
+    cache.append(rows[3])
+    cache.append(rows[0])
+    assert cache.length == 5 and cache.capacity == 6
+    dst = np.full((8, DIM), 9.0, np.float32)
+    dst[:] = 0.0
+    cache.gather_into(dst)
+    assert np.array_equal(dst[:3], rows[:3])
+    assert not dst[5:].any()                 # causal zero tail
+    cache.truncate(2)
+    assert cache.length == 2
+    dst[:] = 0.0
+    cache.gather_into(dst)
+    assert not dst[2:].any()                 # truncated drafts zeroed
+    cache.close()
+    cache.close()                            # idempotent
+    assert alloc.n_free == alloc.n_pages
+
+
+# ------------------------------------------------- interleaving parity
+
+def test_scheduler_greedy_matches_isolated_reference_bitwise():
+    fn = _step_fn()
+    sched = DecodeScheduler(fn, max_batch=4, max_seq=16, page_size=4)
+    seqs = [sched.admit(_enc(i), _start(), 5 + i, mode="greedy")
+            for i in range(3)]
+    sched.drain()
+    for i, s in enumerate(seqs):
+        ref = _reference(fn, _enc(i), 5 + i, mode="greedy")
+        assert np.array_equal(s.result, ref)
+    # every page back in the pool after retirement
+    assert sched.allocator.n_free == sched.allocator.n_pages
+
+
+def test_mid_flight_admission_is_invisible_bitwise():
+    fn = _step_fn()
+    sched = DecodeScheduler(fn, max_batch=4, max_seq=32, page_size=4)
+    a = sched.admit(_enc(1), _start(), 10, mode="greedy")
+    for _ in range(4):                       # a is mid-generation...
+        sched.step()
+    b = sched.admit(_enc(2), _start(), 6, mode="greedy")
+    sched.drain()
+    assert np.array_equal(a.result, _reference(fn, _enc(1), 10,
+                                               mode="greedy"))
+    assert np.array_equal(b.result, _reference(fn, _enc(2), 6,
+                                               mode="greedy"))
+
+
+def test_step_boundary_pauses_are_invisible_bitwise():
+    # the engine preempts between steps — a paused-and-resumed schedule
+    # must produce exactly what an uninterrupted drain produces
+    fn = _step_fn()
+    paused = DecodeScheduler(fn, max_batch=4, max_seq=16, page_size=4)
+    straight = DecodeScheduler(fn, max_batch=4, max_seq=16, page_size=4)
+    p = [paused.admit(_enc(i), _start(), 7, mode="greedy")
+         for i in range(2)]
+    s = [straight.admit(_enc(i), _start(), 7, mode="greedy")
+         for i in range(2)]
+    while paused.live:
+        paused.step()                        # "preemption" = caller pause
+        # arbitrary interleaved work happens here in the engine
+    straight.drain()
+    for x, y in zip(p, s):
+        assert np.array_equal(x.result, y.result)
+
+
+def test_page_recycling_across_lengths():
+    fn = _step_fn()
+    # pool holds exactly two worst-case sequences (6 pages of 4)
+    alloc = PagedKVAllocator.for_grid(2, 12, DIM, page_size=4)
+    sched = DecodeScheduler(fn, max_batch=2, max_seq=11, page_size=4,
+                            allocator=alloc, spec_k=0)
+    short = sched.admit(_enc(3), _start(), 2, mode="greedy")
+    long = sched.admit(_enc(4), _start(), 11, mode="greedy")
+    with pytest.raises(PagePoolExhausted):
+        sched.admit(_enc(5), _start(), 11, mode="greedy")
+    while not short.done:
+        sched.step()
+    # the short retirement freed pages mid-flight of the long one
+    third = sched.admit(_enc(5), _start(), 4, mode="greedy")
+    sched.drain()
+    assert np.array_equal(short.result, _reference(fn, _enc(3), 2,
+                                                   mode="greedy"))
+    assert np.array_equal(long.result, _reference(fn, _enc(4), 11,
+                                                  mode="greedy"))
+    assert np.array_equal(third.result, _reference(fn, _enc(5), 4,
+                                                   mode="greedy"))
+    assert alloc.n_free == alloc.n_pages
+
+
+def test_chunked_prefill_matches_isolated_scheduler():
+    fn = _step_fn()
+    prefill = np.random.default_rng(8).normal(
+        size=(9, DIM)).astype(np.float32)
+
+    def run(extra_load):
+        sched = DecodeScheduler(fn, max_batch=4, max_seq=32, page_size=4,
+                                prefill_chunk=4)
+        if extra_load:
+            sched.admit(_enc(6), _start(), 12, mode="greedy")
+        seq = sched.admit(_enc(7), prefill, 5, mode="greedy")
+        sched.drain()
+        return seq.result
+
+    assert np.array_equal(run(True), run(False))
+
+
+def test_sample_mode_rng_is_per_sequence():
+    fn = _step_fn()
+    sched = DecodeScheduler(fn, max_batch=4, max_seq=16, page_size=4)
+    seqs = [sched.admit(_enc(i), _start(), 6, mode="sample",
+                        temperature=0.7, seed=100 + i)
+            for i in range(3)]
+    sched.drain()
+    for i, s in enumerate(seqs):
+        ref = _reference(fn, _enc(i), 6, mode="sample", temperature=0.7,
+                         seed=100 + i)
+        assert np.array_equal(s.result, ref)
+
+
+# ------------------------------------------------- speculative decoding
+
+def test_speculative_with_perfect_draft_is_bitwise_greedy():
+    fn = _step_fn()
+    sched = DecodeScheduler(fn, max_batch=4, max_seq=16, page_size=4,
+                            draft_fn=fn, spec_k=3)
+    seqs = [sched.admit(_enc(i), _start(), 8, mode="greedy")
+            for i in range(2)]
+    sched.drain()
+    for i, s in enumerate(seqs):
+        assert np.array_equal(s.result,
+                              _reference(fn, _enc(i), 8, mode="greedy"))
+    # a perfect draft never mismatches
+    assert sched.spec_accept_ratio == 1.0
+    # and accepted tokens cost no extra target steps: 8 tokens in
+    # ceil(8 / (spec_k + 1)) wide steps, not 8
+    assert sched.steps_run == 2
+    assert sched.allocator.n_free == sched.allocator.n_pages
+
+
+def test_speculative_with_adversarial_draft_still_bitwise_greedy():
+    fn = _step_fn()
+    bad = lambda enc, dec: -fn(enc, dec)     # disagrees everywhere
+    sched = DecodeScheduler(fn, max_batch=4, max_seq=16, page_size=4,
+                            draft_fn=bad, spec_k=3)
+    s = sched.admit(_enc(9), _start(), 8, mode="greedy")
+    sched.drain()
+    assert np.array_equal(s.result, _reference(fn, _enc(9), 8,
+                                               mode="greedy"))
+    assert sched.spec_accept_ratio == 0.0
+    assert sched.allocator.n_free == sched.allocator.n_pages
+
+
+def test_speculative_skips_sample_mode_sequences():
+    # clean fallback: sampled sequences take the plain one-token step
+    # even with a draft configured, and their rng stream is unchanged
+    fn = _step_fn()
+    sched = DecodeScheduler(fn, max_batch=4, max_seq=16, page_size=4,
+                            draft_fn=fn, spec_k=3)
+    s = sched.admit(_enc(2), _start(), 6, mode="sample", temperature=0.7,
+                    seed=42)
+    sched.drain()
+    ref = _reference(fn, _enc(2), 6, mode="sample", temperature=0.7,
+                     seed=42)
+    assert np.array_equal(s.result, ref)
+    assert sched.spec_accept_ratio == 0.0    # nothing was proposed
+
+
+def test_spec_metrics_land_on_the_registry():
+    fn = _step_fn()
+    sched = DecodeScheduler(fn, max_batch=2, max_seq=16, page_size=4,
+                            draft_fn=fn, spec_k=2)
+    sched.admit(_enc(1), _start(), 6, mode="greedy")
+    sched.drain()
+    snap = telemetry.snapshot()
+    assert float(snap["zoo_spec_proposed_total"]) > 0
+    assert float(snap["zoo_spec_accepted_total"]) > 0
+    assert float(snap["zoo_spec_accept_ratio"]) == 1.0
+
+
+# ------------------------------------------- engine preemption seam
+
+def _preemptions_total():
+    fam = telemetry.snapshot().get("zoo_decode_preemptions_total", {})
+    if not isinstance(fam, dict):
+        return float(fam or 0.0)
+    return float(sum(fam.values()))
+
+
+def test_engine_defers_decode_to_hotter_lane_with_starvation_floor():
+    """The engine's per-step preemption: a waiting record on a lane with
+    a strictly lower credit/weight ratio defers the decode step (counted
+    on zoo_decode_preemptions_total), and the starvation floor forces a
+    step through after DECODE_STARVATION_FLOOR consecutive deferrals."""
+    from analytics_zoo_tpu.serving.engine import ClusterServing
+
+    eng = ClusterServing(object(), 0, warmup=False)
+    sched = DecodeScheduler(_step_fn(), max_batch=2, max_seq=16,
+                            page_size=4)
+    seq = sched.admit(_enc(1), _start(), 8, mode="greedy")
+    eng._decode_sched = sched
+    eng._gen_live[seq] = ("u1", ("XACK",), None, "batch", eng._conn_gen)
+    # one interactive record waiting in the assembly bucket, its lane
+    # ratio (0/4) strictly under the live decode lane's (5/1)
+    eng._asm = [(1, "u2", {}, None, "interactive", 0.0, None, None)]
+    eng._lane_credit.update({"interactive": 0.0, "batch": 5.0})
+    before = _preemptions_total()
+    for _ in range(eng.DECODE_STARVATION_FLOOR):
+        assert eng._decode_tick(None) == 0
+    assert sched.steps_run == 0                  # every tick deferred
+    assert _preemptions_total() - before == eng.DECODE_STARVATION_FLOOR
+    eng._decode_tick(None)                       # floor reached: step runs
+    assert sched.steps_run == 1
+    assert _preemptions_total() - before == eng.DECODE_STARVATION_FLOOR
+    # with nothing waiting the decode never defers
+    eng._asm = []
+    eng._decode_tick(None)
+    assert sched.steps_run == 2
+    sched.abort_all()
+
+
+# ---------------------------------------------------- lifecycle & errors
+
+def test_abort_all_frees_every_page():
+    fn = _step_fn()
+    sched = DecodeScheduler(fn, max_batch=4, max_seq=16, page_size=4)
+    sched.admit(_enc(1), _start(), 8, mode="greedy")
+    sched.admit(_enc(2), _start(), 8, mode="greedy")
+    sched.step()
+    dropped = sched.abort_all()
+    assert len(dropped) == 2 and sched.live == 0
+    assert sched.allocator.n_free == sched.allocator.n_pages
+
+
+def test_admit_validates_inputs():
+    sched = DecodeScheduler(_step_fn())
+    with pytest.raises(ValueError):
+        sched.admit(_enc(1), _start(), 0, mode="greedy")
+    with pytest.raises(ValueError):
+        sched.admit(_enc(1), _start(), 4, mode="beam")
+    with pytest.raises(ValueError):
+        sched.admit(_enc(1), np.zeros((2, 2, DIM)), 4)

@@ -128,6 +128,46 @@ def test_sample_mode_is_seed_deterministic(s2s, s2s_inputs):
     assert np.array_equal(run(5), run(5))
 
 
+def test_sample_token_ids_gumbel_stream_contract():
+    vec = np.random.default_rng(0).normal(size=(4, 6))
+    a, b = np.random.default_rng(9), np.random.default_rng(9)
+    ids = generation.sample_token_ids(vec, 0.7, a)
+    assert ids.shape == (4,)
+    # exactly ONE uniform draw of vec.shape per call — the contract the
+    # step scheduler's per-sequence rng streams rest on
+    b.random(vec.shape)
+    assert a.bit_generator.state == b.bit_generator.state
+    ids2 = generation.sample_token_ids(vec, 0.7, np.random.default_rng(9))
+    assert np.array_equal(ids, ids2)
+
+
+def test_sample_vectorization_matches_per_row_reference():
+    vec = np.random.default_rng(4).normal(size=(5, 7))
+    u = np.random.default_rng(11).random(vec.shape)
+    u = np.maximum(u, np.finfo(np.float64).tiny)
+    want = np.array([np.argmax(vec[i] / 0.7 - np.log(-np.log(u[i])))
+                     for i in range(vec.shape[0])])
+    got = generation.sample_token_ids(vec, 0.7, np.random.default_rng(11))
+    assert np.array_equal(got, want)
+
+
+def test_sample_low_temperature_collapses_to_argmax():
+    vec = np.random.default_rng(1).normal(size=(8, 5))
+    ids = generation.sample_token_ids(vec, 1e-9, np.random.default_rng(3))
+    assert np.array_equal(ids, np.argmax(vec, axis=-1))
+
+
+def test_feedback_rows_sample_is_seeded_one_hot():
+    vec = np.random.default_rng(2).normal(size=(3, 4)).astype(np.float32)
+    r1 = generation.feedback_rows(
+        vec, "sample", 0.5, np.random.default_rng(7))
+    r2 = generation.feedback_rows(
+        vec, "sample", 0.5, np.random.default_rng(7))
+    assert np.array_equal(r1, r2)
+    assert np.all(np.isin(r1, (0.0, 1.0)))
+    assert np.all(r1.sum(axis=-1) == 1.0)
+
+
 def test_bad_mode_and_steps_raise(s2s_inputs):
     enc, start = s2s_inputs
     fn = lambda e, d: np.zeros((e.shape[0], d.shape[1], 2), np.float32)

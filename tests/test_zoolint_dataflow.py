@@ -442,6 +442,85 @@ def test_jit_branch_fixture_lines():
     assert len(findings) == 3
 
 
+# ---------------------------------------------------------- kv-page-leak
+
+def test_kv_page_leak_early_return_and_guarded_handoff():
+    leak = """
+    def admit(pool, cache_cls, enc, need, budget):
+        pages = pool.alloc_pages(need)
+        if need > budget:
+            return None
+        return cache_cls(pool, pages)
+    """
+    clean = """
+    def admit(pool, cache_cls, validate, enc, need):
+        pages = pool.alloc_pages(need)
+        try:
+            validate(enc)
+            cache = cache_cls(pool, pages)
+        except Exception:
+            pool.free_pages(pages)
+            raise
+        return cache
+    """
+    assert "kv-page-leak" in _rules_of(_scan(leak))
+    assert "kv-page-leak" not in _rules_of(_scan(clean))
+
+
+def test_kv_page_leak_counts_the_raise_exit():
+    # unlike record-ack-leak (lease redelivery covers escaping
+    # exceptions), stranded pages never rejoin the pool — an unprotected
+    # call between the alloc and the handoff is itself a finding
+    src = """
+    def admit(pool, cache_cls, validate, enc, need):
+        pages = pool.alloc_pages(need)
+        validate(enc)
+        return cache_cls(pool, pages)
+    """
+    f = [x for x in _scan(src) if x.rule == "kv-page-leak"]
+    assert f and "without being freed or handed off" in f[0].message
+
+
+def test_kv_page_leak_loop_settlement_forms():
+    # free on one branch, handoff into a collection on the other — both
+    # settle ownership, the per-iteration alloc is clean
+    src = """
+    def retire(pool, seqs):
+        recycled = []
+        for seq in seqs:
+            pages = pool.alloc_pages(seq.need)
+            if seq.short:
+                pool.free_pages(pages)
+            else:
+                recycled.append(pages)
+        return recycled
+    """
+    assert "kv-page-leak" not in _rules_of(_scan(src))
+
+
+def test_kv_page_leak_fixture_lines():
+    path = os.path.join(FIXTURE, "serving", "bad_kv_page_leak.py")
+    findings = [f for f in analyze_paths([path], root=REPO)
+                if f.rule == "kv-page-leak"]
+    tree = ast.parse(open(path).read())
+    expected = set()
+    for fn in tree.body:
+        if isinstance(fn, ast.FunctionDef) and fn.name.endswith("_leak"):
+            expected.add(min(n.lineno for n in ast.walk(fn)
+                             if isinstance(n, ast.Assign)))
+    # exactly the two VIOLATION allocs; the clean shapes stay quiet
+    assert {f.line for f in findings} == expected
+    assert len(findings) == 2
+
+
+def test_kv_page_leak_clean_on_real_scheduler():
+    sched = os.path.join(REPO, "analytics_zoo_tpu", "inference",
+                         "decode_scheduler.py")
+    findings = [f for f in analyze_paths([sched, ENGINE], root=REPO)
+                if f.rule == "kv-page-leak"]
+    assert findings == []
+
+
 # ------------------------------------------------------------ CFG cache
 
 def test_cfg_cache_hits_and_rebuild():
