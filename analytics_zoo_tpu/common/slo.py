@@ -10,11 +10,13 @@ SRE-workbook signal instead:
 - an :class:`SLO` declares a target: "99% of records complete within
   ``threshold_s``" (latency, read from a histogram's bucket counts) or
   "99.9% of records succeed" (availability, read from a counter pair);
-- :class:`SLOMonitor` samples the cumulative series on every ``tick()``,
-  keeps a bounded ring of timestamped samples, and computes the **burn
-  rate** per rolling window: ``bad_fraction / (1 - objective)`` — burn 1.0
-  spends the error budget exactly at the sustainable rate, burn N spends
-  it N× too fast;
+- :class:`SLOMonitor` delegates sample retention to the history store
+  (``common/timeseries.py``): every ``tick()`` samples the registry into
+  the store's rings and computes the **burn rate** per rolling window
+  from the store's windowed deltas: ``bad_fraction / (1 - objective)``
+  — burn 1.0 spends the error budget exactly at the sustainable rate,
+  burn N spends it N× too fast (the monitor's former private sample
+  ring is gone — one retained history, many readers);
 - burns are published as ``zoo_slo_burn_rate{slo,window}`` (and the
   shed decision as ``zoo_slo_shedding``), served by ``GET /slo``, and
   drive the frontend's ``/healthz`` 503: **multi-window** agreement (all
@@ -36,12 +38,11 @@ from __future__ import annotations
 
 import os
 import threading
-from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from time import monotonic
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from analytics_zoo_tpu.common import telemetry
+from analytics_zoo_tpu.common import telemetry, timeseries
 
 __all__ = [
     "SLO", "SLOMonitor", "default_slos", "get_monitor", "set_monitor",
@@ -133,87 +134,30 @@ def default_slos() -> List[SLO]:
     return out
 
 
-def _entries(fam: Any,
-             labels: Optional[Tuple[Tuple[str, str], ...]] = None
-             ) -> List[Dict[str, Any]]:
-    """Histogram entries of a snapshot family (labelled or collapsed).
-    With ``labels``, only children whose snapshot key carries every
-    (key, value) pair are kept — an unlabeled family cannot match a
-    label filter and yields nothing."""
-    if fam is None:
-        return []
-    if isinstance(fam, dict) and "count" in fam and "le" in fam:
-        return [] if labels else [fam]
-    if isinstance(fam, dict):
-        out = []
-        for key, v in fam.items():
-            if not (isinstance(v, dict) and "count" in v and "le" in v):
-                continue
-            if labels:
-                names, values = telemetry._parse_label_key(key)
-                kv = dict(zip(names, values))
-                if any(kv.get(k) != want for k, want in labels):
-                    continue
-            out.append(v)
-        return out
-    return []
-
-
-def _scalar_total(fam: Any) -> float:
-    if fam is None:
-        return 0.0
-    if isinstance(fam, (int, float)):
-        return float(fam)
-    if isinstance(fam, dict):
-        return float(sum(v for v in fam.values()
-                         if isinstance(v, (int, float))))
-    return 0.0
-
-
-def _sample_slo(slo: SLO, snap: Dict[str, Any]) -> Dict[str, Any]:
-    """One cumulative sample of the series an SLO watches."""
+def _window_good_bad(slo: SLO, store: "timeseries.TimeSeriesStore",
+                     window: float, now: float
+                     ) -> Tuple[float, float, float]:
+    """(good, bad, covered_s) event deltas for one SLO over one rolling
+    window, read from the history store. Per-series deltas clamp at 0
+    inside the store, so a registry reset (tests) reads as an empty
+    window, never a negative one."""
     if slo.kind == "latency":
-        le: List[float] = []
-        counts: List[int] = []
-        total = 0
-        for e in _entries(snap.get(slo.metric), slo.labels):
-            if not le:
-                le = list(e["le"])
-                counts = [0] * len(e["bucket_counts"])
-            if list(e["le"]) != le:
-                continue        # mismatched child buckets: skip, not lie
-            counts = [a + int(b)
-                      for a, b in zip(counts, e["bucket_counts"])]
-            total += int(e["count"])
-        return {"le": le, "counts": counts, "count": total}
-    return {"good": _scalar_total(snap.get(slo.metric)),
-            "bad": _scalar_total(snap.get(slo.bad_metric))}
-
-
-def _good_bad_delta(slo: SLO, old: Dict[str, Any],
-                    new: Dict[str, Any]) -> Tuple[float, float]:
-    """(good, bad) event deltas between two cumulative samples. Clamped
-    at 0 so a registry reset (tests) reads as an empty window, never a
-    negative one."""
-    if slo.kind == "latency":
-        le = new.get("le") or []
-        if not le or old.get("le") not in (None, [], le):
-            return 0.0, 0.0
-        d_total = max(0, new["count"] - (old.get("count") or 0))
-        if d_total == 0:
-            return 0.0, 0.0
-        old_counts = old.get("counts") or [0] * len(new["counts"])
+        le, counts, total, covered = store.window_hist_delta(
+            slo.metric, labels=slo.labels, window=window, now=now)
+        if not le or total == 0:
+            return 0.0, 0.0, covered
         # good = observations in buckets fully at/under the threshold
         # (first edge ≥ threshold still counts: v ≤ edge ⇒ within SLO
         # only when edge ≤ threshold, so use edges ≤ threshold + ulp)
         good = 0
-        for edge, n_new, n_old in zip(le, new["counts"], old_counts):
+        for edge, c in zip(le, counts):
             if edge <= slo.threshold_s * (1 + 1e-9):
-                good += max(0, int(n_new) - int(n_old))
-        return float(min(good, d_total)), float(max(0, d_total - good))
-    d_good = max(0.0, new["good"] - (old.get("good") or 0.0))
-    d_bad = max(0.0, new["bad"] - (old.get("bad") or 0.0))
-    return d_good, d_bad
+                good += int(c)
+        good = min(good, total)
+        return float(good), float(total - good), covered
+    d_good, cov_g = store.window_scalar_delta(slo.metric, window, now)
+    d_bad, cov_b = store.window_scalar_delta(slo.bad_metric, window, now)
+    return d_good, d_bad, max(cov_g, cov_b)
 
 
 @dataclass
@@ -229,11 +173,13 @@ class _WindowBurn:
 class SLOMonitor:
     """Rolling-window burn rates over the process registry.
 
-    ``tick()`` is the one state transition: sample the cumulative series,
-    recompute every (slo, window) burn, publish the gauges. Call it from
-    the daemon ticker (``start()``), from a request handler via
-    ``tick_if_stale()`` (the frontend's mode — no thread, sampling rides
-    the health-check cadence), or directly in tests."""
+    ``tick()`` is the one state transition: sample the registry into the
+    history store (``timeseries.get_store()`` — re-resolved every tick,
+    tests swap it), recompute every (slo, window) burn from the store's
+    windowed deltas, publish the gauges. Call it from the daemon ticker
+    (``start()``), from a request handler via ``tick_if_stale()`` (the
+    frontend's mode — no thread, sampling rides the health-check
+    cadence), or directly in tests."""
 
     def __init__(self, slos: Optional[Sequence[SLO]] = None,
                  windows: Optional[Sequence[float]] = None,
@@ -256,10 +202,12 @@ class SLOMonitor:
         # without 503-ing the replica
         self._shed_names = frozenset(
             s.name for s in self.slos if getattr(s, "shed", True))
-        retain = int(max(self.windows) / max(self.tick_s, 1e-3)) + 8
-        self._samples: "deque[Tuple[float, Dict[str, Dict]]]" = deque(
-            maxlen=min(retain, 4096))
         self._burns: Dict[str, Dict[str, _WindowBurn]] = {}
+        # set at the first tick: burn windows clamp their left edge here,
+        # so a fresh monitor never bills traffic that predates it (the
+        # store's rings outlive any one monitor; the retired private
+        # sample deque baselined at creation and this preserves that)
+        self._born: Optional[float] = None
         self._last_tick = 0.0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -267,8 +215,14 @@ class SLOMonitor:
     # ----------------------------------------------------------- sampling
     def tick(self, now: Optional[float] = None) -> None:
         now = monotonic() if now is None else float(now)
-        snap = telemetry.snapshot()
-        sample = {slo.name: _sample_slo(slo, snap) for slo in self.slos}
+        with self._lock:
+            if self._born is None:
+                self._born = now
+            born = self._born
+        # re-resolve per tick: reset_for_tests swaps the global store,
+        # and a monitor caching the old one would read cleared rings
+        store = timeseries.get_store()
+        store.tick(now=now)
         reg = telemetry.get_registry()
         burn_gauge = reg.gauge(
             "zoo_slo_burn_rate",
@@ -279,42 +233,31 @@ class SLOMonitor:
             "zoo_slo_shedding",
             "1 while burn-rate load shedding is active (all windows past "
             "ZOO_SLO_SHED_BURN for some SLO)")
+        burns: Dict[str, Dict[str, _WindowBurn]] = {}
+        for slo in self.slos:
+            per_win: Dict[str, _WindowBurn] = {}
+            for w in self.windows:
+                # clamp the window at the monitor's birth: the shared
+                # store retains history across monitor lifetimes, but
+                # this monitor's error budget starts spending at its own
+                # first tick
+                eff = min(w, max(0.0, now - born))
+                good, bad, covered = _window_good_bad(slo, store, eff, now)
+                events = good + bad
+                frac = bad / events if events else 0.0
+                burn = frac / max(1e-9, 1.0 - slo.objective)
+                per_win[f"{int(w)}s"] = _WindowBurn(
+                    window_s=w, events=events, bad=bad,
+                    bad_fraction=frac, burn=burn, covered_s=covered)
+            burns[slo.name] = per_win
         with self._lock:
-            self._samples.append((now, sample))
             self._last_tick = now
-            burns: Dict[str, Dict[str, _WindowBurn]] = {}
-            for slo in self.slos:
-                per_win: Dict[str, _WindowBurn] = {}
-                for w in self.windows:
-                    old_t, old = self._sample_at(now - w)
-                    good, bad = _good_bad_delta(
-                        slo, old.get(slo.name, {}), sample[slo.name])
-                    events = good + bad
-                    frac = bad / events if events else 0.0
-                    burn = frac / max(1e-9, 1.0 - slo.objective)
-                    per_win[f"{int(w)}s"] = _WindowBurn(
-                        window_s=w, events=events, bad=bad,
-                        bad_fraction=frac, burn=burn,
-                        covered_s=max(0.0, now - old_t))
-                burns[slo.name] = per_win
             self._burns = burns
             shedding = self._overloaded_locked()
         for name, per_win in burns.items():
             for wname, wb in per_win.items():
                 burn_gauge.labels(name, wname).set(round(wb.burn, 6))
         shed_gauge.set(1.0 if shedding else 0.0)
-
-    def _sample_at(self, t: float) -> Tuple[float, Dict[str, Dict]]:
-        """The newest sample taken at or before ``t`` — the window's
-        start point; falls back to the oldest held sample (partial
-        window) so a young process still reports."""
-        best = self._samples[0]
-        for s in self._samples:
-            if s[0] <= t:
-                best = s
-            else:
-                break
-        return best
 
     def tick_if_stale(self) -> None:
         """Tick when the last sample is older than ``tick_s`` — lets the
@@ -375,10 +318,11 @@ class SLOMonitor:
                             "covered_s": round(wb.covered_s, 3)}
                         for w, wb in per.items()},
                 })
-            return {"slos": slos, "shedding": self._overloaded_locked(),
-                    "shed_burn": self.shed_burn,
-                    "windows_s": list(self.windows),
-                    "samples_held": len(self._samples)}
+            shedding = self._overloaded_locked()
+        return {"slos": slos, "shedding": shedding,
+                "shed_burn": self.shed_burn,
+                "windows_s": list(self.windows),
+                "history_points": timeseries.get_store().points_held()}
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> "SLOMonitor":

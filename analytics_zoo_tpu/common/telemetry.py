@@ -40,7 +40,7 @@ import threading
 from collections import OrderedDict
 from contextlib import contextmanager
 from dataclasses import dataclass
-from time import perf_counter
+from time import monotonic, perf_counter
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = [
@@ -148,7 +148,16 @@ class Histogram(_Child):
 
     The reservoir keeps the first ``RESERVOIR_SIZE`` samples then switches
     to uniform replacement (algorithm R) with a cheap deterministic LCG —
-    no ``random`` module state touched, bounded memory forever."""
+    no ``random`` module state touched, bounded memory forever.
+
+    ``observe(v, exemplar=trace_id)`` additionally parks the trace id in
+    the observed value's bucket — one slot per bucket (latest wins), so
+    exemplar memory is bounded by the bucket count. Exposed in the
+    Prometheus exposition (OpenMetrics ``# {trace_id="..."} v`` suffix)
+    and in ``/query`` results, linking a windowed p99 spike to the
+    ``/trace`` span tree that caused it. Callers pass an exemplar only
+    for trace-sampled requests (``Tracer.should_sample``), so the id is
+    resolvable while the trace store holds it."""
 
     def __init__(self, labelvalues=(), buckets: Sequence[float] = DEFAULT_BUCKETS):
         super().__init__(labelvalues)
@@ -158,8 +167,10 @@ class Histogram(_Child):
         self._sum = 0.0
         self._reservoir: List[float] = []
         self._rng = 0x9E3779B9
+        # bucket index -> (trace_id, observed value, monotonic timestamp)
+        self._exemplars: Dict[int, Tuple[str, float, float]] = {}
 
-    def observe(self, v: float):
+    def observe(self, v: float, exemplar: Optional[str] = None):
         v = float(v)
         with self._lock:
             self._count += 1
@@ -170,6 +181,8 @@ class Histogram(_Child):
                     break
                 i += 1
             self._bucket_counts[i] += 1
+            if exemplar is not None:
+                self._exemplars[i] = (str(exemplar), v, monotonic())
             if len(self._reservoir) < RESERVOIR_SIZE:
                 self._reservoir.append(v)
             else:
@@ -201,6 +214,10 @@ class Histogram(_Child):
         with self._lock:
             return (list(self._bucket_counts), self._count, self._sum,
                     list(self._reservoir))
+
+    def _exemplar_state(self) -> Dict[int, Tuple[str, float, float]]:
+        with self._lock:
+            return dict(self._exemplars)
 
 
 class _Family:
@@ -251,8 +268,8 @@ class _Family:
     def set(self, v: float):
         self._default().set(v)
 
-    def observe(self, v: float):
-        self._default().observe(v)
+    def observe(self, v: float, exemplar: Optional[str] = None):
+        self._default().observe(v, exemplar)
 
     @property
     def value(self):
@@ -456,18 +473,29 @@ class MetricsRegistry:
                         + " " + _fmt_value(child.value))
                 else:
                     counts, total, s, _ = child._state()
+                    exs = child._exemplar_state()
+
+                    def _ex_suffix(i: int) -> str:
+                        ex = exs.get(i)
+                        if ex is None:
+                            return ""
+                        # OpenMetrics exemplar syntax on the bucket line
+                        return (f' # {{trace_id="{_escape_label(ex[0])}"}}'
+                                f" {_fmt_value(ex[1])}")
+
                     cum = 0
-                    for b, c in zip(child.buckets, counts):
+                    for i, (b, c) in enumerate(zip(child.buckets, counts)):
                         cum += c
                         names = [k for k, _ in label_base] + ["le"]
                         vals = [v for _, v in label_base] + [_fmt_value(b)]
                         lines.append(f"{fam.name}_bucket"
                                      + _label_str(names, vals)
-                                     + " " + str(cum))
+                                     + " " + str(cum) + _ex_suffix(i))
                     names = [k for k, _ in label_base] + ["le"]
                     vals = [v for _, v in label_base] + ["+Inf"]
                     lines.append(f"{fam.name}_bucket"
-                                 + _label_str(names, vals) + " " + str(total))
+                                 + _label_str(names, vals) + " " + str(total)
+                                 + _ex_suffix(len(child.buckets)))
                     ls = _label_str([k for k, _ in label_base],
                                     [v for _, v in label_base])
                     lines.append(f"{fam.name}_sum{ls} " + _fmt_value(s))
@@ -661,15 +689,25 @@ class Tracer:
     def record(self, trace_id: str, name: str, start: float, end: float,
                parent: Optional[str] = None):
         span = Span(name, trace_id, start, end, parent)
+        evicted = 0
         with self._lock:
             spans = self._traces.get(trace_id)
             if spans is None:
                 while len(self._traces) >= self.capacity:
                     self._traces.popitem(last=False)
+                    evicted += 1
                 spans = []
                 self._traces[trace_id] = spans
             spans.append(span)
             hooks = tuple(self._hooks)
+        if evicted:
+            # traces dropped under LRU pressure would otherwise vanish
+            # silently and break exemplar->/trace links; counted outside
+            # the store lock (registry locks are independent leaves)
+            get_registry().counter(
+                "zoo_trace_evictions_total",
+                "Traces evicted from the bounded span store under LRU "
+                "pressure").inc(evicted)
         for hook in hooks:
             try:
                 hook(span)
@@ -775,6 +813,9 @@ def reset_for_tests():
     res = sys.modules.get("analytics_zoo_tpu.common.resilience")
     if res is not None:
         res.reset_for_tests()
+    ts = sys.modules.get("analytics_zoo_tpu.common.timeseries")
+    if ts is not None:
+        ts.reset_for_tests()
 
 
 def bench_snapshot() -> Dict[str, Any]:

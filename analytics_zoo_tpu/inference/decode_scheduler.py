@@ -211,6 +211,10 @@ class PagedKVCache:
     def capacity(self) -> int:
         return len(self._pages) * self._alloc.page_size
 
+    @property
+    def n_pages(self) -> int:
+        return len(self._pages)
+
     def _slot(self, pos: int):
         page, off = divmod(int(pos), self._alloc.page_size)
         return self._pages[page], off
@@ -278,7 +282,7 @@ class DecodeSequence:
     __slots__ = ("enc", "cache", "prefill", "max_new_tokens", "mode",
                  "temperature", "rng", "gen", "generated", "tag", "lane",
                  "trace_uri", "error", "_prefill_pos", "_drafts",
-                 "t_admit")
+                 "t_admit", "device_s", "pages_held")
 
     def __init__(self, enc, prefill, max_new_tokens, mode, temperature,
                  seed, cache, tag, lane, trace_uri):
@@ -300,6 +304,12 @@ class DecodeSequence:
         self._prefill_pos = 0
         self._drafts = 0
         self.t_admit = perf_counter()
+        # cost attribution, settled by the engine when the sequence
+        # finishes: device_s accumulates this sequence's share of every
+        # wide step's wall time; pages_held tracks the cache's page high
+        # water (captured just before close frees them)
+        self.device_s = 0.0
+        self.pages_held = int(cache.n_pages)
 
     @property
     def prefilled(self) -> bool:
@@ -527,8 +537,15 @@ class DecodeScheduler:
                     self._tracer.record(s.trace_uri, f"decode_step_{g}",
                                         t0, t1, parent="device")
             if s.done:
+                s.pages_held = max(s.pages_held, s.cache.n_pages)
                 s.cache.close()
                 finished.append(s)
+        # bill every participant an equal share of the wide step's wall
+        # time — the per-request device-seconds the engine settles into
+        # zoo_request_cost_device_seconds when the sequence finishes
+        share = (perf_counter() - t0) / max(1, len(seqs))
+        for s in seqs:
+            s.device_s += share
         return finished
 
     # ------------------------------------------------- speculative decode

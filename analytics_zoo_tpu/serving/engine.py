@@ -36,7 +36,7 @@ import numpy as np
 # StageTimer moved to the shared pipeline layer; re-exported here because
 # the engine is its historical home.
 from analytics_zoo_tpu.common import compile_ahead, fleet, resilience, \
-    slo, telemetry
+    slo, telemetry, timeseries
 from analytics_zoo_tpu.common.pipeline_io import (  # noqa: F401
     Completed,
     DevicePipeline,
@@ -206,6 +206,10 @@ class ClusterServing:
     #: consecutive preempted decode ticks before a step runs regardless —
     #: encode pressure may slow decode, never starve it
     DECODE_STARVATION_FLOOR = 4
+    #: count-shaped buckets for the step/page cost histograms (the
+    #: latency default buckets top out at 30 — useless for step counts)
+    COST_COUNT_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                          256.0, 512.0, 1024.0, 4096.0)
 
     def __init__(self, model, broker_port: int, batch_size: int = 8,
                  stream: str = INPUT_STREAM, result_key: str = RESULT_HASH,
@@ -415,6 +419,33 @@ class ClusterServing:
             "Decode scheduler steps deferred because a waiting encode "
             "lane outranked the live decode lanes on the weighted-"
             "deficit schedule", ("stream",)).labels(stream)
+        # per-request cost attribution (ISSUE 17): settled when a record's
+        # result flushes — an encode record is billed its share of the
+        # batch's device time; a generate record its accumulated share of
+        # every wide decode step it rode, plus steps and KV pages held —
+        # so each lane gets a measured unit cost
+        cost_dev = reg.histogram(
+            "zoo_request_cost_device_seconds",
+            "Device-seconds attributed to one record at settlement",
+            ("stream", "priority", "kind"))
+        cost_steps = reg.histogram(
+            "zoo_request_cost_decode_steps",
+            "Decode steps one generate record consumed",
+            ("stream", "priority", "kind"), buckets=self.COST_COUNT_BUCKETS)
+        cost_pages = reg.histogram(
+            "zoo_request_cost_kv_pages",
+            "KV cache pages one generate record held at retirement",
+            ("stream", "priority", "kind"), buckets=self.COST_COUNT_BUCKETS)
+        self._cost_device_hist = {
+            (lane, kind): cost_dev.labels(stream, lane, kind)
+            for lane in schema.PRIORITIES
+            for kind in ("encode", "generate")}
+        self._cost_steps_hist = {
+            lane: cost_steps.labels(stream, lane, "generate")
+            for lane in schema.PRIORITIES}
+        self._cost_pages_hist = {
+            lane: cost_pages.labels(stream, lane, "generate")
+            for lane in schema.PRIORITIES}
         # cross-thread-readable mirrors for /healthz and tests
         self.records_redelivered = 0
         self.lease_reclaims = 0
@@ -1040,13 +1071,17 @@ class ClusterServing:
                 draft_fn = (self._draft_model.decode_step_fn()
                             if hasattr(self._draft_model, "decode_step_fn")
                             else self._draft_model)
-            self._decode_sched = decode_scheduler.DecodeScheduler(
+            sched = decode_scheduler.DecodeScheduler(
                 self.model.decode_step_fn(),
                 max_batch=self.max_batch_size,
                 max_seq=(self._decode_max_seq
                          or generation.DEFAULT_SEQ_RUNGS[1]),
                 batch_ladder=self.ladder,
                 draft_fn=draft_fn, spec_k=self._spec_k)
+            # published under the state lock: /healthz's decode_state()
+            # reads the attribute from the HTTP thread
+            with self._state_lock:
+                self._decode_sched = sched
         return self._decode_sched
 
     def _admit_generate(self, client: BrokerClient, entries: List[tuple]):
@@ -1176,18 +1211,29 @@ class ClusterServing:
                     f"postprocess failed: {e}", self.cipher)
             cmds.append(("HSET", self.result_key, uri, val))
             acks.append(ack)
-            lanes_meta.append((m, lane))
+            lanes_meta.append((m, lane, uri, seq))
         if not acks and not cmds:
             return 0
         n = len(acks)
         with self._state_lock:
             self.records_out += n
         self._rec_counter.inc(n)
-        for m, lane in lanes_meta:
+        for m, lane, uri, seq in lanes_meta:
+            # trace-sampled sequences stamp their uri as the exemplar —
+            # the same id the scheduler recorded decode_step spans under
+            ex = uri if seq.trace_uri is not None else None
             if m is not None:
                 self._latency_hist.get(
                     lane, self._latency_hist[schema.DEFAULT_PRIORITY]
-                ).observe(max(0.0, t1 - m[0]))
+                ).observe(max(0.0, t1 - m[0]), exemplar=ex)
+            # cost settlement: the scheduler accumulated this sequence's
+            # share of every wide step it rode and its page high water
+            lane_key = lane if lane in self._cost_steps_hist \
+                else schema.DEFAULT_PRIORITY
+            self._cost_device_hist[(lane_key, "generate")].observe(
+                max(0.0, seq.device_s), exemplar=ex)
+            self._cost_steps_hist[lane_key].observe(seq.generated)
+            self._cost_pages_hist[lane_key].observe(seq.pages_held)
         client.pipeline(cmds + acks)
         self._mark_done(acks, self._conn_gen)
         return n
@@ -1320,12 +1366,21 @@ class ClusterServing:
         self._rec_counter.inc(n)
         # end-to-end latency per stamped record: client enqueue (mapped
         # onto this clock by _queue_wait) → results about to flush, on
-        # the record's own priority series
-        for m, lane in metas:
+        # the record's own priority series. Sampled batches stamp the
+        # record uri as the latency exemplar — the /trace link for this
+        # very observation. Cost settlement: each record is billed an
+        # equal share of the batch's device time.
+        dev_share = max(0.0, comp.inflight_s) / max(1, n)
+        for (m, lane), uri in zip(metas, uris):
+            ex = uri if trace is not None else None
             if m is not None:
                 self._latency_hist.get(
                     lane, self._latency_hist[schema.DEFAULT_PRIORITY]
-                ).observe(max(0.0, t_pp_end - m[0]))
+                ).observe(max(0.0, t_pp_end - m[0]), exemplar=ex)
+            self._cost_device_hist.get(
+                (lane, "encode"),
+                self._cost_device_hist[(schema.DEFAULT_PRIORITY, "encode")]
+            ).observe(dev_share, exemplar=ex)
         if trace is not None:
             self._record_batch_trace(uris, trace, comp, t0, t_pp_end,
                                      metas)
@@ -1537,6 +1592,10 @@ class ClusterServing:
         # replica leaves evidence of what its pipeline was doing
         from analytics_zoo_tpu.common import profiling
         profiling.maybe_arm_from_env()
+        # retain windowed metric history while serving (ISSUE 17): the
+        # background sampler feeds /metrics/history, /query and the SLO
+        # monitor's burn windows (idempotent; ZOO_TS_TICK_S=0 opts out)
+        timeseries.get_store().start()
         # supervise the backend only when failover can act on its verdicts
         # (or a fault drill wants to observe them) — plain deployments get
         # no extra thread
@@ -1604,6 +1663,23 @@ class ClusterServing:
             sup, self._supervisor = self._supervisor, None
         if sup is not None:
             sup.stop()
+
+    def decode_state(self) -> Dict:
+        """Decode occupancy at a glance — the /healthz ``decode`` block:
+        live sequences, page-pool pages in use/free, preemptions since
+        start. Counts are read without the serve thread's cooperation
+        (int/len reads of scheduler state — point-in-time, never exact
+        mid-step), which is the health endpoint's contract everywhere."""
+        sched = self._decode_sched
+        out = {"live_sequences": int(sched.live) if sched else 0,
+               "steps_run": int(sched.steps_run) if sched else 0,
+               "preemptions": int(self._preempt_counter.value),
+               "pages_in_use": 0, "pages_free": 0}
+        alloc = sched.allocator if sched else None
+        if alloc is not None:
+            out["pages_in_use"] = int(alloc.n_in_use)
+            out["pages_free"] = int(alloc.n_free)
+        return out
 
     def metrics(self) -> Dict:
         """Throughput + stage latencies (ref Flink numRecordsOutPerSecond +

@@ -38,6 +38,20 @@ enqueues to Redis and awaits the result). Endpoints:
   backlog ever looks scary.
 - ``GET  /slo``      → the SLO monitor's full report: per-objective,
   per-window burn rates, bad fractions, and the shed decision.
+- ``GET  /metrics/history`` → the retained time-series rings
+  (common/timeseries.py): every series' sampled points with age-relative
+  timestamps. ``?name=`` filters (repeatable), ``?window=`` bounds the
+  age. ``?format=windows`` renders windowed *snapshot-shaped deltas*
+  (default 60/300/3600 s, override ``?windows=60,300``) — the federation
+  wire format. ``?scope=fleet`` merges every live replica's windowed
+  history through the snapshot-merge algebra; a dead peer degrades the
+  response to partial (``partial: true``) without touching the retained
+  local windows.
+- ``GET  /query``    → one windowed aggregate:
+  ``?name=zoo_serving_latency_seconds&window=60&agg=p99`` (any other
+  query param is a label filter, e.g. ``&priority=batch``). Histogram
+  points carry an ``exemplar`` trace id when one landed in the window —
+  resolvable via ``GET /trace?uri=``.
 - ``GET  /``         → liveness
 
 stdlib ``ThreadingHTTPServer`` — no framework dependency; each request
@@ -53,7 +67,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from analytics_zoo_tpu.common import fleet, profiling, resilience, slo, \
-    telemetry
+    telemetry, timeseries
 from analytics_zoo_tpu.serving import schema
 from analytics_zoo_tpu.serving.broker import BrokerClient, ShedError
 from analytics_zoo_tpu.serving.client import (INPUT_STREAM, InputQueue,
@@ -99,6 +113,57 @@ def scrape_fleet(broker_host: str, broker_port: int,
                     timeout=timeout_s) as resp:
                 peer = json.loads(resp.read())
             merged = telemetry.MetricsRegistry.merge_snapshot(merged, peer)
+            scraped.append(r.replica_id)
+        except Exception:
+            errs.labels(r.replica_id).inc()
+            failed.append(r.replica_id)
+    return merged, {"scraped": scraped, "failed": failed,
+                    "stale": [r.replica_id for r in stale]}
+
+
+def scrape_fleet_history(broker_host: str, broker_port: int,
+                         own_replica_id: Optional[str] = None,
+                         windows=timeseries.DEFAULT_WINDOWS_S,
+                         timeout_s: float = FLEET_SCRAPE_TIMEOUT_S):
+    """Merge the local store's windowed deltas with every live replica's
+    ``/metrics/history?format=windows``. Window deltas are snapshot-
+    shaped, so each window folds through the SAME merge algebra as the
+    point-in-time fleet scrape — merged counter deltas over a window are
+    the fleet's windowed rate. A peer that cannot be scraped or merged
+    lands in ``failed`` (``zoo_fleet_scrape_errors_total{replica}``) and
+    the response degrades to partial; the local retained windows are
+    never mutated (merge copies)."""
+    import urllib.request
+    registry = fleet.ReplicaRegistry(broker_host, broker_port)
+    live, stale = registry.partition()
+    store = timeseries.get_store()
+    store.tick_if_stale()
+    merged = store.windows_delta(windows)
+    errs = telemetry.get_registry().counter(
+        "zoo_fleet_scrape_errors_total",
+        "Replica snapshot scrapes that failed during fleet federation",
+        ("replica",))
+    wparam = ",".join(str(int(w)) for w in windows)
+    scraped, failed = [], []
+    for r in live:
+        if own_replica_id is not None and r.replica_id == own_replica_id:
+            scraped.append(r.replica_id)   # self = the local windows
+            continue
+        try:
+            if r.port <= 0:
+                raise ValueError("replica advertises no scrape port")
+            with urllib.request.urlopen(
+                    f"http://{r.host}:{r.port}/metrics/history"
+                    f"?format=windows&windows={wparam}",
+                    timeout=timeout_s) as resp:
+                peer = json.loads(resp.read())["windows"]
+            # all-or-nothing per peer: a window that fails to merge
+            # discards this peer's whole contribution (failed scrape),
+            # never a half-merged aggregate
+            merged = {
+                wname: telemetry.MetricsRegistry.merge_snapshot(
+                    snap_w, peer.get(wname, {}))
+                for wname, snap_w in merged.items()}
             scraped.append(r.replica_id)
         except Exception:
             errs.labels(r.replica_id).inc()
@@ -178,6 +243,89 @@ class _Handler(BaseHTTPRequestHandler):
         self._json(200, {"scope": "fleet", "partial": bool(meta["failed"]),
                          "replicas": meta, "metrics": merged},
                    path="/metrics")
+
+    def _qs(self) -> dict:
+        from urllib.parse import parse_qs
+        if "?" not in self.path:
+            return {}
+        return parse_qs(self.path.split("?", 1)[1])
+
+    def _history(self):
+        q = self._qs()
+        windows = timeseries.DEFAULT_WINDOWS_S
+        if "windows" in q:
+            try:
+                windows = tuple(max(1.0, float(p))
+                                for p in q["windows"][0].split(",") if p)
+            except ValueError:
+                self._json(400, {"error": "bad windows= parameter"},
+                           path="/metrics/history")
+                return
+        if (q.get("scope") or [""])[0] == "fleet":
+            self._history_fleet(windows)
+            return
+        store = timeseries.get_store()
+        store.tick_if_stale()
+        if (q.get("format") or [""])[0] == "windows":
+            # the federation wire format: snapshot-shaped per-window
+            # deltas, mergeable via MetricsRegistry.merge_snapshot
+            self._json(200, {"windows": store.windows_delta(windows)},
+                       path="/metrics/history")
+            return
+        window = None
+        if "window" in q:
+            try:
+                window = float(q["window"][0])
+            except ValueError:
+                self._json(400, {"error": "bad window= parameter"},
+                           path="/metrics/history")
+                return
+        self._json(200, store.history(names=q.get("name") or None,
+                                      window=window),
+                   path="/metrics/history")
+
+    def _history_fleet(self, windows):
+        srv = self.server  # type: ignore[assignment]
+        own = srv.engine.replica_id if srv.engine else None
+        try:
+            merged, meta = scrape_fleet_history(
+                srv.broker_host, srv.broker_port, own_replica_id=own,
+                windows=windows)
+        except (ConnectionError, OSError) as e:
+            self._json(503, {"error": f"fleet registry unreachable: {e}"},
+                       path="/metrics/history")
+            return
+        self._json(200, {"scope": "fleet",
+                         "partial": bool(meta["failed"]),
+                         "replicas": meta, "windows": merged},
+                   path="/metrics/history")
+
+    #: /query params with reserved meaning — everything else filters labels
+    QUERY_RESERVED = frozenset({"name", "window", "agg", "scope", "format",
+                                "windows"})
+
+    def _query(self):
+        q = self._qs()
+        name = (q.get("name") or [None])[0]
+        if not name:
+            self._json(400, {"error": "query needs name="}, path="/query")
+            return
+        store = timeseries.get_store()
+        # a query window's right edge must include traffic up to the
+        # request itself, not the last background tick — force a sample
+        # (cheap: one registry walk)
+        store.tick()
+        try:
+            out = store.query(
+                name,
+                labels={k: v[0] for k, v in q.items()
+                        if k not in self.QUERY_RESERVED},
+                window=float((q.get("window") or ["60"])[0]),
+                agg=(q.get("agg") or [None])[0])
+        except ValueError as e:
+            self._json(400, {"error": str(e)}, path="/query")
+            return
+        self._json(200, out, path="/query")
 
     @staticmethod
     def _lane_state(client: BrokerClient, stream: str, engine) -> dict:
@@ -302,6 +450,14 @@ class _Handler(BaseHTTPRequestHandler):
         sup = resilience.supervisor_snapshot()
         if sup is not None:
             out["backend_supervisor"] = sup
+        # decode occupancy: live sequences, paged-KV pressure and the
+        # preemption count since start — capacity dashboards watch page
+        # exhaustion from the probe, not from a metrics scrape
+        if engine is not None and hasattr(engine, "decode_state"):
+            try:
+                out["decode"] = engine.decode_state()
+            except Exception:
+                pass
         if code == 200 and (failover
                             or out["backend"].get("status") == "wedged"
                             or (sup or {}).get("state")
@@ -327,6 +483,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._healthz()
         elif path == "/trace":
             self._trace()
+        elif path == "/metrics/history":
+            self._history()
+        elif path == "/query":
+            self._query()
         elif path == "/slo":
             mon = slo.get_monitor()
             mon.tick_if_stale()
@@ -462,6 +622,9 @@ class FrontEnd:
         # reach, leaking the thread past stop()
         if self._thread is not None:
             return self
+        # an engine-less frontend (metrics-only sidecar) still needs the
+        # history sampler ticking or /metrics/history serves empty rings
+        timeseries.get_store().start()
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()

@@ -1047,6 +1047,166 @@ def measure_serving_priority():
     }
 
 
+# history drill: flood sized so the batch lane stays visibly deep for
+# several sampler ticks (ramp -> sustain) before the drain empties it
+HIST_FLOOD, HIST_GEN, HIST_TICK_S = 96, 4, 0.05
+
+
+def measure_metric_history():
+    """Windowed-history drill (ISSUE 17): flood the batch lane behind a
+    live ``FrontEnd`` while the history store samples on a fast tick,
+    then read the whole episode back from ``/metrics/history`` — the
+    ``zoo_serving_lane_depth`` ring must show ramp -> sustain ->
+    recover (a zero point, a deep peak, and a zero tail), with a
+    mid-drill scrape proving the ramp is readable while the flood is
+    still draining. ``/query`` must answer the windowed serving p99
+    with >= 1 exemplar whose trace id resolves on ``/trace``; a short
+    generate tail on the same broker settles ``kind="generate"``
+    request costs so both cost kinds land in
+    ``zoo_request_cost_device_seconds`` within one drill."""
+    import urllib.request
+
+    import numpy as np
+    from analytics_zoo_tpu.common import telemetry, timeseries
+    from analytics_zoo_tpu.inference import InferenceModel
+    from analytics_zoo_tpu.models import Seq2Seq
+    from analytics_zoo_tpu.serving import (
+        Broker, ClusterServing, FrontEnd, InputQueue, OutputQueue,
+    )
+
+    def get_json(url):
+        with urllib.request.urlopen(url, timeout=10.0) as r:
+            return json.loads(r.read())
+
+    def batch_depths(hist):
+        return [p["value"] for s in hist["series"]
+                if s["name"] == "zoo_serving_lane_depth"
+                and s["labels"].get("priority") == "batch"
+                for p in s["points"]]
+
+    class SleepDuck:
+        def predict(self, x):
+            time.sleep(PRIO_SLEEP_MS / 1000.0)
+            return np.asarray(x) * 2.0
+
+    # fast sampler so the short drill spans many ticks; restored to the
+    # env-configured default store on the way out. The lane-depth gauges
+    # refresh on the engine's admission tick, so that cadence tightens
+    # too — at the default 1s the whole flood drains between refreshes
+    # and the ring would only ever sample an empty lane.
+    timeseries.set_store(timeseries.TimeSeriesStore(tick_s=HIST_TICK_S))
+    old_adm = os.environ.get("ZOO_SERVING_ADMISSION_S")
+    os.environ["ZOO_SERVING_ADMISSION_S"] = str(HIST_TICK_S)
+    rng = np.random.default_rng(31)
+    payloads = rng.standard_normal((HIST_FLOOD, 6)).astype(np.float32)
+    try:
+        with Broker.launch() as broker:
+            eng = ClusterServing(SleepDuck(), broker.port,
+                                 batch_size=MR_BATCH,
+                                 max_batch_size=MR_BATCH,
+                                 pipeline_window=2, block_ms=10,
+                                 warmup=False)
+            fe = FrontEnd(broker.port, engine=eng)
+            try:
+                with eng.start():
+                    fe.start()
+                    base = f"http://127.0.0.1:{fe.port}"
+                    in_q = InputQueue(port=broker.port)
+                    out_q = OutputQueue(port=broker.port)
+                    # pre-flood quiet phase: the sampler banks the
+                    # zero-depth points the ramp is judged against
+                    time.sleep(4 * HIST_TICK_S)
+                    t0 = time.perf_counter()
+                    flood = in_q.enqueue_batch(
+                        ((f"hb{i}", {"x": payloads[i]})
+                         for i in range(HIST_FLOOD)), priority="batch")
+                    time.sleep(6 * HIST_TICK_S)
+                    mid = get_json(base + "/metrics/history"
+                                   "?name=zoo_serving_lane_depth")
+                    mid_depth = batch_depths(mid)
+                    assert mid_depth and max(mid_depth) > 0, (
+                        "mid-drill history shows no batch-lane ramp")
+                    res = out_q.query_many(flood, timeout=90.0)
+                    dt = time.perf_counter() - t0
+                    missing = [u for u, v in res.items() if v is None]
+                    assert not missing, (
+                        f"{len(missing)} flood records unanswered")
+                    time.sleep(4 * HIST_TICK_S)   # recovery gets sampled
+                    hist = get_json(base + "/metrics/history"
+                                    "?name=zoo_serving_lane_depth")
+                    depth = batch_depths(hist)
+                    peak = max(depth)
+                    assert peak >= MR_BATCH, (
+                        f"lane-depth peak {peak} never sustained past one "
+                        f"batch in the history ring")
+                    assert depth[-1] == 0, (
+                        f"lane depth never recovered to 0 (tail "
+                        f"{depth[-3:]})")
+                    assert min(depth) == 0, "no zero-depth ramp point"
+                    q = get_json(base + "/query"
+                                 "?name=zoo_serving_latency_seconds"
+                                 "&window=60&agg=p99")
+                    vals = [p["value"] for p in q["points"]
+                            if p["value"] is not None]
+                    assert vals, "windowed p99 answered no points"
+                    exs = [p["exemplar"] for p in q["points"]
+                           if "exemplar" in p]
+                    assert exs, "no exemplar on the latency histogram"
+                    tr = get_json(base + "/trace?uri="
+                                  + exs[0]["trace_id"])
+                    assert tr.get("traceEvents"), (
+                        f"exemplar {exs[0]['trace_id']} did not resolve "
+                        f"on /trace")
+                # generate tail: a fresh decode-capable engine on the
+                # drained stream settles kind="generate" costs
+                m = Seq2Seq(input_dim=8, output_dim=8, hidden_size=16,
+                            rnn_type="gru", encoder_seq_len=8,
+                            decoder_seq_len=4)
+                im = InferenceModel().load_zoo(m)
+                gen_eng = ClusterServing(im, broker.port,
+                                         batch_size=MR_BATCH,
+                                         max_batch_size=MR_BATCH,
+                                         block_ms=10, warmup=False)
+                with gen_eng.start():
+                    enc = rng.standard_normal((8, 8)).astype(np.float32)
+                    start = np.zeros(8, np.float32)
+                    gen = InputQueue(port=broker.port).enqueue_batch(
+                        ((f"hg{i}", {"x": enc, "start": start})
+                         for i in range(HIST_GEN)),
+                        priority="batch",
+                        generate={"max_new_tokens": 8})
+                    gres = OutputQueue(port=broker.port).query_many(
+                        gen, timeout=120.0)
+                    gmiss = [u for u, v in gres.items() if v is None]
+                    assert not gmiss, (
+                        f"{len(gmiss)} generate records unanswered")
+            finally:
+                fe.stop()
+    finally:
+        timeseries.set_store(None)
+        if old_adm is None:
+            os.environ.pop("ZOO_SERVING_ADMISSION_S", None)
+        else:
+            os.environ["ZOO_SERVING_ADMISSION_S"] = old_adm
+    cost = telemetry.snapshot().get("zoo_request_cost_device_seconds", {})
+    kinds = set()
+    for key, v in (cost.items() if isinstance(cost, dict) else ()):
+        names, values = telemetry._parse_label_key(key)
+        if isinstance(v, dict) and v.get("count", 0) > 0:
+            kinds.add(dict(zip(names, values)).get("kind"))
+    assert {"encode", "generate"} <= kinds, (
+        f"request-cost histograms missing a kind: {sorted(kinds)}")
+    p99_ms = round(max(vals) * 1000.0, 2)
+    return {
+        "history_lane_depth_peak": peak,
+        "history_ring_points": len(depth),
+        "history_p99_60s_ms": p99_ms,
+        "history_exemplar_links": len(exs),
+        "history_records_per_sec":
+            round((HIST_FLOOD + HIST_GEN) / dt, 1),
+    }
+
+
 def measure_replica_kill_failover():
     """Replica-kill chaos drill (ISSUE 9 tentpole): SIGKILL one of two
     replicas mid-stream under a deterministic fault plan (no drain, no
@@ -1892,6 +2052,7 @@ def _smoke():
     global RECSYS_BATCH
     global DECODE_BATCH, DECODE_STEPS, DECODE_HIDDEN
     global MIXED_FLOOD, MIXED_INT, MIXED_STEPS
+    global HIST_FLOOD, HIST_GEN
     N_ROWS, BATCH = 2048, 256
     WARMUP_STEPS, MEASURE_STEPS, STEPS_PER_LOOP = 2, 4, 2
     SERVE_N, SERVE_BATCH, SERVE_HIDDEN = 64, 8, 32
@@ -1902,6 +2063,7 @@ def _smoke():
     RECSYS_BATCH = 128
     DECODE_BATCH, DECODE_STEPS, DECODE_HIDDEN = 4, 8, 16
     MIXED_FLOOD, MIXED_INT, MIXED_STEPS = 6, 6, 8
+    HIST_FLOOD, HIST_GEN = 48, 2
     out = {
         "metric": "ncf_train_samples_per_sec",
         "value": 0.0, "unit": "samples/s", "vs_baseline": 0.0,
@@ -1914,6 +2076,7 @@ def _smoke():
                                  measure_serving_multi_replica,
                                  measure_replica_kill_failover,
                                  measure_serving_priority,
+                                 measure_metric_history,
                                  measure_recsys_pipeline))
     if fr is not None:
         # armed smoke leaves the artifact the CI lane asserts on
@@ -1958,6 +2121,7 @@ def main():
               measure_decode_mixed,
               measure_serving_failover, measure_serving_multi_replica,
               measure_replica_kill_failover, measure_serving_priority,
+              measure_metric_history,
               measure_flash_attention,
               measure_int8_predict, measure_resnet50_train,
               measure_widedeep_train, measure_recsys_pipeline),

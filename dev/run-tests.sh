@@ -9,7 +9,7 @@
 #   dev/run-tests.sh smoke        # fast pre-push subset (<5 min, 1 core)
 #   Lanes: smoke core data keras models zouwu automl serving interop
 #          examples telemetry fleet resilience zoolint kernels chaos
-#          scheduling sharded decode
+#          scheduling sharded decode observability
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -420,6 +420,40 @@ print(f"decode OK: concurrent speedup "
       f"accept_ratio={dec['decode_spec_accept_ratio']}")
 print(f"mixed OK: interactive p99={p99}ms (budget {budget}ms) "
       f"preemptions={mix['decode_mixed_preemptions_total']}")
+PY
+            ;;
+  # metric history + cost attribution (ISSUE 17): the windowed store's
+  # quantile/rate algebra, exemplar->/trace links, fleet window merge,
+  # the end-to-end cost drill (slow-marked, runs here) — then the bench
+  # history drill scraping /metrics/history mid-flood. The seeded
+  # zoolint fixture must flag an undeclared zoo_ts_* name: a quiet
+  # drift check on the new families means the linter regressed.
+  observability) run tests/test_timeseries.py
+            echo "== zoolint: drift must flag undeclared history names"
+            drift="$(python -m analytics_zoo_tpu.analysis --no-baseline \
+                       tests/fixtures/zoolint 2>&1 || true)"
+            for name in zoo_ts_points_bogus ZOO_TS_BOGUS_TICK_S; do
+              if ! grep -q "$name" <<<"$drift"; then
+                echo "catalog drift missed the seeded $name violation" >&2
+                exit 1
+              fi
+            done
+            echo "== bench metric-history smoke (flood + mid-drill scrape)"
+            JAX_PLATFORMS=cpu python - <<'PY'
+import bench
+bench.HIST_FLOOD, bench.HIST_GEN = 48, 2
+# the measure itself asserts ramp -> sustain -> recover on the lane
+# depth ring, a mid-drill non-empty scrape, >= 1 exemplar resolving on
+# /trace, and encode+generate request-cost settlement
+h = bench.measure_metric_history()
+assert h["history_lane_depth_peak"] > 0, h
+assert h["history_ring_points"] >= 3, h
+assert h["history_exemplar_links"] >= 1, h
+assert h["history_records_per_sec"] > 0, h
+print(f"history OK: peak={h['history_lane_depth_peak']} "
+      f"points={h['history_ring_points']} "
+      f"p99(60s)={h['history_p99_60s_ms']}ms "
+      f"exemplars={h['history_exemplar_links']}")
 PY
             ;;
   release)  bash "$(dirname "$0")/release.sh" ;;

@@ -38,4 +38,11 @@ def register_bogus(registry):
                          "not in docs")  # VIOLATION metric-undocumented
     seq = os.getenv(
         "ZOO_SERVING_DECODE_BOGUS_SEQ")  # VIOLATION envvar-undocumented
-    return c, flag, g, knob, r, lease, d, wait, s, t, seq
+    # history-store families the catalog does NOT list: the drift check
+    # must flag new zoo_ts_* self-metrics and ZOO_TS_* knobs (the history
+    # store landed with its own catalog rows; an undeclared sibling must
+    # fire, not coast on the prefix)
+    h = registry.gauge("zoo_ts_points_bogus",
+                       "not in docs")  # VIOLATION metric-undocumented
+    tick = os.getenv("ZOO_TS_BOGUS_TICK_S")  # VIOLATION envvar-undocumented
+    return c, flag, g, knob, r, lease, d, wait, s, t, seq, h, tick
