@@ -85,9 +85,61 @@ def _m_spec_ratio():
         "means every draft token survived verification")
 
 
+def _m_paged_steps():
+    return telemetry.get_registry().counter(
+        "zoo_paged_attn_steps_total",
+        "Wide decode steps dispatched through the paged seam — the page "
+        "pool consumed on device via the scalar-prefetched page table "
+        "instead of a host-side gather")
+
+
+def _m_paged_fallback():
+    return telemetry.get_registry().counter(
+        "zoo_paged_attn_fallback_total",
+        "Wide decode steps that took the host gather_into fallback on a "
+        "paged-capable scheduler (paged off, no verdict yet, or the "
+        "autotune verdict favored gather)")
+
+
+def _m_zeros_skipped():
+    return telemetry.get_registry().counter(
+        "zoo_kv_page_zeros_skipped_total",
+        "Recycled-page memsets skipped because the paged kernel's length "
+        "masking makes stale positions unreadable")
+
+
+def _m_kv_requants():
+    return telemetry.get_registry().counter(
+        "zoo_kv_quant_requants_total",
+        "int8 KV page requantizations — a later append raised a page's "
+        "running amax, so its existing rows were rescaled to the grown "
+        "per-page scale")
+
+
+def _m_kv_pool_bytes():
+    return telemetry.get_registry().gauge(
+        "zoo_kv_quant_pool_bytes",
+        "Resident bytes of the shared KV page pool including per-page "
+        "scales — ZOO_KV_DTYPE=int8 shows up here as a ~4x drop at a "
+        "fixed page count")
+
+
 class PagePoolExhausted(RuntimeError):
     """The shared KV page pool cannot hold another sequence right now —
     admission should defer until a live sequence retires its pages."""
+
+
+def default_pool_pages(max_batch: int, max_seq: int, spec_k: int = 4,
+                       page_size: int = generation.DEFAULT_SEQ_RUNGS[0]
+                       ) -> int:
+    """Page count a scheduler's lazily-built allocator uses for this
+    config (``admit``'s ``for_grid`` sizing: worst case per sequence is
+    max_seq generated positions + the speculative draft window + one).
+    ``InferenceModel.warm_decode`` sizes the paged executables' pool aval
+    with it so the first live paged dispatch hits a warmed shape."""
+    positions = max(1, int(max_seq) + max(0, int(spec_k)) + 1)
+    per_seq = -(-positions // int(page_size))
+    return max(1, int(max_batch)) * per_seq
 
 
 class PagedKVAllocator:
@@ -100,32 +152,67 @@ class PagedKVAllocator:
     admission regardless of what lengths are still in flight — rung
     memory is shared, never per-batch.
 
+    Storage dtype (``kv_dtype``, default from ``ZOO_KV_DTYPE``) may be
+    ``int8``: pages then hold symmetric-quantized rows with one float32
+    scale per page stored alongside the pool (inference/quantize.py), a
+    4x byte drop per page — at a fixed pool byte budget that multiplies
+    the admissible concurrent-sequence count. ``dtype`` stays the
+    LOGICAL float dtype every reader sees (gathers dequantize).
+
     Not thread-safe: an allocator belongs to the one scheduler (and so
     the one driving thread) that created it.
     """
 
     def __init__(self, n_pages: int, page_size: int, dim: int,
-                 dtype=np.float32):
+                 dtype=np.float32, kv_dtype=None, lazy_zero: bool = False,
+                 sync_gauges: bool = True):
+        from analytics_zoo_tpu.inference import quantize
         if int(n_pages) < 1 or int(page_size) < 1:
             raise ValueError("need n_pages >= 1 and page_size >= 1")
         self.page_size = int(page_size)
         self.dim = int(dim)
         self.dtype = np.dtype(dtype)
+        self.kv_dtype = quantize.resolve_kv_dtype(kv_dtype)
+        self.quantized = self.kv_dtype == np.dtype(np.int8)
         self._pool = np.zeros((int(n_pages), self.page_size, self.dim),
-                              self.dtype)
+                              self.kv_dtype if self.quantized
+                              else self.dtype)
+        # per-page symmetric scale + the running |x|max it derives from;
+        # allocated (tiny) for float pools too so pool_view keeps one
+        # signature — x * 1.0 is bitwise x
+        self._scales = np.ones((int(n_pages),), np.float32)
+        self._amax = np.zeros((int(n_pages),), np.float32)
         self._free: List[int] = list(range(int(n_pages)))[::-1]
+        self.lazy_zero = bool(lazy_zero)
+        self.zeros_skipped = 0
+        self._gauges_on = bool(sync_gauges)
         self._sync_gauges()
 
     @classmethod
     def for_grid(cls, max_batch: int, max_positions: int, dim: int,
                  page_size: int = generation.DEFAULT_SEQ_RUNGS[0],
-                 dtype=np.float32) -> "PagedKVAllocator":
+                 dtype=np.float32, kv_dtype=None) -> "PagedKVAllocator":
         """Pool sized for ``max_batch`` concurrent sequences of up to
         ``max_positions`` each — the (batch rung × seq rung) grid's
         worst case, shared instead of per-batch."""
         per_seq = -(-max(1, int(max_positions)) // int(page_size))
         return cls(max(1, int(max_batch)) * per_seq, page_size, dim,
-                   dtype)
+                   dtype, kv_dtype=kv_dtype)
+
+    @classmethod
+    def for_pool_bytes(cls, budget_bytes: int, page_size: int, dim: int,
+                       dtype=np.float32, kv_dtype=None
+                       ) -> "PagedKVAllocator":
+        """Pool sized from a byte budget — the admission-capacity lever
+        int8 KV moves: at fixed bytes, int8 pages cost ~4x less than
+        float32, so the same budget admits ~4x the sequences."""
+        from analytics_zoo_tpu.inference import quantize
+        kv = quantize.resolve_kv_dtype(kv_dtype)
+        per_page = int(page_size) * int(dim) * kv.itemsize
+        if kv == np.dtype(np.int8):
+            per_page += 8            # per-page scale + running amax
+        n_pages = max(1, int(budget_bytes) // per_page)
+        return cls(n_pages, page_size, dim, dtype, kv_dtype=kv)
 
     # ------------------------------------------------------------ sizing
     @property
@@ -144,9 +231,27 @@ class PagedKVAllocator:
         """Pages needed to hold ``positions`` sequence positions."""
         return -(-max(0, int(positions)) // self.page_size)
 
+    @property
+    def page_nbytes(self) -> int:
+        """Bytes one page pins in the pool (row storage plus its per-page
+        scale/amax entries when quantized) — what
+        ``decode_kv_bytes_per_seq`` multiplies out."""
+        per = int(self._pool[0].nbytes)
+        if self.quantized:
+            per += int(self._scales.itemsize + self._amax.itemsize)
+        return per
+
+    @property
+    def pool_nbytes(self) -> int:
+        return int(self._pool.nbytes + self._scales.nbytes
+                   + self._amax.nbytes)
+
     def _sync_gauges(self):
+        if not self._gauges_on:
+            return
         _m_pages_in_use().set(self.n_in_use)
         _m_pages_free().set(self.n_free)
+        _m_kv_pool_bytes().set(self.pool_nbytes)
 
     def _grow(self, extra: int):
         """Extend the pool (a single request larger than the whole pool
@@ -156,7 +261,11 @@ class PagedKVAllocator:
         self._pool = np.concatenate(
             [self._pool,
              np.zeros((int(extra), self.page_size, self.dim),
-                      self.dtype)])
+                      self._pool.dtype)])
+        self._scales = np.concatenate(
+            [self._scales, np.ones((int(extra),), np.float32)])
+        self._amax = np.concatenate(
+            [self._amax, np.zeros((int(extra),), np.float32)])
         self._free.extend(range(base + int(extra) - 1, base - 1, -1))
         self._sync_gauges()
 
@@ -175,10 +284,23 @@ class PagedKVAllocator:
                 f"need {n} KV pages, {len(self._free)} free of "
                 f"{self.n_pages} — waiting for a sequence to retire")
         pages = [self._free.pop() for _ in range(n)]
-        # zero on alloc: a recycled page must not leak a previous
-        # sequence's positions into the causal zero tail
         for p in pages:
-            self._pool[p].fill(0.0)
+            # quant state always resets (O(1) per page): a recycled
+            # page's scale must not dequantize the new owner's rows
+            self._scales[p] = 1.0
+            self._amax[p] = 0.0
+        if self.lazy_zero:
+            # the paged kernel's length masking makes stale positions
+            # unreadable, so the recycle memset is pure overhead; the
+            # gather fallback stays safe too (gather_into copies only
+            # positions < length and the step buffer is pre-zeroed)
+            self.zeros_skipped += len(pages)
+            _m_zeros_skipped().inc(len(pages))
+        else:
+            # zero on alloc: a recycled page must not leak a previous
+            # sequence's positions into the causal zero tail
+            for p in pages:
+                self._pool[p].fill(0)
         self._sync_gauges()
         return pages
 
@@ -187,6 +309,55 @@ class PagedKVAllocator:
         admission."""
         self._free.extend(int(p) for p in pages)
         self._sync_gauges()
+
+    # -------------------------------------------------------- row access
+    def write_row(self, page: int, off: int, vec: np.ndarray) -> None:
+        """Write one position in place (the paged append seam). int8
+        pools quantize under the page's symmetric scale, growing it —
+        and requantizing the page's existing rows — when this row raises
+        the page's running |x|max."""
+        from analytics_zoo_tpu.inference import quantize
+        if not self.quantized:
+            self._pool[page, off, :] = vec
+            return
+        vec = np.asarray(vec, np.float32)
+        amax = float(np.max(np.abs(vec))) if vec.size else 0.0
+        if amax > self._amax[page]:
+            new_scale = quantize.page_scale(amax)
+            if self._amax[page] > 0.0:
+                self._pool[page] = quantize.requantize_rows(
+                    self._pool[page], self._scales[page], new_scale)
+                _m_kv_requants().inc()
+            self._scales[page] = new_scale
+            self._amax[page] = amax
+        self._pool[page, off, :] = quantize.quantize_rows(
+            vec, self._scales[page])
+
+    def read_row(self, page: int, off: int) -> np.ndarray:
+        """One position as the logical float dtype (dequantized)."""
+        from analytics_zoo_tpu.inference import quantize
+        if self.quantized:
+            return quantize.dequantize_rows(self._pool[page, off, :],
+                                            self._scales[page])
+        return self._pool[page, off, :].copy()
+
+    def read_page(self, page: int, upto: int) -> np.ndarray:
+        """The first ``upto`` rows of a page, dequantized — the SAME
+        ``q * scale`` expression the paged kernel fuses, so the gather
+        fallback is bitwise the kernel's view of the pool."""
+        from analytics_zoo_tpu.inference import quantize
+        rows = self._pool[page, :upto, :]
+        if self.quantized:
+            return quantize.dequantize_rows(rows, self._scales[page])
+        return rows
+
+    def pool_view(self):
+        """The device-facing view ``(pool, scales)`` — the same backing
+        arrays appends write in place, handed to the paged step whole
+        (one upload instead of a python loop of page copies). ``scales``
+        is all-ones for float pools so the paged seam keeps one
+        signature; ``x * 1.0`` is bitwise ``x``."""
+        return self._pool, self._scales
 
 
 class PagedKVCache:
@@ -226,7 +397,7 @@ class PagedKVCache:
             # pages escape into self._pages in the same expression)
             self._pages.extend(self._alloc.alloc_pages(1))
         p, off = self._slot(self.length)
-        self._alloc._pool[p, off, :] = vec
+        self._alloc.write_row(p, off, vec)
         self.length += 1
 
     def append_block(self, mat: np.ndarray) -> None:
@@ -236,36 +407,51 @@ class PagedKVCache:
 
     def set(self, pos: int, vec: np.ndarray) -> None:
         p, off = self._slot(pos)
-        self._alloc._pool[p, off, :] = vec
+        self._alloc.write_row(p, off, vec)
 
     def token_id(self, pos: int) -> int:
         p, off = self._slot(pos)
+        # argmax over raw storage is argmax over the dequantized row: the
+        # per-page scale is one positive scalar
         return int(np.argmax(self._alloc._pool[p, off, :]))
 
     def row(self, pos: int) -> np.ndarray:
         p, off = self._slot(pos)
-        return self._alloc._pool[p, off, :].copy()
+        return self._alloc.read_row(p, off)
 
     def truncate(self, n: int) -> None:
         """Drop positions ``>= n`` (rejected speculative drafts), zeroing
-        them so later gathers see the causal zero tail again."""
+        them so later gathers see the causal zero tail again (int8 zero
+        dequantizes to exact 0.0 under any scale)."""
         n = max(0, int(n))
         for pos in range(n, self.length):
             p, off = self._slot(pos)
-            self._alloc._pool[p, off, :] = 0.0
+            self._alloc._pool[p, off, :] = 0
         self.length = min(self.length, n)
 
     def gather_into(self, dst: np.ndarray) -> None:
         """Copy live positions into ``dst`` (``[rung, dim]``, pre-zeroed
-        by the caller)."""
+        by the caller), dequantizing int8 pages with the same per-page
+        expression the paged kernel fuses — the fallback and the kernel
+        see identical bits."""
         ps = self._alloc.page_size
         pos = 0
         for page in self._pages:
             if pos >= self.length:
                 break
             take = min(ps, self.length - pos)
-            dst[pos:pos + take, :] = self._alloc._pool[page, :take, :]
+            dst[pos:pos + take, :] = self._alloc.read_page(page, take)
             pos += take
+
+    def page_table(self, width: int) -> np.ndarray:
+        """This sequence's device-facing page-table row, padded to
+        ``width`` entries with page 0 — a real page the pipelined DMA may
+        prefetch, whose contents the kernel's length mask keeps out of
+        the result."""
+        table = np.zeros((int(width),), np.int32)
+        own = self._pages[:int(width)]
+        table[:len(own)] = own
+        return table
 
     def close(self) -> None:
         """Free every page back to the pool (idempotent)."""
@@ -367,8 +553,21 @@ class DecodeScheduler:
                  batch_ladder: Optional[compile_ahead.BucketLadder] = None,
                  allocator: Optional[PagedKVAllocator] = None,
                  draft_fn: Optional[Callable] = None, spec_k: int = 4,
-                 prefill_chunk: int = 32):
+                 prefill_chunk: int = 32,
+                 paged_step_fn: Optional[Callable] = None,
+                 paged: str = "auto"):
+        if paged not in ("auto", "force", "off"):
+            raise ValueError(
+                f"paged must be auto|force|off, got {paged!r}")
         self._step_fn = step_fn
+        # paged seam: ``(enc, pool, scales, table, lengths) ->
+        # [rung, width*page_size, dim]`` — the wide TARGET step consuming
+        # the page pool directly (InferenceModel.paged_decode_step_fn).
+        # "auto" dispatches it per shape when the autotune verdict wins
+        # (gather stays the numerics reference — never slower by
+        # construction); "force"/"off" pin the path for parity tests.
+        self._paged_step_fn = paged_step_fn
+        self._paged = paged
         self._draft_fn = draft_fn
         self.spec_k = max(0, int(spec_k))
         self.prefill_chunk = max(1, int(prefill_chunk))
@@ -419,9 +618,10 @@ class DecodeScheduler:
         if prefill.ndim != 2:
             raise ValueError("start must be [dim] or [prefill_len, dim]")
         if self._alloc is None:
-            self._alloc = PagedKVAllocator.for_grid(
-                self.max_batch, self.max_seq + self.spec_k + 1,
-                int(prefill.shape[-1]), page_size=self.page_size)
+            self._alloc = PagedKVAllocator(
+                default_pool_pages(self.max_batch, self.max_seq,
+                                   self.spec_k, self.page_size),
+                self.page_size, int(prefill.shape[-1]))
         # worst case: prefill + every generated position + a transient
         # speculative draft window past the live length
         need = self._alloc.pages_for(
@@ -500,8 +700,7 @@ class DecodeScheduler:
         ``[batch_rung, seq_rung, dim]`` step buffer the compile-ahead
         grid warmed — pad rows repeat the last sequence (pad_to_rung),
         their outputs are never read."""
-        rung = min(self._batch_ladder.rung_for(len(seqs)), self.max_batch)
-        rung = max(rung, len(seqs))
+        rung = self._batch_rung(len(seqs))
         enc = np.stack([s.enc for s in seqs])
         dec = np.zeros((len(seqs), seq_rung, self._alloc.dim),
                        self._alloc.dtype)
@@ -510,6 +709,143 @@ class DecodeScheduler:
         enc, dec = compile_ahead.pad_to_rung((enc, dec), rung,
                                              site="decode")
         return enc, dec
+
+    def _batch_rung(self, n: int) -> int:
+        rung = min(self._batch_ladder.rung_for(n), self.max_batch)
+        return max(rung, n)
+
+    def _use_paged_step(self, seqs: List[DecodeSequence],
+                        seq_rung: int) -> bool:
+        """Per-shape paged-vs-gather dispatch decision. ``force``/``off``
+        pin the path; ``auto`` consults the autotune verdict for the
+        step shape — a miss tunes on the spot in sync mode, else
+        enqueues a synthetic measurement for the warmup worker and takes
+        the gather reference this time (never-slower by construction)."""
+        if self._paged_step_fn is None or self._paged == "off":
+            return False
+        if self._paged == "force":
+            return True
+        from analytics_zoo_tpu.ops import autotune, paged_attention
+        if autotune._mode() == "off":
+            return False
+        rung = self._batch_rung(len(seqs))
+        enc_shape = tuple(seqs[0].enc.shape)
+        key = paged_attention.step_key(
+            rung, seq_rung, self.page_size, self._alloc.dim,
+            self._alloc.n_pages, self._alloc.kv_dtype, enc_shape)
+        rec = autotune.get_tuner().lookup(key, "paged_step")
+        if rec is None:
+            thunk = self._paged_tune_thunk(rung, seq_rung, enc_shape, key)
+            if autotune._mode() == "sync":
+                rec = thunk()
+            else:
+                autotune.enqueue_tune(key, thunk)
+                return False
+        return bool(rec.get("use_kernel"))
+
+    def _paged_tune_thunk(self, rung: int, seq_rung: int, enc_shape,
+                          key: str) -> Callable[[], dict]:
+        """Closure measuring one wide step via host gather vs via the
+        paged seam, end to end (``Autotuner.tune_thunks`` — host thunks,
+        because the gather fallback's cost is host-side python a jit
+        harness cannot see). Runs on SYNTHETIC state at the live shapes:
+        its own private allocator, never the serving pool."""
+        step_fn, paged_fn = self._step_fn, self._paged_step_fn
+        page_size, dim = self.page_size, self._alloc.dim
+        n_pages, kv_dtype = self._alloc.n_pages, self._alloc.kv_dtype
+
+        def thunk() -> dict:
+            from analytics_zoo_tpu.ops import autotune
+            rng = np.random.default_rng(0)
+            alloc = PagedKVAllocator(n_pages, page_size, dim,
+                                     kv_dtype=kv_dtype, sync_gauges=False)
+            width = alloc.pages_for(seq_rung)
+            fill = max(1, seq_rung - 1)
+            caches = []
+            for _ in range(rung):
+                cache = PagedKVCache(alloc, alloc.alloc_pages(width))
+                cache.append_block(
+                    rng.standard_normal((fill, dim)).astype(np.float32))
+                caches.append(cache)
+            enc = rng.standard_normal(
+                (rung,) + tuple(enc_shape)).astype(np.float32)
+            table = np.stack([c.page_table(width) for c in caches])
+            lengths = np.array([c.length for c in caches], np.int32)
+            pool, scales = alloc.pool_view()
+
+            def gather():
+                dec = np.zeros((rung, seq_rung, dim), np.float32)
+                for i, c in enumerate(caches):
+                    c.gather_into(dec[i])
+                return np.asarray(step_fn(enc, dec))
+
+            def paged():
+                return np.asarray(
+                    paged_fn(enc, pool, scales, table, lengths))
+
+            return autotune.get_tuner().tune_thunks(
+                "paged_step", key, {"paged": paged}, gather)
+
+        return thunk
+
+    def tune_paged(self, batch_rung: Optional[int] = None,
+                   seq_rung: Optional[int] = None,
+                   enc_shape=None) -> Optional[dict]:
+        """Synchronously measure gather-vs-paged for one step shape and
+        persist the verdict ``paged="auto"`` dispatch consults (what
+        bench.py and tests call; the serve path tunes in the background
+        instead). Shape arguments default to the live sequences'.
+        Returns None when no paged seam or allocator exists yet."""
+        if self._paged_step_fn is None or self._alloc is None:
+            return None
+        live = self._prefilling + self._decoding
+        if batch_rung is None:
+            batch_rung = self._batch_rung(max(1, len(live)))
+        if seq_rung is None:
+            want = max((s.cache.length + 1 for s in live), default=2)
+            seq_rung = self._seq_ladder.rung_for(want)
+        if enc_shape is None:
+            if not live:
+                raise ValueError(
+                    "enc_shape is required when no sequence is live")
+            enc_shape = tuple(live[0].enc.shape)
+        from analytics_zoo_tpu.ops import paged_attention
+        key = paged_attention.step_key(
+            int(batch_rung), int(seq_rung), self.page_size,
+            self._alloc.dim, self._alloc.n_pages, self._alloc.kv_dtype,
+            tuple(enc_shape))
+        return self._paged_tune_thunk(int(batch_rung), int(seq_rung),
+                                      tuple(enc_shape), key)()
+
+    def _paged_step(self, seqs: List[DecodeSequence],
+                    seq_rung: int) -> np.ndarray:
+        """The paged analog of ``_materialize`` + step: hand the step the
+        pool itself plus each sequence's page table and live length — the
+        gather happens on device, driven by the scalar-prefetched table.
+        Pad rows repeat the last sequence's table and length (the
+        pad_to_rung convention: their outputs are never read, and
+        repeating keeps the dispatch identical to the gather path's)."""
+        rung = self._batch_rung(len(seqs))
+        width = self._alloc.pages_for(seq_rung)
+        enc = np.stack([s.enc for s in seqs])
+        (enc,) = compile_ahead.pad_to_rung((enc,), rung, site="decode")
+        table = np.stack([s.cache.page_table(width) for s in seqs])
+        lengths = np.array([s.cache.length for s in seqs], np.int32)
+        if len(seqs) < rung:
+            pad = rung - len(seqs)
+            table = np.concatenate(
+                [table, np.repeat(table[-1:], pad, axis=0)])
+            lengths = np.concatenate(
+                [lengths, np.repeat(lengths[-1:], pad)])
+        pool, scales = self._alloc.pool_view()
+        out = np.asarray(
+            self._paged_step_fn(enc, pool, scales, table, lengths))
+        # kernel length masking is live from here on: recycled pages stop
+        # paying the memset (the gather fallback stays safe — it only
+        # ever copies positions < length into a pre-zeroed buffer)
+        self._alloc.lazy_zero = True
+        _m_paged_steps().inc()
+        return out
 
     def _step_group(self, seqs: List[DecodeSequence]
                     ) -> List[DecodeSequence]:
@@ -521,8 +857,16 @@ class DecodeScheduler:
             self._propose(spec)
         seq_rung = self._seq_ladder.rung_for(
             max(s.cache.length + 1 for s in seqs))
-        enc, dec = self._materialize(seqs, seq_rung)
-        out = np.asarray(self._step_fn(enc, dec))
+        if self._use_paged_step(seqs, seq_rung):
+            # the wide TARGET step goes paged; outputs agree bitwise with
+            # the gather path because the on-device gather materializes
+            # the identical (dequantized, causally zero-tailed) buffer
+            out = self._paged_step(seqs, seq_rung)
+        else:
+            enc, dec = self._materialize(seqs, seq_rung)
+            out = np.asarray(self._step_fn(enc, dec))
+            if self._paged_step_fn is not None and self._paged != "off":
+                _m_paged_fallback().inc()
         finished = []
         for i, s in enumerate(seqs):
             before = s.generated

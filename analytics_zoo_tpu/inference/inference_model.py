@@ -43,6 +43,27 @@ def _as_tuple(x):
     return tuple(x) if isinstance(x, (list, tuple)) else (x,)
 
 
+def _warm_many_async(todo):
+    """Daemon thread warming ``(cache, avals)`` pairs — warm_decode's
+    grid may span the plain and the paged executable caches. Smallest
+    first, same as ``ExecutableCache.warm_async``; a failed build must
+    not kill the thread (the shape just compiles in-band later)."""
+    def size(item):
+        _, avals = item
+        return int(np.prod(avals[1].shape)) if len(avals) > 1 else 0
+
+    def work():
+        for cache, avals in sorted(todo, key=size):
+            try:
+                cache.warm(*avals)
+            except Exception:
+                pass
+
+    t = threading.Thread(target=work, name="zoo-warm-decode", daemon=True)
+    t.start()
+    return t
+
+
 class InferenceModel:
     """Thread-safe inference holder with a jitted-executable cache."""
 
@@ -68,6 +89,10 @@ class InferenceModel:
         # set by shard(): the mesh executable the dispatch seam rides —
         # params partitioned per strategy, avals carrying shardings
         self._sharded = None
+        # paged decode seam (built lazily by paged_decode_step_fn): the
+        # forward with ops/paged_attention.paged_gather fused under it
+        self._paged_jitted = None
+        self._paged_cache: Optional[compile_ahead.ExecutableCache] = None
 
     # ------------------------------------------------------------- loaders
     def load_zoo(self, model) -> "InferenceModel":
@@ -261,6 +286,9 @@ class InferenceModel:
             # a re-install also invalidates any mesh layout: the new
             # forward must be re-sharded explicitly
             self._sharded = None
+            # and the paged decode seam: it closes over the old forward
+            self._paged_jitted = None
+            self._paged_cache = None
 
     def shard(self, strategy, param_rules=None, mesh=None,
               devices=None) -> "InferenceModel":
@@ -434,7 +462,8 @@ class InferenceModel:
 
     # ------------------------------------------------------------ generate
     def warm_decode(self, max_seq_len: int, rungs=None, seq_rungs=None,
-                    block: bool = False, verify_k: int = 0):
+                    block: bool = False, verify_k: int = 0,
+                    paged_pool=None):
         """AOT-compile the decode grid: every (batch rung × seq-length
         rung) shape a ``generate`` up to ``max_seq_len`` can present, so
         the decode loop never recompiles — the KV cache's rung growth is
@@ -444,7 +473,11 @@ class InferenceModel:
         speculative k-wide verify step (live length + k drafts + bonus)
         lands on a warmed rung too; chunked prefill needs no extra shapes
         — prefill positions fill the same rung buffers the decode steps
-        run. Returns the warmup thread (None when nothing to do)."""
+        run. ``paged_pool=(n_pages, page_size)`` additionally warms the
+        PAGED step executables on the same grid (pool dtype from
+        ``ZOO_KV_DTYPE``), so the scheduler's first paged dispatch hits a
+        built shape. Returns the warmup thread (None when nothing to
+        do)."""
         from analytics_zoo_tpu.inference import generation
 
         with self._lock:
@@ -457,22 +490,61 @@ class InferenceModel:
                 int(max_seq_len) + max(0, int(verify_k))).rungs
         if rungs is None:
             rungs = ladder.rungs if ladder is not None else ()
-        todo = [avals for avals in compile_ahead.decode_grid_specs(
+        todo = [(cache, avals)
+                for avals in compile_ahead.decode_grid_specs(
                     spec, rungs, seq_rungs,
                     lambda dspec, rung: self._aot_avals(
                         params, dspec, rung))
                 if not cache.ready(*avals)]
+        if paged_pool is not None:
+            for pcache, avals in self._paged_decode_avals(
+                    paged_pool, spec, params, rungs, seq_rungs):
+                todo.append((pcache, avals))
         if not todo:
             return None
         if block:
-            for avals in todo:
-                cache.warm(*avals)
+            for c, avals in todo:
+                c.warm(*avals)
             return None
-        t = cache.warm_async(todo)
+        t = _warm_many_async(todo)
         with self._lock:
             self._warm_threads = [w for w in self._warm_threads
                                   if w.is_alive()] + [t]
         return t
+
+    def _paged_decode_avals(self, paged_pool, spec, params, rungs,
+                            seq_rungs):
+        """Yield (cache, avals) for every unbuilt PAGED step executable
+        on the (batch rung × seq rung) grid. The paged seam materializes
+        the decoder at ``width * page_size`` positions, so distinct seq
+        rungs sharing a page width share one executable."""
+        import jax
+        from analytics_zoo_tpu.inference import quantize
+
+        n_pages, page_size = (int(v) for v in paged_pool)
+        self._ensure_paged()
+        with self._lock:
+            pcache = self._paged_cache
+        if pcache is None:
+            return
+        kv_dtype = quantize.resolve_kv_dtype(None)
+        dim = int(spec[-1][0][-1])
+        pool_aval = jax.ShapeDtypeStruct((n_pages, page_size, dim),
+                                         kv_dtype)
+        scales_aval = jax.ShapeDtypeStruct((n_pages,), np.float32)
+        seen = set()
+        for rung in sorted({int(r) for r in rungs}):
+            for sr in sorted({int(s) for s in seq_rungs}):
+                width = -(-sr // page_size)
+                if (rung, width) in seen:
+                    continue
+                seen.add((rung, width))
+                avals = self._aot_avals(params, spec[:1], rung) + (
+                    pool_aval, scales_aval,
+                    jax.ShapeDtypeStruct((rung, width), np.int32),
+                    jax.ShapeDtypeStruct((rung,), np.int32))
+                if not pcache.ready(*avals):
+                    yield pcache, avals
 
     def decode_step_fn(self):
         """The scheduler-facing step seam: one wide ``(enc, dec) -> out``
@@ -491,6 +563,73 @@ class InferenceModel:
         def step(enc, dec):
             return np.asarray(self.predict_fetch(
                 self.predict_async((enc, dec))))
+
+        return step
+
+    def _ensure_paged(self):
+        """Build the paged decode dispatch seam once per installed
+        forward: ``paged_apply(state, enc, pool, scales, table, lengths)``
+        runs ``ops/paged_attention.paged_gather`` INSIDE the jitted step
+        — the per-page host copy of ``gather_into`` becomes an on-device
+        gather driven by the scalar-prefetched page table — then feeds
+        the gathered buffer to the original forward. Because that buffer
+        is bitwise the host-gathered one, outputs match the plain seam
+        bit for bit."""
+        with self._lock:
+            if self._paged_cache is not None:
+                return
+            orig_apply = self._apply
+
+        def paged_apply(state, enc, pool, scales, table, lengths):
+            from analytics_zoo_tpu.ops import paged_attention
+            # pinned dispatch, decision by verdict lookup only: this
+            # traces under whoever owns the jit (serve loop / warmup
+            # thread, possibly holding the model lock), so the path must
+            # never reach a tuner measurement
+            dec = paged_attention.paged_gather_pinned(
+                pool, table, lengths, scales=scales,
+                use_kernel=paged_attention.gather_decision(pool, table))
+            return orig_apply(state, enc, dec)
+
+        jitted = telemetry.instrument_jit(
+            paged_apply, name="inference_model_paged")
+        cache = compile_ahead.ExecutableCache(
+            jitted, name="inference_model_paged")
+        with self._lock:
+            if self._paged_cache is None and self._apply is orig_apply:
+                self._paged_jitted = jitted
+                self._paged_cache = cache
+
+    def paged_decode_step_fn(self):
+        """Paged counterpart of :meth:`decode_step_fn`: one wide
+        ``(enc, pool, scales, table, lengths) -> out`` dispatch where the
+        per-sequence page gather runs inside the jitted forward. The
+        decoder buffer materializes at ``table_width * page_size``
+        positions — the seq rung rounded up to a page multiple — which is
+        output-invisible for live positions (the causal rung-padding
+        parity generation.py pins). int8 pools ship with their per-page
+        scales; float pools pass all-ones (``x * 1.0`` is bitwise
+        ``x``)."""
+        with self._lock:
+            if self._apply is None:
+                raise RuntimeError(
+                    "load a model before paged_decode_step_fn")
+            if self._n_inputs != 2:
+                raise ValueError(
+                    "decode needs a 2-input (encoder, decoder) model, "
+                    f"got {self._n_inputs} inputs")
+        self._ensure_paged()
+
+        def step(enc, pool, scales, table, lengths):
+            self._ensure_paged()   # rebuilt lazily after a re-install
+            with self._lock:
+                params, cache = self._params, self._paged_cache
+            pending = cache(params, np.asarray(enc),
+                            np.ascontiguousarray(pool),
+                            np.asarray(scales, np.float32),
+                            np.asarray(table, np.int32),
+                            np.asarray(lengths, np.int32))
+            return np.asarray(telemetry.traced_device_get(pending))
 
         return step
 

@@ -13,6 +13,7 @@ weight-only is the accuracy-safe default for the model-zoo scale.
 
 from __future__ import annotations
 
+import os
 from typing import Any, NamedTuple
 
 import numpy as np
@@ -282,6 +283,62 @@ def int8_interceptor(act_amax: dict, qparams=None):
         return y.astype(x.dtype) if x.dtype != y.dtype else y
 
     return interceptor
+
+
+# ---------------------------------------------------------------------------
+# Paged-KV int8 storage: per-PAGE symmetric scales for the decode page pool
+# (`ZOO_KV_DTYPE=int8`). One float32 scale per page sits alongside the pool;
+# the paged kernel fuses the dequantize multiply into its inner loop and the
+# host gather fallback uses the *same expression* so both paths see identical
+# bits. Storage drops 4x per page vs float32 — at a fixed pool byte budget
+# that multiplies the admissible concurrent-sequence count.
+# ---------------------------------------------------------------------------
+
+KV_DTYPES = ("float32", "int8")
+
+
+def resolve_kv_dtype(kv_dtype=None) -> np.dtype:
+    """Storage dtype for the decode KV page pool: the explicit argument
+    when given, else the ``ZOO_KV_DTYPE`` env knob (``float32`` default;
+    ``int8`` stores pages quantized under per-page symmetric scales)."""
+    if kv_dtype is None:
+        kv_dtype = os.environ.get("ZOO_KV_DTYPE", "").strip().lower() \
+            or "float32"
+    if isinstance(kv_dtype, str):
+        kv_dtype = {"fp32": "float32", "f32": "float32"}.get(
+            kv_dtype, kv_dtype)
+    dt = np.dtype(kv_dtype)
+    if dt not in (np.dtype(np.float32), np.dtype(np.int8)):
+        raise ValueError(
+            f"ZOO_KV_DTYPE must be one of {KV_DTYPES}, got {kv_dtype!r}")
+    return dt
+
+
+def page_scale(amax: float) -> np.float32:
+    """Symmetric per-page scale for a page whose running max |x| is
+    ``amax`` (zero-amax pages get scale 1.0 so all-zero pages stay exact)."""
+    return np.float32(amax / 127.0) if amax > 0.0 else np.float32(1.0)
+
+
+def quantize_rows(rows, scale) -> np.ndarray:
+    """Float rows → int8 under one shared (per-page) scale."""
+    return np.clip(np.round(np.asarray(rows, np.float32)
+                            / np.float32(scale)),
+                   -127, 127).astype(np.int8)
+
+
+def dequantize_rows(q, scale) -> np.ndarray:
+    """int8 rows → float32 as ``q * scale`` — the exact expression the
+    paged kernel fuses into its inner loop, so the host gather fallback
+    and the kernel dequant are bitwise identical."""
+    return np.asarray(q).astype(np.float32) * np.float32(scale)
+
+
+def requantize_rows(q, old_scale, new_scale) -> np.ndarray:
+    """Rescale already-quantized rows after a later append raised the
+    page's amax (so its scale grew). The round-trip costs at most half an
+    ulp of the FINAL scale — bounded by the page's eventual amax/254."""
+    return quantize_rows(dequantize_rows(q, old_scale), new_scale)
 
 
 def int8_apply(apply_fn, act_amax: dict):

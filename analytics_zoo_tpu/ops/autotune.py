@@ -44,6 +44,7 @@ from typing import Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 DEFAULT_CACHE_PATH = os.path.join("zoo_tpu_logs", "autotune.json")
 
@@ -203,6 +204,45 @@ class Autotuner:
                 times[name] = self._time_candidate(fn, args, iters, chain)
             except Exception as e:
                 errors[name] = repr(e)[:160]
+        return self._finish(kernel, key, ref_s, times, errors, iters)
+
+    def tune_thunks(self, kernel: str, key: str,
+                    candidates: Dict[str, Callable[[], object]],
+                    reference: Callable[[], object],
+                    iters: Optional[int] = None) -> dict:
+        """Host-level sibling of :meth:`tune` for seams whose fallback
+        includes host-side work the jit harness cannot see — the decode
+        scheduler's per-step page gather is the motivating case (a python
+        loop of pool copies feeding a device dispatch). Candidates and
+        reference are NULLARY thunks that each run one complete step end
+        to end and return a host array; the host materialization is the
+        fence, so the measured time covers copies, python loops and
+        device dispatch alike. Verdict shape, persistence and metrics
+        match ``tune``."""
+        iters = iters or _iters()
+
+        def timed(fn) -> float:
+            fn()                            # first-touch outside the clock
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(iters):
+                out = fn()
+            np.asarray(out)
+            return (time.perf_counter() - t0) / iters
+
+        ref_s = timed(reference)
+        times: Dict[str, float] = {}
+        errors: Dict[str, str] = {}
+        for name, fn in candidates.items():
+            try:
+                times[name] = timed(fn)
+            except Exception as e:
+                errors[name] = repr(e)[:160]
+        return self._finish(kernel, key, ref_s, times, errors, iters)
+
+    def _finish(self, kernel: str, key: str, ref_s: float,
+                times: Dict[str, float], errors: Dict[str, str],
+                iters: int) -> dict:
         best = min(times, key=times.get) if times else None
         best_s = times[best] if best else float("inf")
         rec = {

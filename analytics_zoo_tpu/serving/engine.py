@@ -999,12 +999,23 @@ class ClusterServing:
         fn = getattr(self.model, "warm_decode", None)
         if fn is None:
             return
+        kw = {}
+        if hasattr(self.model, "paged_decode_step_fn"):
+            # warm the paged step executables on the same grid, sized the
+            # way the scheduler's lazily-built allocator will size the
+            # pool — the first live paged dispatch then hits a built shape
+            kw["paged_pool"] = (
+                decode_scheduler.default_pool_pages(
+                    self.max_batch_size,
+                    self._decode_max_seq or generation.DEFAULT_SEQ_RUNGS[1],
+                    spec_k=self._spec_k),
+                generation.DEFAULT_SEQ_RUNGS[0])
         try:
             # a configured draft model means verify steps run k positions
             # past the live length — warm those taller rungs too
             fn(self._decode_max_seq, rungs=list(self._warm_rungs),
                verify_k=(self._spec_k if self._draft_model is not None
-                         else 0))
+                         else 0), **kw)
         except TypeError:
             fn(self._decode_max_seq, rungs=list(self._warm_rungs))
         except Exception:
@@ -1071,13 +1082,22 @@ class ClusterServing:
                 draft_fn = (self._draft_model.decode_step_fn()
                             if hasattr(self._draft_model, "decode_step_fn")
                             else self._draft_model)
+            paged_fn = None
+            make_paged = getattr(self.model, "paged_decode_step_fn", None)
+            if make_paged is not None:
+                try:
+                    paged_fn = make_paged()
+                except Exception:
+                    logger.debug("paged decode seam unavailable",
+                                 exc_info=True)
             sched = decode_scheduler.DecodeScheduler(
                 self.model.decode_step_fn(),
                 max_batch=self.max_batch_size,
                 max_seq=(self._decode_max_seq
                          or generation.DEFAULT_SEQ_RUNGS[1]),
                 batch_ladder=self.ladder,
-                draft_fn=draft_fn, spec_k=self._spec_k)
+                draft_fn=draft_fn, spec_k=self._spec_k,
+                paged_step_fn=paged_fn)
             # published under the state lock: /healthz's decode_state()
             # reads the attribute from the HTTP thread
             with self._state_lock:

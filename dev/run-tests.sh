@@ -163,7 +163,8 @@ PY
   # cross-check must know the zoo_autotune_* metrics)
   kernels)  lint_zoolint
             run -m "not slow" tests/test_autotune.py \
-                tests/test_embedding_bag.py tests/test_attention.py
+                tests/test_embedding_bag.py tests/test_attention.py \
+                tests/test_paged_attention.py
             echo "== autotune never-slower smoke"
             JAX_PLATFORMS=cpu ZOO_PALLAS_INTERPRET=1 python - <<'PY'
 import os, tempfile
@@ -398,6 +399,21 @@ PY
               echo "zoolint missed a seeded kv page leak" >&2
               exit 1
             fi
+            # the paged-table fixture holds exactly ONE leak (the guard
+            # raise) — its clean twin must stay silent
+            if [ "$(grep "kv-page-leak" <<<"$drift" | \
+                    grep -c "serving/bad_paged_table_leak.py")" -ne 1 ]; then
+              echo "zoolint missed the seeded paged-table leak" >&2
+              exit 1
+            fi
+            echo "== zoolint: drift must flag undeclared paged/kv names"
+            for name in zoo_paged_attn_bogus_total zoo_kv_quant_bogus_bytes \
+                        ZOO_KV_BOGUS_DTYPE; do
+              if ! grep -q "$name" <<<"$drift"; then
+                echo "catalog drift missed the seeded $name violation" >&2
+                exit 1
+              fi
+            done
             echo "== bench decode smoke (continuous batching + spec + mixed)"
             JAX_PLATFORMS=cpu python - <<'PY'
 import bench
@@ -411,13 +427,20 @@ dec = bench.measure_decode()
 assert dec["decode_concurrent_speedup"] >= 1.0, dec
 assert dec["decode_spec_accept_ratio"] == 1.0, dec
 assert dec["decode_post_warmup_recompiles"] == 0, dec
+# the paged-attention verdict is never-slower by construction (a losing
+# measurement dispatches the gather fallback and reports 1.0), and the
+# paged run's outputs are asserted bitwise against plain decode inside
+assert dec["decode_paged_attn_speedup"] >= 1.0, dec
+assert dec["decode_kv_bytes_per_seq"] > 0, dec
 mix = bench.measure_decode_mixed()
 p99, budget = (mix["decode_mixed_interactive_p99_ms"],
                mix["decode_mixed_interactive_budget_ms"])
 assert 0 <= p99 <= budget, mix
 print(f"decode OK: concurrent speedup "
       f"{dec['decode_concurrent_speedup']}x "
-      f"accept_ratio={dec['decode_spec_accept_ratio']}")
+      f"accept_ratio={dec['decode_spec_accept_ratio']} "
+      f"paged={dec['decode_paged_attn_speedup']}x "
+      f"kv_bytes/seq={dec['decode_kv_bytes_per_seq']}")
 print(f"mixed OK: interactive p99={p99}ms (budget {budget}ms) "
       f"preemptions={mix['decode_mixed_preemptions_total']}")
 PY

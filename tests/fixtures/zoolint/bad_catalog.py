@@ -45,4 +45,14 @@ def register_bogus(registry):
     h = registry.gauge("zoo_ts_points_bogus",
                        "not in docs")  # VIOLATION metric-undocumented
     tick = os.getenv("ZOO_TS_BOGUS_TICK_S")  # VIOLATION envvar-undocumented
-    return c, flag, g, knob, r, lease, d, wait, s, t, seq, h, tick
+    # paged-attention / KV-quantization families the catalog does NOT
+    # list: the drift check must flag new zoo_paged_attn_* / zoo_kv_quant_*
+    # names and ZOO_KV_* knobs (the paged decode kernel + int8 pool landed
+    # with their own rows; undeclared siblings must fire, not coast on the
+    # prefix)
+    p = registry.counter("zoo_paged_attn_bogus_total",
+                         "not in docs")  # VIOLATION metric-undocumented
+    q = registry.gauge("zoo_kv_quant_bogus_bytes",
+                       "not in docs")  # VIOLATION metric-undocumented
+    kvd = os.getenv("ZOO_KV_BOGUS_DTYPE")  # VIOLATION envvar-undocumented
+    return c, flag, g, knob, r, lease, d, wait, s, t, seq, h, tick, p, q, kvd

@@ -83,6 +83,39 @@ def test_tune_records_candidate_errors(tuner_env):
     assert rec2["best_ms"] is None
 
 
+# ------------------------------------------------------- host-thunk timing
+
+def test_tune_thunks_times_host_callables(tuner_env):
+    """tune_thunks measures nullary HOST thunks (the paged-step decision:
+    the gather fallback's cost is host-side python a jit harness cannot
+    see) with the same verdict contract as tune()."""
+    import time
+
+    def slow():
+        time.sleep(0.005)
+        return np.zeros(4)
+
+    rec = autotune.get_tuner().tune_thunks(
+        "paged_step", "step|fast", {"paged": lambda: np.zeros(4)}, slow,
+        iters=2)
+    assert rec["best"] == "paged" and rec["use_kernel"]
+    assert rec["speedup"] > 1.0
+    assert autotune.get_tuner().lookup("step|fast") == rec
+
+    rec2 = autotune.get_tuner().tune_thunks(
+        "paged_step", "step|slow", {"paged": slow},
+        lambda: np.zeros(4), iters=2)
+    assert rec2["use_kernel"] is False           # never-selects-slower
+
+    def boom():
+        raise RuntimeError("thunk exploded")
+
+    rec3 = autotune.get_tuner().tune_thunks(
+        "paged_step", "step|err", {"paged": boom}, lambda: np.zeros(4),
+        iters=2)
+    assert "paged" in rec3["errors"] and rec3["use_kernel"] is False
+
+
 # ------------------------------------------------------------ persistence
 
 def test_verdict_persists_across_tuner_instances(tuner_env):

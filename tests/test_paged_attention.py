@@ -1,0 +1,252 @@
+"""Paged decode kernel tests (ops/paged_attention.py): BITWISE
+gather-vs-reference parity (the pallas kernel and the pure-jax take
+dequantize with the same expression and zero the same causal tail, so
+equality is exact), stale-garbage masking on recycled pages, the
+online-softmax attention kernel against a dense softmax reference
+(page-table indexing, page-boundary / mid-page / zero lengths, int8
+per-page dequant), and the autotune verdict dispatch.
+
+Kernel paths run on the CPU pallas interpreter via ZOO_PALLAS_INTERPRET;
+``use_kernel=True/False`` pins dispatch except in the dispatch tests.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.ops import autotune
+from analytics_zoo_tpu.ops import paged_attention as pa
+
+N_PAGES, PS, DIM = 7, 4, 8
+
+
+@pytest.fixture(autouse=True)
+def _interp(monkeypatch, tmp_path):
+    monkeypatch.setenv("ZOO_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("ZOO_AUTOTUNE_CACHE",
+                       str(tmp_path / "autotune.json"))
+    monkeypatch.setenv("ZOO_AUTOTUNE_ITERS", "2")
+    autotune.reset_tuner()
+    yield
+    autotune.reset_tuner()
+    autotune._pending.clear()
+
+
+def _pool(dtype="float32", seed=0):
+    rng = np.random.default_rng(seed)
+    if dtype == "int8":
+        pool = rng.integers(-127, 128, (N_PAGES, PS, DIM)).astype(np.int8)
+        scales = rng.uniform(0.005, 0.05, N_PAGES).astype(np.float32)
+    else:
+        pool = rng.standard_normal((N_PAGES, PS, DIM)).astype(np.float32)
+        scales = np.ones(N_PAGES, np.float32)
+    return pool, scales
+
+
+def _host_gather(pool, table, lengths, scales):
+    """Numpy host loop — the gather_into semantics the kernel replaces."""
+    b, w = table.shape
+    out = np.zeros((b, w * PS, DIM), np.float32)
+    for i in range(b):
+        for j in range(w):
+            rows = pool[table[i, j]].astype(np.float32)
+            if pool.dtype == np.int8:
+                rows = rows * np.float32(scales[table[i, j]])
+            out[i, j * PS:(j + 1) * PS] = rows
+        out[i, lengths[i]:] = 0.0
+    return out
+
+
+TABLE = np.array([[3, 1], [0, 6], [5, 5]], np.int32)   # dup page reused
+LENGTHS = np.array([8, 5, 0], np.int32)                # full / mid / empty
+
+
+# --------------------------------------------------------- paged gather
+
+@pytest.mark.parametrize("dtype", ["float32", "int8"])
+def test_gather_ref_matches_host_loop_bitwise(dtype):
+    pool, scales = _pool(dtype)
+    got = pa.paged_gather_ref(pool, TABLE, LENGTHS, scales=scales)
+    np.testing.assert_array_equal(
+        np.asarray(got), _host_gather(pool, TABLE, LENGTHS, scales))
+
+
+@pytest.mark.parametrize("dtype", ["float32", "int8"])
+def test_gather_kernel_matches_ref_bitwise(dtype):
+    pool, scales = _pool(dtype)
+    got = pa.paged_gather(pool, TABLE, LENGTHS, scales=scales,
+                          use_kernel=True)
+    want = pa.paged_gather_ref(pool, TABLE, LENGTHS, scales=scales)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("use_kernel", [True, False])
+def test_gather_masks_stale_garbage(use_kernel):
+    """The length mask IS the recycle hygiene: a page full of stale rows
+    from a retired sequence reads back as exact zeros past the live
+    length, so alloc never needs to memset it."""
+    pool, scales = _pool()
+    pool[6] = 1e30                               # recycled, never zeroed
+    pool[2, 3] = 1e30                            # stale tail of a live page
+    table = np.array([[2, 6]], np.int32)         # stale page in-table
+    out = np.asarray(pa.paged_gather(pool, table, np.array([3], np.int32),
+                                     scales=scales, use_kernel=use_kernel))
+    assert np.array_equal(out[0, :3], pool[2, :3])
+    assert not out[0, 3:].any()                  # exact zeros, not tiny
+    # length 0: the whole row is zeros even with every page stale
+    out0 = np.asarray(pa.paged_gather(
+        np.full_like(pool, 127 if pool.dtype == np.int8 else 1e30),
+        table, np.array([0], np.int32), scales=scales,
+        use_kernel=use_kernel))
+    assert not out0.any()
+
+
+def test_gather_out_len_trims_to_seq_rung():
+    pool, scales = _pool()
+    full = pa.paged_gather_ref(pool, TABLE, LENGTHS, scales=scales)
+    trim = pa.paged_gather_ref(pool, TABLE, LENGTHS, scales=scales,
+                               out_len=6)
+    assert trim.shape == (3, 6, DIM)
+    np.testing.assert_array_equal(np.asarray(trim),
+                                  np.asarray(full)[:, :6])
+
+
+def test_gather_clamps_out_of_range_table_entries():
+    # index_map DMAs the page before the mask applies — entries must be
+    # clamped into the pool, and the mask makes the row invisible anyway
+    pool, scales = _pool()
+    table = np.array([[0, 99]], np.int32)
+    out = np.asarray(pa.paged_gather(pool, table, np.array([4], np.int32),
+                                     scales=scales, use_kernel=True))
+    assert np.isfinite(out).all()
+    assert not out[0, 4:].any()
+
+
+# ------------------------------------------------- paged decode attention
+
+def _dense_attention(q, k, v, lengths):
+    """Straight-line fp32 softmax over the gathered dense rows — the
+    ground truth the online-softmax accumulation must reproduce."""
+    s = (q[:, None, :] * k).sum(-1) / np.sqrt(DIM)
+    out = np.zeros_like(q)
+    for i in range(q.shape[0]):
+        n = lengths[i]
+        if n == 0:
+            continue
+        w = np.exp(s[i, :n] - s[i, :n].max())
+        out[i] = (w[:, None] * v[i, :n]).sum(0) / w.sum()
+    return out
+
+
+@pytest.mark.parametrize("dtype", ["float32", "int8"])
+@pytest.mark.parametrize("use_kernel", [True, False])
+def test_attention_matches_dense_reference(dtype, use_kernel):
+    """Page-table indexing + masking + online softmax vs the dense
+    einsum, across a full page, a mid-page length, a page-boundary
+    length and an empty row, fp32 and int8 pools."""
+    k_pool, k_scales = _pool(dtype, seed=1)
+    v_pool, v_scales = _pool(dtype, seed=2)
+    table = np.array([[3, 1], [0, 6], [5, 2], [4, 4]], np.int32)
+    lengths = np.array([8, 5, 4, 0], np.int32)   # boundary at 4 = PS
+    q = np.random.default_rng(3).standard_normal((4, DIM)).astype(
+        np.float32)
+    got = np.asarray(pa.paged_attention(
+        q, k_pool, v_pool, table, lengths, k_scales=k_scales,
+        v_scales=v_scales, use_kernel=use_kernel))
+    k = _host_gather(k_pool, table, lengths, k_scales)
+    v = _host_gather(v_pool, table, lengths, v_scales)
+    np.testing.assert_allclose(got, _dense_attention(q, k, v, lengths),
+                               rtol=2e-5, atol=2e-6)
+    assert not got[3].any()                      # empty row: exact zeros
+
+
+def test_attention_kernel_matches_ref_path():
+    k_pool, k_scales = _pool(seed=4)
+    v_pool, v_scales = _pool(seed=5)
+    table = np.array([[6, 0], [2, 2]], np.int32)
+    lengths = np.array([7, 6], np.int32)
+    q = np.random.default_rng(6).standard_normal((2, DIM)).astype(
+        np.float32)
+    kern = np.asarray(pa.paged_attention(q, k_pool, v_pool, table,
+                                         lengths, use_kernel=True))
+    ref = np.asarray(pa.paged_attention_ref(q, k_pool, v_pool, table,
+                                            lengths))
+    np.testing.assert_allclose(kern, ref, rtol=2e-5, atol=2e-6)
+
+
+def test_attention_ignores_stale_rows_on_recycled_pages():
+    k_pool, _ = _pool(seed=7)
+    v_pool, _ = _pool(seed=8)
+    table = np.array([[1, 5]], np.int32)
+    lengths = np.array([4], np.int32)            # second page fully dead
+    q = np.ones((1, DIM), np.float32)
+    base = np.asarray(pa.paged_attention(q, k_pool, v_pool, table,
+                                         lengths, use_kernel=True))
+    k_pool[5] = 1e3                              # poison the dead page
+    v_pool[5] = -1e3
+    poisoned = np.asarray(pa.paged_attention(q, k_pool, v_pool, table,
+                                             lengths, use_kernel=True))
+    np.testing.assert_array_equal(base, poisoned)
+
+
+# ----------------------------------------------------- verdict dispatch
+
+def test_tune_persists_verdict_and_auto_dispatch_stays_correct():
+    pool, scales = _pool()
+    rec = pa.tune_paged_gather(3, 2, PS, DIM, N_PAGES)
+    key = pa.gather_key(3, 2, PS, DIM, N_PAGES, jnp.float32)
+    assert autotune.get_tuner().lookup(key) == rec
+    # never-selects-slower, whichever way the measurement went — and the
+    # auto path must match the reference bitwise on either verdict
+    if rec["use_kernel"]:
+        assert rec["best_ms"] < rec["reference_ms"]
+    out = np.asarray(pa.paged_gather(pool, TABLE, LENGTHS, scales=scales))
+    np.testing.assert_array_equal(
+        out, np.asarray(pa.paged_gather_ref(pool, TABLE, LENGTHS,
+                                            scales=scales)))
+
+
+def test_auto_dispatch_off_mode_takes_reference(monkeypatch):
+    monkeypatch.setenv("ZOO_AUTOTUNE", "off")
+    pool, scales = _pool()
+    out = pa.paged_gather(pool, TABLE, LENGTHS, scales=scales)
+    np.testing.assert_array_equal(
+        np.asarray(out), _host_gather(pool, TABLE, LENGTHS, scales))
+    assert autotune.pending_count() == 0
+
+
+def test_auto_dispatch_miss_enqueues_for_warmup_worker():
+    pool, scales = _pool()
+    pa.paged_attention(np.zeros((3, DIM), np.float32), pool, pool,
+                       TABLE, LENGTHS)
+    assert autotune.pending_count() == 1
+    assert autotune.tune_pending() == 1          # worker drains → verdict
+    key = pa.attn_key(3, 2, PS, DIM, N_PAGES, jnp.float32)
+    assert autotune.get_tuner().lookup(key) is not None
+
+
+def test_seeded_winning_verdict_routes_through_kernel(monkeypatch):
+    calls = []
+    orig = pa._gather_pallas
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(pa, "_gather_pallas", spy)
+    key = pa.gather_key(3, 2, PS, DIM, N_PAGES, jnp.float32)
+    autotune.get_tuner().record(key, {
+        "kernel": "paged_gather", "best": "pallas", "use_kernel": True,
+        "best_ms": 1.0, "reference_ms": 2.0, "speedup": 2.0})
+    pool, scales = _pool()
+    out = pa.paged_gather(pool, TABLE, LENGTHS, scales=scales)
+    assert calls, "winning verdict did not dispatch the kernel"
+    np.testing.assert_array_equal(
+        np.asarray(out), _host_gather(pool, TABLE, LENGTHS, scales))
+
+
+def test_step_key_spells_shape_pool_and_kv_dtype():
+    key = pa.step_key(4, 16, 8, 32, 12, np.int8, (5, 7))
+    assert "b4s16p8d32n12" in key and "enc5x7" in key
+    assert key.endswith("int8") and key.startswith("paged_step|")

@@ -41,6 +41,7 @@ def test_rule_registry_complete():
         "rowwise-map-in-data-plane",
         "record-ack-leak", "lock-release-path", "span-pairing",
         "tainted-host-sync", "shape-dependent-branch-in-jit",
+        "kv-page-leak",
     }
     for rid, rule in rules.items():
         assert rule.id == rid
@@ -533,6 +534,7 @@ def test_seeded_fixture_trips_every_family():
         "rowwise-map-in-data-plane",
         "record-ack-leak", "lock-release-path", "span-pairing",
         "tainted-host-sync", "shape-dependent-branch-in-jit",
+        "kv-page-leak",
     }
     # and the suppressed half of the fixture stays quiet
     sup = [f for f in findings
